@@ -1,0 +1,45 @@
+"""deneva_tpu — a TPU-native distributed OLTP concurrency-control testbed.
+
+A from-scratch rebuild of the capabilities of Deneva (moyun/deneva, the MIT
+DDBMS testbed behind Harding et al., VLDB 2017): six concurrency-control
+algorithms plus Calvin's deterministic protocol, three benchmarks (YCSB,
+TPC-C Payment/NewOrder, PPS), multi-node client/server execution, and a
+reproducible experiment harness reporting committed-txns/sec, abort rates
+and latency breakdowns.
+
+Architecture (TPU-first, not a translation):
+
+* The reference resolves conflicts one row at a time behind per-row latches
+  (`storage/row.cpp:197-310` dispatching to `concurrency_control/*`).  Here
+  the unit of execution is an **epoch**: a batch of transactions whose
+  read/write sets are validated *simultaneously* on the TPU — RW-set
+  incidence matrices multiplied on the MXU into a boolean conflict matrix,
+  then a greedy serialization sweep decides commit/abort/defer per the
+  selected algorithm's rules.  Tables live device-resident as
+  structure-of-arrays; committed writes are applied with vectorized
+  scatters inside the same jitted step.
+
+* The reference partitions the keyspace across nodes by hash
+  (`system/global.h:294`) and coordinates with 2PC / Calvin over nanomsg.
+  Here the keyspace is additionally sharded across the TPU **device mesh**
+  (`jax.sharding.Mesh` + shard_map), with XLA collectives over ICI playing
+  the role nanomsg plays across hosts.  Multi-host distribution keeps a
+  message-passing runtime (see `deneva_tpu.runtime`).
+
+Package map (mirrors SURVEY.md §1's layer map):
+
+* `config`    — L1: runtime flag system (no compile-time #define forest)
+* `storage`   — L7: catalog / tables / indexes, device-resident
+* `ops`       — TPU kernels: hashing, conflict matrices, serialization sweeps
+* `cc`        — L6: the CC algorithms as batched validation backends
+* `engine`    — L3-L5 analogue: the epoch executor (jitted step function)
+* `workloads` — L8: YCSB / TPCC / PPS generators + loaders + txn programs
+* `parallel`  — mesh construction + sharded epoch execution
+* `runtime`   — L2/L9/L10: processes, messages, transport, client/server
+* `stats`     — L11: counters, percentile arrays, [summary] emitter
+* `harness`   — L0: experiment configs and sweep runner
+"""
+
+__version__ = "0.1.0"
+
+from deneva_tpu.config import Config, CCAlg, WorkloadKind, Mode  # noqa: F401

@@ -1,0 +1,181 @@
+"""Statistics subsystem (reference `statistics/`, SURVEY §5.5).
+
+The reference accumulates ~300 counters into per-thread cache-padded
+``Stats_thd`` structs via ``INC_STATS`` macros and prints one
+``[summary] k=v,k=v,...`` line at exit (`statistics/stats.cpp:1470`) that
+``scripts/parse_results.py`` regexes apart; latency distributions go through
+``StatsArr`` sorted arrays (`statistics/stats_array.cpp:127-146`).
+
+Here a ``Stats`` object holds plain dict counters (the interactive runtime
+keeps one per worker and merges, mirroring the per-thread design), plus
+``StatsArr`` for percentile series.  ``summary_line()`` emits the same
+``[summary]`` format with the reference's headline field names so the
+reference's result parsers (and ours in `deneva_tpu.harness.parse`) work
+unchanged:  ``total_runtime, tput, txn_cnt, total_txn_commit_cnt,
+total_txn_abort_cnt, unique_txn_abort_cnt`` (`statistics/stats.h:44-289`).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Iterable
+
+import numpy as np
+
+
+class StatsArr:
+    """Percentile array (reference `statistics/stats_array.cpp:53-146`).
+
+    The reference preallocates a fixed array and either sorts or histograms.
+    Here: an amortized-growth numpy buffer; percentiles computed on demand
+    (same 50/90/95/99 points as `scripts/latency_stats.py:20`).
+    """
+
+    __slots__ = ("_buf", "_n")
+
+    def __init__(self, cap: int = 4096):
+        self._buf = np.empty(cap, dtype=np.float64)
+        self._n = 0
+
+    def insert(self, v: float) -> None:
+        if self._n == len(self._buf):
+            self._buf = np.resize(self._buf, len(self._buf) * 2)
+        self._buf[self._n] = v
+        self._n += 1
+
+    def extend(self, vs: Iterable[float]) -> None:
+        vs = np.asarray(list(vs) if not isinstance(vs, np.ndarray) else vs,
+                        dtype=np.float64)
+        need = self._n + len(vs)
+        if need > len(self._buf):
+            cap = len(self._buf)
+            while cap < need:
+                cap *= 2
+            self._buf = np.resize(self._buf, cap)
+        self._buf[self._n:need] = vs
+        self._n = need
+
+    def __len__(self) -> int:
+        return self._n
+
+    def view(self) -> np.ndarray:
+        return self._buf[: self._n]
+
+    def percentile(self, p: float) -> float:
+        if self._n == 0:
+            return 0.0
+        return float(np.percentile(self.view(), p))
+
+    def percentiles(self, ps=(50, 90, 95, 99)) -> dict[str, float]:
+        if self._n == 0:
+            return {f"p{p}": 0.0 for p in ps}
+        vals = np.percentile(self.view(), list(ps))
+        return {f"p{p}": float(v) for p, v in zip(ps, vals)}
+
+    def mean(self) -> float:
+        return float(self.view().mean()) if self._n else 0.0
+
+
+class Stats:
+    """Counter/timer registry for one node (or one worker thread).
+
+    ``incr``/``add`` replace the reference's ``INC_STATS(tid, name, v)``;
+    per-thread instances are combined with ``merge`` exactly as
+    ``Stats::print`` folds ``Stats_thd`` structs.
+    """
+
+    def __init__(self) -> None:
+        self.counters: dict[str, float] = defaultdict(float)
+        self.arrays: dict[str, StatsArr] = {}
+        self._t_start: float | None = None
+        self._t_end: float | None = None
+
+    # -- accumulation ---------------------------------------------------
+    def incr(self, name: str, v: float = 1.0) -> None:
+        self.counters[name] += v
+
+    add = incr
+
+    def set(self, name: str, v: float) -> None:
+        self.counters[name] = v
+
+    def arr(self, name: str) -> StatsArr:
+        a = self.arrays.get(name)
+        if a is None:
+            a = self.arrays[name] = StatsArr()
+        return a
+
+    def merge(self, other: "Stats") -> None:
+        for k, v in other.counters.items():
+            self.counters[k] += v
+        for k, a in other.arrays.items():
+            self.arr(k).extend(a.view())
+        # Union of run windows: workers measure concurrently, so the
+        # aggregate window spans min(start)..max(end), not the sum.
+        if other._t_start is not None:
+            if self._t_start is None or other._t_start < self._t_start:
+                self._t_start = other._t_start
+        if other._t_end is not None:
+            if self._t_end is None or other._t_end > self._t_end:
+                self._t_end = other._t_end
+
+    # -- run window (reference SimManager warmup/done timers) -----------
+    def start_window(self) -> None:
+        self._t_start = time.monotonic()
+
+    def end_window(self) -> None:
+        self._t_end = time.monotonic()
+
+    @property
+    def runtime(self) -> float:
+        if self._t_start is None:
+            return 0.0
+        end = self._t_end if self._t_end is not None else time.monotonic()
+        return end - self._t_start
+
+    # -- output ----------------------------------------------------------
+    def summary_fields(self) -> dict[str, float]:
+        c = self.counters
+        runtime = c.get("total_runtime", 0.0) or self.runtime
+        commit = c.get("total_txn_commit_cnt", 0.0)
+        out = dict(c)
+        out["total_runtime"] = runtime
+        out["txn_cnt"] = commit
+        out["tput"] = commit / runtime if runtime > 0 else 0.0
+        for name, a in self.arrays.items():
+            if len(a):
+                for p, v in a.percentiles().items():
+                    out[f"{name}_{p}"] = v
+                out[f"{name}_mean"] = a.mean()
+        return out
+
+    def summary_line(self, client: bool = False) -> str:
+        """Reference `[summary]` line (`statistics/stats.cpp:1470`, client
+        variant `:1558`)."""
+        fields = self.summary_fields()
+        head = ["total_runtime", "tput", "txn_cnt", "total_txn_commit_cnt",
+                "total_txn_abort_cnt", "unique_txn_abort_cnt"]
+        ordered = [(k, fields.get(k, 0.0)) for k in head]
+        ordered += sorted((k, v) for k, v in fields.items() if k not in head)
+        body = ",".join(f"{k}={_fmt(v)}" for k, v in ordered)
+        return f"[summary] {body}"
+
+
+def _fmt(v: float) -> str:
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return f"{v:.6g}"
+
+
+def parse_summary(line: str) -> dict[str, float]:
+    """Inverse of ``summary_line`` (reference `scripts/parse_results.py:19-38`)."""
+    assert "[summary]" in line, line
+    body = line.split("[summary]", 1)[1].strip()
+    out: dict[str, float] = {}
+    for kv in body.split(","):
+        if not kv:
+            continue
+        k, v = kv.split("=", 1)
+        out[k] = float(v)
+    return out

@@ -1,0 +1,20 @@
+"""Test harness setup.
+
+Tests run on a virtual 8-device CPU mesh (the analogue of the reference's
+IPC-on-one-box multi-node rig, `scripts/run_experiments.py:67` /
+`transport/transport.cpp:132` — SURVEY §4.4): sharding and collective code
+paths execute for real without TPU hardware.  Env vars must be set before
+the first `import jax` anywhere, hence this module-level block.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

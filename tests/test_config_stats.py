@@ -1,0 +1,57 @@
+"""Unit tests for the config + stats layers (SURVEY §1 L1/L11)."""
+
+import pytest
+
+from deneva_tpu.config import Config, CCAlg, WorkloadKind
+from deneva_tpu.stats import Stats, StatsArr, parse_summary
+
+
+def test_config_defaults_validate():
+    cfg = Config().validate()
+    assert cfg.cc_alg == CCAlg.TPU_BATCH
+    assert cfg.workload == WorkloadKind.YCSB
+
+
+def test_config_from_args_roundtrip():
+    cfg = Config.from_args([
+        "--cc-alg=OCC", "--zipf-theta", "0.9", "--epoch_batch=1024",
+        "--node_cnt=4", "--backoff=false", "--mesh_shape=(8,)",
+    ])
+    assert cfg.cc_alg == CCAlg.OCC
+    assert cfg.zipf_theta == 0.9
+    assert cfg.epoch_batch == 1024
+    assert cfg.node_cnt == 4
+    assert cfg.backoff is False
+    assert cfg.mesh_shape == (8,)
+
+
+def test_config_rejects_unknown_and_bad():
+    with pytest.raises(ValueError):
+        Config.from_args(["--nonsense=1"])
+    with pytest.raises(AssertionError):
+        Config(epoch_batch=1000).validate()  # not a power of two
+
+
+def test_stats_arr_percentiles():
+    a = StatsArr(cap=4)
+    a.extend(range(1, 101))
+    assert a.percentile(50) == pytest.approx(50.5)
+    assert a.percentile(99) == pytest.approx(99.01)
+    assert len(a) == 100
+
+
+def test_stats_merge_and_summary_roundtrip():
+    s1, s2 = Stats(), Stats()
+    s1.incr("total_txn_commit_cnt", 100)
+    s1.incr("total_txn_abort_cnt", 7)
+    s2.incr("total_txn_commit_cnt", 50)
+    s2.arr("client_client_latency").extend([1.0, 2.0, 3.0])
+    s1.merge(s2)
+    s1.set("total_runtime", 2.0)
+
+    line = s1.summary_line()
+    assert line.startswith("[summary] total_runtime=2,tput=75,txn_cnt=150")
+    fields = parse_summary(line)
+    assert fields["total_txn_commit_cnt"] == 150
+    assert fields["total_txn_abort_cnt"] == 7
+    assert fields["client_client_latency_p50"] == 2.0
