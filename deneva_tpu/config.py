@@ -170,25 +170,34 @@ class Config:
 
     # ------------------------------------------------------------------
     def replace(self, **kw: Any) -> "Config":
-        return dataclasses.replace(self, **kw)
+        return dataclasses.replace(self, **kw).validate()
 
     def validate(self) -> "Config":
-        assert self.node_cnt >= 1 and self.part_cnt >= 1
-        assert self.epoch_batch > 0 and (self.epoch_batch & (self.epoch_batch - 1)) == 0, \
-            "epoch_batch must be a power of two (tiling discipline)"
-        assert self.max_accesses >= self.req_per_query or self.workload != WorkloadKind.YCSB
+        # real raises, not asserts: must hold under `python -O` too
+        _check(self.node_cnt >= 1 and self.part_cnt >= 1,
+               "node_cnt/part_cnt must be >= 1")
+        _check(self.epoch_batch > 0
+               and (self.epoch_batch & (self.epoch_batch - 1)) == 0,
+               "epoch_batch must be a power of two (tiling discipline)")
         if self.workload == WorkloadKind.YCSB:
-            assert abs(self.read_perc + self.write_perc - 1.0) < 1e-6
-        assert self.isolation_level in (
-            "SERIALIZABLE", "READ_COMMITTED", "READ_UNCOMMITTED", "NOLOCK")
-        assert self.index_struct in ("IDX_HASH", "IDX_BTREE")
-        assert self.tport_type in ("ipc", "tcp")
-        assert self.repl_type in ("AP", "AA")
+            _check(self.max_accesses >= self.req_per_query,
+                   "max_accesses must cover req_per_query")
+            _check(abs(self.read_perc + self.write_perc - 1.0) < 1e-6,
+                   "read_perc + write_perc must sum to 1")
+        _check(self.isolation_level in (
+            "SERIALIZABLE", "READ_COMMITTED", "READ_UNCOMMITTED", "NOLOCK"),
+            f"bad isolation_level {self.isolation_level!r}")
+        _check(self.index_struct in ("IDX_HASH", "IDX_BTREE"),
+               f"bad index_struct {self.index_struct!r}")
+        _check(self.tport_type in ("ipc", "tcp"),
+               f"bad tport_type {self.tport_type!r}")
+        _check(self.repl_type in ("AP", "AA"),
+               f"bad repl_type {self.repl_type!r}")
         if self.workload == WorkloadKind.PPS:
             mix = (self.perc_getparts + self.perc_getproducts + self.perc_getsuppliers
                    + self.perc_getpartbyproduct + self.perc_getpartbysupplier
                    + self.perc_orderproduct + self.perc_updateproductpart + self.perc_updatepart)
-            assert abs(mix - 1.0) < 1e-6, "PPS txn mix must sum to 1"
+            _check(abs(mix - 1.0) < 1e-6, "PPS txn mix must sum to 1")
         return self
 
     # -- CLI bridge -----------------------------------------------------
@@ -219,6 +228,11 @@ class Config:
             kw[name] = _coerce(fields[name].type, val)
             i += 1
         return cls(**kw).validate()
+
+
+def _check(ok: bool, msg: str) -> None:
+    if not ok:
+        raise ValueError(f"config: {msg}")
 
 
 def _coerce(typ: Any, val: str) -> Any:

@@ -35,7 +35,7 @@ class StatsArr:
     __slots__ = ("_buf", "_n")
 
     def __init__(self, cap: int = 4096):
-        self._buf = np.empty(cap, dtype=np.float64)
+        self._buf = np.empty(max(1, cap), dtype=np.float64)
         self._n = 0
 
     def insert(self, v: float) -> None:
@@ -150,9 +150,10 @@ class Stats:
                 out[f"{name}_mean"] = a.mean()
         return out
 
-    def summary_line(self, client: bool = False) -> str:
-        """Reference `[summary]` line (`statistics/stats.cpp:1470`, client
-        variant `:1558`)."""
+    def summary_line(self) -> str:
+        """Reference `[summary]` line (`statistics/stats.cpp:1470`).  The
+        reference's client variant (`:1558`) is just this emitter called on
+        the client process's own Stats instance."""
         fields = self.summary_fields()
         head = ["total_runtime", "tput", "txn_cnt", "total_txn_commit_cnt",
                 "total_txn_abort_cnt", "unique_txn_abort_cnt"]

@@ -1,0 +1,16 @@
+"""Storage layer (reference `storage/`, SURVEY §2.4).
+
+The reference stores tuples as flat ``char*`` rows with schema-offset field
+access behind per-row CC managers (`storage/row.h:57`, `storage/row.cpp:95-153`).
+A TPU has no use for row-at-a-time pointers: here a table is a
+**structure-of-arrays resident in device memory** — one JAX array per
+column — accessed by vectorized gather/scatter over *slot ids*.  Indexes map
+keys to slots (dense affine fast path, or an open-addressing device hash
+table built host-side).  Per-row CC state lives in separate per-key arrays
+owned by `deneva_tpu.cc`, not inside the row (the reference's
+``row_t::manager`` pointer has no analogue here by design).
+"""
+
+from deneva_tpu.storage.catalog import Catalog, TableSchema, Column, parse_schema  # noqa: F401
+from deneva_tpu.storage.table import DeviceTable  # noqa: F401
+from deneva_tpu.storage.index import DenseIndex, HashIndex  # noqa: F401

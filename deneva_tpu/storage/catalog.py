@@ -1,0 +1,98 @@
+"""Schema catalog (reference `storage/catalog.{h,cpp}`, `system/wl.cpp:31-149`).
+
+Parses the reference's exact schema text format (``benchmarks/*_schema.txt``)::
+
+    //size, type, name
+    TABLE=MAIN_TABLE
+        100,string,F0
+        ...
+    INDEX=MAIN_INDEX
+        MAIN_TABLE,0
+
+Columns carry the declared wire size/type; `deneva_tpu.storage.table` then
+chooses a TPU-resident representation per column (int64_t -> int32 key
+column, double -> float32, string -> fingerprint word or raw bytes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Column:
+    name: str
+    ctype: str          # "int64_t" | "string" | "double" | "uint64_t"
+    size: int           # declared byte width in the reference schema
+    index: int          # position within the table
+
+
+@dataclass(frozen=True)
+class IndexDef:
+    name: str
+    table: str
+    part_col: int       # reference stores (table, column) per index entry
+
+
+@dataclass
+class TableSchema:
+    name: str
+    columns: list[Column] = field(default_factory=list)
+
+    def column(self, name: str) -> Column:
+        for c in self.columns:
+            if c.name == name:
+                return c
+        raise KeyError(f"{self.name}: no column {name!r}")
+
+    @property
+    def tuple_size(self) -> int:
+        return sum(c.size for c in self.columns)
+
+
+@dataclass
+class Catalog:
+    tables: dict[str, TableSchema] = field(default_factory=dict)
+    indexes: dict[str, IndexDef] = field(default_factory=dict)
+
+    def table(self, name: str) -> TableSchema:
+        return self.tables[name]
+
+
+def parse_schema(text: str) -> Catalog:
+    """Parse schema text; same grammar as `system/wl.cpp:31-149`."""
+    cat = Catalog()
+    current: TableSchema | None = None
+    current_index: str | None = None
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("//") or line.startswith("#"):
+            current = current if line else current
+            if not line:
+                current, current_index = None, None
+            continue
+        if line.startswith("TABLE="):
+            current = TableSchema(name=line.split("=", 1)[1].strip())
+            cat.tables[current.name] = current
+            current_index = None
+        elif line.startswith("INDEX="):
+            current_index = line.split("=", 1)[1].strip()
+            current = None
+        elif current is not None:
+            size_s, ctype, name = (p.strip() for p in line.split(","))
+            current.columns.append(
+                Column(name=name, ctype=ctype, size=int(size_s),
+                       index=len(current.columns)))
+        elif current_index is not None:
+            parts = [p.strip() for p in line.split(",")]
+            table, col = parts[0], int(parts[1]) if len(parts) > 1 else 0
+            cat.indexes[current_index] = IndexDef(
+                name=current_index, table=table, part_col=col)
+        else:
+            raise ValueError(f"schema line outside TABLE/INDEX block: {raw!r}")
+    return cat
+
+
+def load_schema_file(path: str) -> Catalog:
+    with open(path) as f:
+        return parse_schema(f.read())

@@ -1,0 +1,132 @@
+"""Indexes: key -> slot (reference `storage/index_hash.{h,cpp}`, `index_btree`).
+
+The reference's ``IndexHash`` is a latched bucket-chain hash table probed
+one key at a time (`storage/index_hash.cpp:56-140`).  On TPU, index probes
+happen for a whole epoch of requests at once, so the structures are:
+
+* `DenseIndex` — affine ``slot = (key - base) // stride``.  Covers every
+  loader-built primary index in the three benchmarks (YCSB keys are dense
+  `key % part_cnt` partitions, `benchmarks/ycsb_wl.cpp:70-74`; TPCC/PPS
+  primary keys are dense composites).  Free at runtime — no memory traffic.
+* `HashIndex` — open-addressing (linear probe) table, built host-side with
+  vectorized numpy, probed on device with a fixed-depth unrolled loop.
+  Used for sparse/secondary keys (e.g. TPCC order lookups).  Lookups are
+  latch-free exactly like the reference's reads; mutation happens only
+  between epochs (host rebuild) in round 1.
+
+Both return the table's trash slot for missing keys, so a failed probe
+flows harmlessly through gather/scatter (the reference asserts instead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EMPTY = np.int32(-1)
+_MULT = np.uint32(2654435761)  # Knuth multiplicative hash
+
+
+@dataclass
+class DenseIndex:
+    base: int = 0
+    stride: int = 1
+    size: int = 0          # number of indexed keys; OOB -> miss
+    miss_slot: int = 0     # table trash slot
+
+    def lookup(self, keys: jax.Array) -> jax.Array:
+        q = (keys.astype(jnp.int32) - self.base)
+        slot = q // self.stride
+        ok = (q >= 0) & (q % self.stride == 0) & (slot < self.size)
+        return jnp.where(ok, slot, jnp.int32(self.miss_slot))
+
+
+@dataclass
+class HashIndex:
+    """Open-addressing key->slot map.  Pytree (arrays live on device)."""
+
+    keys: jax.Array        # int32[cap]; _EMPTY = free
+    slots: jax.Array       # int32[cap]
+    # -- static --
+    cap: int               # power of two
+    max_probe: int
+    miss_slot: int
+
+    @classmethod
+    def build(cls, keys: np.ndarray, slots: np.ndarray, miss_slot: int,
+              load_factor: float = 0.5) -> "HashIndex":
+        """Host-side vectorized build (loader path, SURVEY §2.5 parallel
+        loaders — here one numpy pass per probe round)."""
+        keys = np.asarray(keys, np.int32)
+        slots = np.asarray(slots, np.int32)
+        assert keys.ndim == 1 and keys.shape == slots.shape
+        assert np.all(keys >= 0), "negative keys are reserved"
+        cap = 1
+        while cap < max(8, int(len(keys) / load_factor)):
+            cap *= 2
+        tab_k = np.full(cap, _EMPTY, np.int32)
+        tab_s = np.zeros(cap, np.int32)
+        idx = _hash_np(keys, cap)
+        pending = np.arange(len(keys))
+        max_probe = 0
+        while len(pending):
+            max_probe += 1
+            pos = idx[pending]
+            # last-writer-wins claim; winners are those that read back own id
+            claim = np.full(cap, -1, np.int64)
+            claim[pos] = pending
+            won = claim[pos] == pending
+            # among winners, the cell must actually be free
+            free = tab_k[pos] == _EMPTY
+            place = won & free
+            placed = pending[place]
+            tab_k[idx[placed]] = keys[placed]
+            tab_s[idx[placed]] = slots[placed]
+            dup = tab_k[pos] == keys[pending]  # same key already present
+            if np.any(dup & ~place):
+                raise ValueError("duplicate keys in unique HashIndex")
+            pending = pending[~place]
+            idx[pending] = (idx[pending] + 1) & (cap - 1)
+            if max_probe > cap:
+                raise RuntimeError("hash build failed to converge")
+        return cls(keys=jnp.asarray(tab_k), slots=jnp.asarray(tab_s),
+                   cap=cap, max_probe=max(8, max_probe), miss_slot=miss_slot)
+
+    def lookup(self, q: jax.Array) -> jax.Array:
+        """Vectorized fixed-depth probe; misses -> miss_slot."""
+        q = q.astype(jnp.int32)
+        start = _hash_jnp(q, self.cap)
+        found = jnp.full(q.shape, jnp.int32(self.miss_slot))
+        done = jnp.zeros(q.shape, bool)
+
+        def body(p, carry):
+            found, done = carry
+            pos = (start + p) & (self.cap - 1)
+            k = jnp.take(self.keys, pos)
+            hit = (k == q) & ~done
+            empty = k == _EMPTY
+            found = jnp.where(hit, jnp.take(self.slots, pos), found)
+            done = done | hit | empty
+            return found, done
+
+        found, _ = jax.lax.fori_loop(0, self.max_probe, body, (found, done))
+        return found
+
+
+def _hash_np(k: np.ndarray, cap: int) -> np.ndarray:
+    return ((k.astype(np.uint32) * _MULT) >> np.uint32(16)).astype(np.int64) & (cap - 1)
+
+
+def _hash_jnp(k: jax.Array, cap: int) -> jax.Array:
+    h = (k.astype(jnp.uint32) * jnp.uint32(2654435761)) >> jnp.uint32(16)
+    return (h & jnp.uint32(cap - 1)).astype(jnp.int32)
+
+
+jax.tree_util.register_dataclass(
+    HashIndex,
+    data_fields=["keys", "slots"],
+    meta_fields=["cap", "max_probe", "miss_slot"],
+)

@@ -1,0 +1,158 @@
+"""Device-resident tables (reference `storage/row.{h,cpp}`, `storage/table.{h,cpp}`).
+
+A `DeviceTable` is the TPU-native replacement for the reference's
+``table_t`` + per-row ``row_t`` pointers: one JAX array per column, indexed
+by *slot id*.  Field access (`row_t::set_value/get_value`,
+`storage/row.cpp:95-153`) becomes vectorized gather/scatter over whole
+epochs of accesses at once.
+
+Representation choices per declared column type:
+
+* ``int64_t``/``uint64_t`` -> int32.  TPU int64 is emulated and slow; all
+  benchmark keys fit 31 bits at the scales the harness drives (asserted at
+  load time by the workloads).
+* ``double`` -> float32 (MXU/VPU native).
+* ``string`` -> by default a uint32 *fingerprint* word per field — the
+  analogue of the reference's ``SIM_FULL_ROW=false`` mode
+  (`storage/row.cpp:30`), which likewise does not materialize payload
+  bytes.  With ``full_row=True`` strings are raw ``uint8[capacity, size]``
+  so consistency tests can check real bytes.
+
+Every table allocates one extra **trash slot** at index ``capacity``:
+masked-out scatters are steered there instead of branching, keeping all
+shapes static under jit.
+
+Appends (`table_t::get_new_row`, `storage/table.cpp:42-53`) are a
+prefix-sum slot assignment over the epoch's insert mask; the running
+``row_cnt`` is traced state so inserts compose with jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deneva_tpu.storage.catalog import TableSchema
+
+
+def _col_spec(ctype: str, size: int, full_row: bool) -> tuple[object, tuple]:
+    """(dtype, extra_shape) for one column."""
+    if ctype in ("int64_t", "uint64_t", "int32_t", "uint32_t"):
+        return jnp.int32, ()
+    if ctype in ("double", "float"):
+        return jnp.float32, ()
+    if ctype == "string":
+        if full_row:
+            return jnp.uint8, (size,)
+        return jnp.uint32, ()
+    raise ValueError(f"unknown column type {ctype!r}")
+
+
+@dataclass
+class DeviceTable:
+    """One table: dict of column arrays + insert cursor.  Pytree."""
+
+    columns: dict[str, jax.Array]
+    row_cnt: jax.Array           # int32 scalar: next free slot
+    # -- static metadata --
+    name: str
+    capacity: int
+    full_row: bool
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def create(cls, schema: TableSchema, capacity: int,
+               full_row: bool = False) -> "DeviceTable":
+        cols = {}
+        for c in schema.columns:
+            dtype, extra = _col_spec(c.ctype, c.size, full_row)
+            cols[c.name] = jnp.zeros((capacity + 1, *extra), dtype=dtype)
+        return cls(columns=cols, row_cnt=jnp.zeros((), jnp.int32),
+                   name=schema.name, capacity=capacity, full_row=full_row)
+
+    @property
+    def trash_slot(self) -> int:
+        return self.capacity
+
+    # -- vectorized field access ---------------------------------------
+    def gather(self, slots: jax.Array, cols: tuple[str, ...] | None = None
+               ) -> dict[str, jax.Array]:
+        """Read fields of many rows at once.  Out-of-range / negative slots
+        read the trash slot (zeros)."""
+        slots = _sanitize(slots, self.capacity)
+        names = cols if cols is not None else tuple(self.columns)
+        return {n: jnp.take(self.columns[n], slots, axis=0) for n in names}
+
+    def scatter(self, slots: jax.Array, updates: dict[str, jax.Array],
+                mask: jax.Array | None = None) -> "DeviceTable":
+        """Masked last-write scatter.  Callers that need a deterministic
+        winner among duplicate slots must pre-resolve (see
+        `deneva_tpu.ops.scatter.last_writer`); raw duplicates here follow
+        XLA's unspecified ordering."""
+        slots = _sanitize(slots, self.capacity, mask)
+        cols = dict(self.columns)
+        for n, v in updates.items():
+            cols[n] = cols[n].at[slots].set(v.astype(cols[n].dtype))
+        return self._replace(columns=cols)
+
+    def scatter_add(self, slots: jax.Array, updates: dict[str, jax.Array],
+                    mask: jax.Array | None = None) -> "DeviceTable":
+        """Commutative read-modify-write (balance += x, stock -= y): the
+        batch analogue of the reference's in-place row updates; order-free
+        so duplicate slots are exact."""
+        slots = _sanitize(slots, self.capacity, mask)
+        cols = dict(self.columns)
+        for n, v in updates.items():
+            cols[n] = cols[n].at[slots].add(v.astype(cols[n].dtype))
+        return self._replace(columns=cols)
+
+    def append(self, rows: dict[str, jax.Array], mask: jax.Array
+               ) -> tuple["DeviceTable", jax.Array]:
+        """Insert up to len(mask) rows; returns (table, slot ids).
+
+        Slot assignment is a prefix sum over the insert mask starting at
+        ``row_cnt`` (`table_t::get_new_row` without the latch).  Rows past
+        capacity fall into the trash slot and are dropped (callers size
+        tables for the run length, as the reference pre-sizes pools).
+        """
+        mask = mask.astype(jnp.int32)
+        offs = jnp.cumsum(mask) - mask
+        slots = self.row_cnt + offs
+        slots = jnp.where((mask > 0) & (slots < self.capacity),
+                          slots, self.capacity)
+        cols = dict(self.columns)
+        for n, v in rows.items():
+            cols[n] = cols[n].at[slots].set(v.astype(cols[n].dtype))
+        new_cnt = jnp.minimum(self.row_cnt + mask.sum(),
+                              jnp.int32(self.capacity))
+        return self._replace(columns=cols, row_cnt=new_cnt), slots
+
+    # ------------------------------------------------------------------
+    def host_column(self, name: str) -> np.ndarray:
+        """Host copy of a column minus the trash slot (tests/loaders)."""
+        return np.asarray(self.columns[name])[: self.capacity]
+
+    def _replace(self, **kw) -> "DeviceTable":
+        d = dict(columns=self.columns, row_cnt=self.row_cnt, name=self.name,
+                 capacity=self.capacity, full_row=self.full_row)
+        d.update(kw)
+        return DeviceTable(**d)
+
+
+def _sanitize(slots: jax.Array, capacity: int,
+              mask: jax.Array | None = None) -> jax.Array:
+    slots = slots.astype(jnp.int32)
+    bad = (slots < 0) | (slots > capacity)
+    if mask is not None:
+        bad = bad | ~mask.astype(bool)
+    return jnp.where(bad, jnp.int32(capacity), slots)
+
+
+jax.tree_util.register_dataclass(
+    DeviceTable,
+    data_fields=["columns", "row_cnt"],
+    meta_fields=["name", "capacity", "full_row"],
+)

@@ -28,8 +28,10 @@ def test_config_from_args_roundtrip():
 def test_config_rejects_unknown_and_bad():
     with pytest.raises(ValueError):
         Config.from_args(["--nonsense=1"])
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         Config(epoch_batch=1000).validate()  # not a power of two
+    with pytest.raises(ValueError):
+        Config().validate().replace(epoch_batch=1000)  # replace re-validates
 
 
 def test_stats_arr_percentiles():
