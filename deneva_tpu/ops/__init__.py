@@ -1,0 +1,19 @@
+"""TPU kernel substrate.
+
+Vectorized primitives beneath the CC layer: key hashing, device-side
+workload sampling, duplicate-scatter resolution, and the conflict-matrix /
+serialization-sweep kernels that replace the reference's per-row latched
+managers (`concurrency_control/*`, dispatched from `storage/row.cpp:197-310`).
+"""
+
+from deneva_tpu.ops.hashing import bucket_hash, combine_key  # noqa: F401
+from deneva_tpu.ops.sampling import Zipfian, uniform_keys  # noqa: F401
+from deneva_tpu.ops.scatter import last_writer  # noqa: F401
+from deneva_tpu.ops.conflict import (  # noqa: F401
+    access_incidence,
+    overlap,
+    earlier_edges,
+    greedy_first_fit,
+    wavefront_levels,
+    precedence_levels,
+)

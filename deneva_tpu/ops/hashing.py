@@ -1,0 +1,53 @@
+"""Key hashing (reference `storage/index_hash.cpp:56-67`, `system/global.h:294`).
+
+The reference hashes keys once, to pick an index bucket or a home node.
+Here keys are hashed into the *conflict bucket space*: the padded RW-sets of
+a whole epoch are mapped to ``[0, n_buckets)`` and compared via incidence
+matrix products (see `deneva_tpu.ops.conflict`).  Bucket collisions can only
+*over*-report conflicts — a false conflict aborts/defers a transaction that
+was actually safe, which is always serializable — so hashing cost trades
+against spurious-abort rate, never against correctness.
+
+Two independent hash families are provided; ANDing their conflict matrices
+(``Config.conflict_exact``) makes a false conflict require a simultaneous
+collision in both families (probability ~1/K² per pair instead of ~1/K).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Distinct odd multipliers per family (Knuth multiplicative hashing).
+_MULTS = (2654435761, 2246822519, 3266489917, 668265263)
+
+
+def combine_key(table_id: jax.Array | int, key: jax.Array) -> jax.Array:
+    """Fold (table, key) into one 32-bit identity.
+
+    The reference namespaces keys per index structure; conflict detection
+    here is global, so two tables' keyspaces must not alias.  Tables are
+    few (<=9 for TPCC), so table_id rides in high-entropy mixed form.
+    """
+    k = key.astype(jnp.uint32)
+    t = jnp.asarray(table_id, jnp.uint32) * jnp.uint32(0x9E3779B9)
+    return (k * jnp.uint32(_MULTS[0])) ^ t
+
+
+def bucket_hash(ident: jax.Array, n_buckets: int, family: int = 0) -> jax.Array:
+    """Map combined identities to bucket ids in [0, n_buckets).
+
+    n_buckets must be a power of two.  ``family`` selects an independent
+    hash (0/1 used by the dual-hash exact mode).  The murmur3 fmix32
+    finalizer gives full avalanche, so the two families behave as
+    independent random functions — a pair of distinct keys colliding in
+    both is ~K^-2.
+    """
+    assert n_buckets & (n_buckets - 1) == 0, "n_buckets must be a power of two"
+    h = ident.astype(jnp.uint32) ^ jnp.uint32(_MULTS[family % len(_MULTS)])
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return (h & jnp.uint32(n_buckets - 1)).astype(jnp.int32)

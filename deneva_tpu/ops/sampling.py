@@ -1,0 +1,71 @@
+"""Device-side workload sampling (reference `benchmarks/ycsb_query.cpp:181-202`).
+
+The reference's client pre-generates queries host-side with Gray's zipfian
+method (``zeta``/``zipf``).  Here query generation happens *on device inside
+the jitted epoch step* — a fresh batch of zipfian keys per epoch costs a few
+microseconds of VPU time and zero host↔device traffic, replacing the
+reference's pre-generated per-server query arrays
+(`client/client_query.cpp:112-121`).
+
+The zipfian quantile function is Gray et al.'s closed form; the two zeta
+constants are host-precomputed once per (n, theta) and baked into the jitted
+step as scalars, exactly like the reference computes ``zeta_2_theta`` and
+``denom`` at generator init (`ycsb_query.cpp:70-76`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@lru_cache(maxsize=64)
+def _zeta(n: int, theta: float) -> float:
+    """sum_{i=1..n} 1/i^theta  (reference `ycsb_query.cpp:181-188`).
+
+    Vectorized host-side; n is table size (16M at paper scale) so this is a
+    single numpy pass, cached per config.
+    """
+    if theta == 0.0:
+        return float(n)
+    i = np.arange(1, n + 1, dtype=np.float64)
+    return float(np.sum(1.0 / np.power(i, theta)))
+
+
+@dataclass(frozen=True)
+class Zipfian:
+    """Zipfian sampler over ``[0, n)`` with skew ``theta``.
+
+    theta=0 degenerates to uniform (the reference special-cases this the
+    same way through the formula).
+    """
+
+    n: int
+    theta: float
+
+    def __post_init__(self):
+        object.__setattr__(self, "_zeta_n", _zeta(self.n, self.theta))
+        object.__setattr__(self, "_zeta_2", _zeta(2, self.theta))
+
+    def sample(self, key: jax.Array, shape: tuple) -> jax.Array:
+        """Zipfian variates, int32 in [0, n).  (`ycsb_query.cpp:190-202`)."""
+        u = jax.random.uniform(key, shape, jnp.float32)
+        if self.theta == 0.0:
+            return jnp.minimum((u * self.n).astype(jnp.int32), self.n - 1)
+        zetan = self._zeta_n
+        alpha = 1.0 / (1.0 - self.theta)
+        eta = (1.0 - (2.0 / self.n) ** (1.0 - self.theta)) / (
+            1.0 - self._zeta_2 / zetan)
+        uz = u * zetan
+        spread = (self.n * jnp.power(eta * u - eta + 1.0, alpha)).astype(jnp.int32)
+        v = jnp.where(uz < 1.0, 0, jnp.where(uz < 1.0 + 0.5 ** self.theta, 1, spread))
+        return jnp.clip(v, 0, self.n - 1)
+
+
+def uniform_keys(key: jax.Array, shape: tuple, n: int) -> jax.Array:
+    """Uniform int32 keys in [0, n)."""
+    return jax.random.randint(key, shape, 0, n, dtype=jnp.int32)

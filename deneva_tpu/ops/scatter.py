@@ -1,0 +1,41 @@
+"""Duplicate-scatter resolution.
+
+When several committed transactions in one epoch write the same slot
+(allowed under the ts-ordered algorithms — T/O's Thomas-rule writes, MVCC,
+MAAT, Calvin), the batch must apply exactly the write of the *latest*
+transaction in serialization order.  The reference gets this for free by
+executing serially under latches (`storage/row.cpp:351-420`); here it is a
+scatter-max tournament.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def last_writer(slots: jax.Array, order: jax.Array, mask: jax.Array,
+                capacity: int) -> jax.Array:
+    """Boolean mask selecting, per duplicated slot, the single entry with the
+    highest ``order`` (ties broken by position).
+
+    slots: int32[N] target slots in [0, capacity] (capacity = trash slot).
+    order: serialization order (commit timestamp / sequence rank), any
+        integer dtype; only comparisons are used.
+    mask: bool[N]; masked-out entries never win.
+
+    Entries aimed at the trash slot still "win" their tournament among
+    themselves but write only to the trash row, so callers need no special
+    casing.
+    """
+    n = slots.shape[0]
+    slots = jnp.where(mask, slots, capacity).astype(jnp.int32)
+    neg = jnp.iinfo(order.dtype).min
+    eff = jnp.where(mask, order, neg)
+    best = jnp.full((capacity + 1,), neg, order.dtype).at[slots].max(eff)
+    is_best = mask & (eff == jnp.take(best, slots))
+    # tie-break: highest lane index among the best
+    lane = jnp.arange(n, dtype=jnp.int32)
+    eff_lane = jnp.where(is_best, lane, jnp.int32(-1))
+    best_lane = jnp.full((capacity + 1,), -1, jnp.int32).at[slots].max(eff_lane)
+    return is_best & (eff_lane == jnp.take(best_lane, slots))
