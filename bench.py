@@ -1,0 +1,95 @@
+"""Headline benchmark: YCSB zipf-0.9 write-heavy committed txns/sec.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+* value        — committed txns/sec of the TPU_BATCH backend (the MXU
+                 conflict-matrix + deterministic chained-execution engine)
+                 on YCSB theta=0.9, 50% writes, 10 req/txn
+                 (BASELINE.md config #2).
+* vs_baseline  — ratio against the OCC backend measured the same way on
+                 the same hardware: the in-framework stand-in for the
+                 reference's native OCC (the reference publishes no
+                 numbers and its nanomsg/jemalloc build is not available
+                 in this image; see BASELINE.md).
+
+The measurement runs in a child process with a watchdog: this box's TPU
+tunnel is single-client and can wedge (see tests/conftest.py); on timeout
+the bench retries on CPU so the driver always gets a line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+MEASURE_SECS = 5.0
+WARMUP_SECS = 1.5
+TIMEOUT = 1500
+
+
+def child(platform: str) -> None:
+    import jax
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from deneva_tpu.config import Config
+    from deneva_tpu.engine.driver import run_simulation
+
+    scale = 1 if platform == "tpu" else 8  # CPU fallback: smaller, same shape
+    base = dict(
+        workload="YCSB", zipf_theta=0.9, read_perc=0.5, write_perc=0.5,
+        req_per_query=10, max_accesses=16,
+        synth_table_size=(1 << 23) // scale,
+        epoch_batch=2048 // scale, conflict_buckets=8192 // scale,
+        max_txn_in_flight=100_000 // scale,
+        warmup_secs=WARMUP_SECS, done_secs=MEASURE_SECS)
+
+    def tput(alg):
+        cfg = Config.from_args([f"--{k}={v}" for k, v in base.items()]
+                               + [f"--cc_alg={alg}"])
+        st = run_simulation(cfg, quiet=True)
+        f = st.summary_fields()
+        return f["tput"], f
+
+    occ_tput, _ = tput("OCC")
+    tpu_tput, _ = tput("TPU_BATCH")
+    print(json.dumps({
+        "metric": "ycsb_zipf0.9_committed_txns_per_sec",
+        "value": round(tpu_tput, 1),
+        "unit": "txn/s" if platform == "tpu" else "txn/s (cpu-fallback)",
+        "vs_baseline": round(tpu_tput / max(occ_tput, 1e-9), 3),
+    }), flush=True)
+
+
+def main() -> None:
+    for platform in ("tpu", "cpu"):
+        env = dict(os.environ)
+        if platform == "cpu":
+            env["PYTHONPATH"] = ""          # skip axon sitecustomize
+            env["JAX_PLATFORMS"] = "cpu"
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child", platform],
+                capture_output=True, text=True, timeout=TIMEOUT, env=env)
+        except subprocess.TimeoutExpired:
+            print(f"bench: {platform} run timed out, falling back",
+                  file=sys.stderr)
+            continue
+        lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+        if out.returncode == 0 and lines:
+            print(lines[-1])
+            return
+        print(f"bench: {platform} run failed:\n{out.stderr[-2000:]}",
+              file=sys.stderr)
+    print(json.dumps({"metric": "ycsb_zipf0.9_committed_txns_per_sec",
+                      "value": 0.0, "unit": "txn/s",
+                      "vs_baseline": 0.0}))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        child(sys.argv[2])
+    else:
+        main()

@@ -1,0 +1,59 @@
+"""Concurrency control as batched epoch validation (SURVEY §2.3).
+
+One registry entry per reference algorithm (`config.h:101`, README:24-35),
+each a pure ``validate(cfg, state, batch, incidence)`` function — runtime
+dispatch replacing the reference's compile-time ``#if CC_ALG`` forest.
+
+``CCBackend`` bundles the algorithm with its cross-epoch state handling
+and declares whether the engine must run chained sub-rounds
+(``n_levels > 1``: Calvin/TPU_BATCH) and whether incidence matrices are
+needed at all (NOCC skips them).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from deneva_tpu.config import CCAlg, Config
+from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, build_incidence  # noqa: F401
+from deneva_tpu.cc.calvin import validate_calvin, validate_tpu_batch
+from deneva_tpu.cc.maat import validate_maat
+from deneva_tpu.cc.nocc import validate_nocc
+from deneva_tpu.cc.occ import validate_occ
+from deneva_tpu.cc.timestamp import init_to_state, validate_mvcc, validate_timestamp
+from deneva_tpu.cc.twopl import validate_no_wait, validate_wait_die
+
+
+@dataclass(frozen=True)
+class CCBackend:
+    alg: CCAlg
+    validate: Callable[..., tuple[Verdict, Any]]
+    init_state: Callable[[Config], Any]
+    needs_incidence: bool = True
+    chained: bool = False      # engine executes commit levels as sub-rounds
+    fresh_ts_on_restart: bool = True   # WAIT_DIE keeps its birth ts
+
+
+_NO_STATE = lambda cfg: ()  # noqa: E731
+
+_REGISTRY: dict[CCAlg, CCBackend] = {
+    CCAlg.NOCC: CCBackend(CCAlg.NOCC, validate_nocc, _NO_STATE,
+                          needs_incidence=False),
+    CCAlg.NO_WAIT: CCBackend(CCAlg.NO_WAIT, validate_no_wait, _NO_STATE),
+    CCAlg.WAIT_DIE: CCBackend(CCAlg.WAIT_DIE, validate_wait_die, _NO_STATE,
+                              fresh_ts_on_restart=False),
+    CCAlg.OCC: CCBackend(CCAlg.OCC, validate_occ, _NO_STATE),
+    CCAlg.TIMESTAMP: CCBackend(CCAlg.TIMESTAMP, validate_timestamp,
+                               init_to_state),
+    CCAlg.MVCC: CCBackend(CCAlg.MVCC, validate_mvcc, init_to_state),
+    CCAlg.MAAT: CCBackend(CCAlg.MAAT, validate_maat, _NO_STATE),
+    CCAlg.CALVIN: CCBackend(CCAlg.CALVIN, validate_calvin, _NO_STATE,
+                            chained=True),
+    CCAlg.TPU_BATCH: CCBackend(CCAlg.TPU_BATCH, validate_tpu_batch, _NO_STATE,
+                               chained=True),
+}
+
+
+def get_backend(alg: CCAlg | str) -> CCBackend:
+    return _REGISTRY[CCAlg(alg)]

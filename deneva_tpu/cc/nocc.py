@@ -1,0 +1,21 @@
+"""NOCC oracle mode (reference ``NOCC_MODE``, `storage/row.cpp:199-202`).
+
+Every active txn commits unconditionally; no conflict matrices are built.
+The reference uses this to isolate CC cost from the rest of the stack
+(SURVEY §4.2); the engine's NOCC throughput bounds what any backend can
+reach.  Committed duplicate writes still resolve last-writer by rank so
+results are at least deterministic (the reference's NOCC mode races).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessBatch, Verdict
+
+
+def validate_nocc(cfg, state, batch: AccessBatch, inc=None):
+    z = jnp.zeros_like(batch.active)
+    v = Verdict(commit=batch.active, abort=z, defer=z,
+                order=batch.rank, level=jnp.zeros_like(batch.rank))
+    return v, state

@@ -1,0 +1,39 @@
+"""OCC — Kung-Robinson backward validation (reference `concurrency_control/occ.{h,cpp}`).
+
+The reference copies rows on access (`storage/row.cpp:283-290`) and runs
+*central* validation under a global semaphore: a committing txn's read set
+is checked against the write sets of txns that committed during its
+execution window, and against concurrently-validating writers
+(`occ.cpp:116-239`); committed write sets are appended to a history list
+(`central_finish` `:248-294`).
+
+Batch semantics collapse the execution window to the epoch: every txn read
+the epoch-start snapshot, so validation against *prior* epochs passes
+vacuously (their writes were all applied before the snapshot — the
+reference prunes its history list with ``his_oldest_active_tn`` the same
+way).  Within the epoch, serial validation in rank order admits txn i iff
+no already-admitted j has ``W_j ∩ (R_i ∪ W_i) ≠ ∅`` — the Kung-Robinson
+serial-equivalence test with j's writes "after" i's snapshot reads.  That
+is the lex-first MIS sweep over the *directed* U-vs-W overlap.
+
+Like the reference's central validation, the whole epoch validates in one
+place — except "one place" is the MXU, and the critical section is a
+matmul instead of a semaphore.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict
+from deneva_tpu.ops import earlier_edges, greedy_first_fit, overlap
+
+
+def validate_occ(cfg, state, batch: AccessBatch, inc: Incidence):
+    # directed: my accesses vs their writes (their reads never invalidate me)
+    uw = overlap(inc.u1, inc.w1, inc.u2, inc.w2)
+    e = earlier_edges(uw, batch.rank, batch.active)
+    win, lose, und = greedy_first_fit(e, batch.active, rounds=cfg.sweep_rounds)
+    v = Verdict(commit=win, abort=lose, defer=und,
+                order=batch.rank, level=jnp.zeros_like(batch.rank))
+    return v, state

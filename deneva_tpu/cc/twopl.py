@@ -1,0 +1,60 @@
+"""2PL variants: NO_WAIT and WAIT_DIE (reference `concurrency_control/row_lock.{h,cpp}`).
+
+The reference keeps a per-row owners/waiters lock table under a pthread
+mutex: NO_WAIT aborts any conflicting requester (`row_lock.cpp:86-90`);
+WAIT_DIE lets a requester *older* than every conflicting owner wait on a
+FIFO list, younger requesters die (`row_lock.cpp:91-151`), and release
+promotes waiters via `txn_table.restart_txn` (`:317-357`).
+
+Batch semantics: lock-acquisition order becomes ``rank`` (pool arrival
+order).  A txn "reaches the lock table first" iff it wins the lex-first
+maximal-independent-set sweep over the RW/WR/WW conflict matrix in rank
+order — exactly the set of txns that would have acquired all their locks
+had the epoch's requests arrived serially in rank order.
+
+* NO_WAIT: sweep losers abort (with the engine's exponential backoff,
+  `system/abort_queue.cpp:26-50`).  Sweep-round-cap leftovers defer —
+  they were never refused a lock, merely unresolved this epoch.
+* WAIT_DIE: a loser conflicting only with *younger* winners (all winner
+  timestamps greater than its own) waits — deferred to the next epoch
+  where its lower rank makes it the presumptive owner; otherwise it dies.
+  Timestamps are assigned at first arrival and preserved across restarts
+  (the reference preserves them the same way, `worker_thread.cpp:492-508`),
+  which is what makes WAIT_DIE starvation-free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict
+from deneva_tpu.ops import earlier_edges, greedy_first_fit, overlap
+
+
+def _conflict_full(inc: Incidence):
+    """Symmetric conflict: pairs sharing a key with >=1 writer (RR excluded)."""
+    uw = overlap(inc.u1, inc.w1, inc.u2, inc.w2)
+    return uw | uw.T
+
+
+def validate_no_wait(cfg, state, batch: AccessBatch, inc: Incidence):
+    c = _conflict_full(inc)
+    e = earlier_edges(c, batch.rank, batch.active)
+    win, lose, und = greedy_first_fit(e, batch.active, rounds=cfg.sweep_rounds)
+    v = Verdict(commit=win, abort=lose, defer=und,
+                order=batch.rank, level=jnp.zeros_like(batch.rank))
+    return v, state
+
+
+def validate_wait_die(cfg, state, batch: AccessBatch, inc: Incidence):
+    c = _conflict_full(inc)
+    e = earlier_edges(c, batch.rank, batch.active)
+    win, lose, und = greedy_first_fit(e, batch.active, rounds=cfg.sweep_rounds)
+    # min timestamp over the winning earlier neighbors that blocked me
+    blockers = e & win[None, :]
+    big = jnp.iinfo(jnp.int32).max
+    min_owner_ts = jnp.where(blockers, batch.ts[None, :], big).min(axis=1)
+    waits = lose & (batch.ts < min_owner_ts)   # older than every owner -> wait
+    v = Verdict(commit=win, abort=lose & ~waits, defer=und | waits,
+                order=batch.rank, level=jnp.zeros_like(batch.rank))
+    return v, state

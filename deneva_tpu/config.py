@@ -147,6 +147,8 @@ class Config:
     conflict_exact: bool = True    # dual-hash AND to squeeze out false conflicts
     max_accesses: int = 16         # padded RW-set width per txn (covers req_per_query)
     defer_rounds_max: int = 8      # WAIT_DIE-style defer budget before forced abort
+    sweep_rounds: int = 24         # serialization-sweep fixpoint iterations (chain depth cap)
+    exec_subrounds: int = 4        # chained-execution levels per epoch (CALVIN/TPU_BATCH)
     mvcc_his_len: int = 4          # in-state version history depth (HIS_RECYCLE_LEN analogue)
     seq_batch_timer_us: float = 5000.0  # Calvin epoch cadence (config.h:348)
 

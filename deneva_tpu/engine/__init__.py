@@ -1,0 +1,13 @@
+"""The epoch engine — L2-L5 of the reference collapsed into a jitted step.
+
+Worker threads + work/abort queues + txn table (`system/worker_thread.cpp`,
+`work_queue.cpp`, `abort_queue.cpp`, `txn_table.cpp`) become a
+device-resident transaction pool plus one compiled epoch step:
+
+    refill -> select -> plan -> validate (CC) -> execute -> update pool
+
+scanned over epochs without host round-trips (`lax.scan`).
+"""
+
+from deneva_tpu.engine.pool import TxnPool, PoolState  # noqa: F401
+from deneva_tpu.engine.step import Engine, EngineState  # noqa: F401

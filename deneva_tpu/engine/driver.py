@@ -1,0 +1,83 @@
+"""Run lifecycle: warmup / measure / summary (reference `system/sim_manager.*`).
+
+The reference runs free threads against wall-clock timers (WARMUP_TIMER /
+DONE_TIMER, `config.h:346-350`; `SimManager::timeout`).  Here the unit of
+progress is a compiled chunk of epochs: the driver scans chunks until the
+wall-clock window closes, then diffs device counters across the measured
+window and emits the reference-compatible ``[summary]`` line
+(`statistics/stats.cpp:1470`; parsed by `scripts/parse_results.py`).
+
+Latency: the engine histograms commit latency in *epochs*; the driver
+scales bucket centers by the measured seconds/epoch to report
+``client_client_latency`` percentiles like `scripts/latency_stats.py:20`.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine.step import Engine, EngineState
+from deneva_tpu.stats import Stats
+from deneva_tpu.workloads import get_workload
+
+
+def _counters(state: EngineState) -> dict:
+    host = jax.device_get(state.stats)
+    return {k: np.asarray(v) for k, v in host.items()}
+
+
+def run_simulation(cfg: Config, chunk: int = 50,
+                   quiet: bool = False) -> Stats:
+    """Warmup for ``warmup_secs``, measure for ``done_secs``; returns Stats."""
+    wl = get_workload(cfg)
+    eng = Engine(cfg, wl)
+    state = eng.init_state()
+
+    # compile once (excluded from both windows, like the reference's setup
+    # barrier, system/thread.cpp:62-84)
+    state = eng.jit_run(state, chunk)
+    jax.block_until_ready(state.stats["total_txn_commit_cnt"])
+
+    def run_window(state, secs):
+        t0 = time.monotonic()
+        epochs = 0
+        while time.monotonic() - t0 < secs:
+            state = eng.jit_run(state, chunk)
+            jax.block_until_ready(state.stats["total_txn_commit_cnt"])
+            epochs += chunk
+        return state, epochs, time.monotonic() - t0
+
+    state, _, _ = run_window(state, cfg.warmup_secs)
+    before = _counters(state)
+    t_start = time.monotonic()
+    state, epochs, elapsed = run_window(state, cfg.done_secs)
+    after = _counters(state)
+
+    st = Stats()
+    st._t_start = t_start
+    st._t_end = t_start + elapsed
+    st.set("total_runtime", elapsed)
+    st.set("epoch_cnt", float(epochs))
+    for k in ("generated_cnt", "admitted_cnt", "total_txn_commit_cnt",
+              "total_txn_abort_cnt", "defer_cnt", "write_cnt"):
+        st.set(k, float(after[k] - before[k]))
+    commits = after["total_txn_commit_cnt"] - before["total_txn_commit_cnt"]
+    aborts = after["total_txn_abort_cnt"] - before["total_txn_abort_cnt"]
+    # unique aborted txns ~= aborts seen once per txn retry chain; the
+    # reference counts first-aborts per txn (stats.h:60-61).  Upper bound
+    # here; exact per-txn tracking lands with the runtime layer.
+    st.set("unique_txn_abort_cnt", float(aborts))
+    sec_per_epoch = elapsed / max(epochs, 1)
+    hist = (after["latency_hist"] - before["latency_hist"]).astype(np.float64)
+    if hist.sum() > 0:
+        centers = (np.arange(len(hist)) + 0.5) * sec_per_epoch
+        samples = np.repeat(centers, np.minimum(hist, 100000).astype(np.int64))
+        st.arr("client_client_latency").extend(samples)
+    st.set("abort_rate", float(aborts) / max(float(commits + aborts), 1.0))
+    if not quiet:
+        print(st.summary_line())
+    return st

@@ -1,0 +1,155 @@
+"""Device-resident transaction pool.
+
+Replaces four reference components at once (SURVEY §2.1):
+
+* `txn_table` — active txns keyed by id (`system/txn_table.cpp:79-134`):
+  here a fixed array of ``capacity = max_txn_in_flight`` slots.
+* `work_queue`/`new_txn_queue` — dequeue-oldest-first scheduling
+  (`system/work_queue.cpp:188-200`): here top-B-by-sequence selection.
+* `abort_queue` — exponential-backoff restarts
+  (`system/abort_queue.cpp:26-50`): here a per-slot ``ready_epoch``
+  computed as ``epoch + min(2^aborts, cap)`` (BACKOFF `config.h:114`).
+* client inflight throttle (`client/client_txn.cpp:25-46`): admission
+  stops when no slot is free; dropped generations are counted like the
+  reference's client-side admission stalls.
+
+The WAIT/restart machinery the survey ranks hardest (§7: txns parked
+mid-state-machine, resumed via `restart_txn`) is simply: deferred txns
+keep their slot and sequence number, so next epoch's selection picks them
+first and the CC sweep sees them as earliest — a parked txn *is* its
+pool slot.
+
+Sequence numbers double as timestamps: ``next_seq`` advances by a static
+amount per epoch, giving globally unique, monotone int32 ts (wraps after
+~2^31 txns — beyond any benchmark window; the reference's 64-bit ts has
+the same finite-horizon caveat at larger scale).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class PoolState:
+    queries: Any            # workload query pytree, leaves [P, ...]
+    ts: jax.Array           # int32[P]
+    seq: jax.Array          # int32[P] arrival sequence (selection priority)
+    abort_cnt: jax.Array    # int32[P]
+    ready_epoch: jax.Array  # int32[P]
+    entry_epoch: jax.Array  # int32[P] (latency measurement)
+    occupied: jax.Array     # bool[P]
+    next_seq: jax.Array     # int32 scalar
+
+
+jax.tree_util.register_dataclass(
+    PoolState,
+    data_fields=["queries", "ts", "seq", "abort_cnt", "ready_epoch",
+                 "entry_epoch", "occupied", "next_seq"],
+    meta_fields=[])
+
+
+class TxnPool:
+    """Static pool logic bound to (capacity P, epoch batch B, gen chunk G)."""
+
+    def __init__(self, capacity: int, batch: int, gen_chunk: int,
+                 backoff: bool, backoff_cap: int = 64):
+        assert capacity >= batch
+        self.p = capacity
+        self.b = batch
+        self.g = gen_chunk
+        self.backoff = backoff
+        self.backoff_cap = backoff_cap
+
+    # ------------------------------------------------------------------
+    def create(self, empty_queries: Any) -> PoolState:
+        p = self.p
+        return PoolState(
+            queries=empty_queries,
+            ts=jnp.zeros((p,), jnp.int32),
+            seq=jnp.zeros((p,), jnp.int32),
+            abort_cnt=jnp.zeros((p,), jnp.int32),
+            ready_epoch=jnp.zeros((p,), jnp.int32),
+            entry_epoch=jnp.zeros((p,), jnp.int32),
+            occupied=jnp.zeros((p,), bool),
+            next_seq=jnp.ones((), jnp.int32))
+
+    # ------------------------------------------------------------------
+    def refill(self, pool: PoolState, new_queries: Any, epoch: jax.Array
+               ) -> tuple[PoolState, jax.Array]:
+        """Admit up to G fresh queries into free slots (client admission,
+        `system/client_thread.cpp:57-104`).  Returns (pool, admitted)."""
+        free = ~pool.occupied
+        pos = jnp.cumsum(free.astype(jnp.int32)) - 1    # rank among free slots
+        take = free & (pos < self.g)
+        src = jnp.clip(pos, 0, self.g - 1)
+
+        def place(old, new):
+            picked = jnp.take(new, src, axis=0)
+            m = take.reshape((-1,) + (1,) * (old.ndim - 1))
+            return jnp.where(m, picked, old)
+
+        queries = jax.tree.map(place, pool.queries, new_queries)
+        newseq = pool.next_seq + pos.astype(jnp.int32)
+        admitted = take.sum(dtype=jnp.int32)
+        return PoolState(
+            queries=queries,
+            ts=jnp.where(take, newseq, pool.ts),
+            seq=jnp.where(take, newseq, pool.seq),
+            abort_cnt=jnp.where(take, 0, pool.abort_cnt),
+            ready_epoch=jnp.where(take, epoch, pool.ready_epoch),
+            entry_epoch=jnp.where(take, epoch, pool.entry_epoch),
+            occupied=pool.occupied | take,
+            # static advance: G admissions + B potential restamps per epoch
+            next_seq=pool.next_seq + jnp.int32(self.g + self.b),
+        ), admitted
+
+    # ------------------------------------------------------------------
+    def select(self, pool: PoolState, epoch: jax.Array
+               ) -> tuple[jax.Array, jax.Array, Any]:
+        """Top-B runnable slots by sequence (oldest-work-first,
+        `system/work_queue.cpp:188-200`).  Returns (slots, active, queries)."""
+        big = jnp.iinfo(jnp.int32).max
+        runnable = pool.occupied & (pool.ready_epoch <= epoch)
+        key = jnp.where(runnable, pool.seq, big)
+        slots = jnp.argsort(key)[: self.b].astype(jnp.int32)
+        active = jnp.take(runnable, slots)
+        queries = jax.tree.map(lambda l: jnp.take(l, slots, axis=0),
+                               pool.queries)
+        return slots, active, queries
+
+    # ------------------------------------------------------------------
+    def update(self, pool: PoolState, slots: jax.Array, active: jax.Array,
+               commit: jax.Array, abort: jax.Array, epoch: jax.Array,
+               fresh_ts_on_restart: bool) -> PoolState:
+        """Apply verdicts: committed slots free; aborted slots back off
+        exponentially; deferred slots stay runnable with their seq."""
+        commit = commit & active
+        abort = abort & active
+        occ_sel = jnp.take(pool.occupied, slots) & ~commit
+        ac_sel = jnp.take(pool.abort_cnt, slots) + abort.astype(jnp.int32)
+        if self.backoff:
+            penalty = jnp.minimum(
+                jnp.left_shift(jnp.int32(1), jnp.clip(ac_sel - 1, 0, 30)),
+                self.backoff_cap)
+        else:
+            penalty = jnp.ones_like(ac_sel)
+        ready_sel = jnp.where(abort, epoch + 1 + penalty,
+                              jnp.take(pool.ready_epoch, slots))
+        ts_sel = jnp.take(pool.ts, slots)
+        if fresh_ts_on_restart:
+            lane = jnp.arange(self.b, dtype=jnp.int32)
+            ts_sel = jnp.where(abort, pool.next_seq - self.b + lane, ts_sel)
+        return PoolState(
+            queries=pool.queries,
+            ts=pool.ts.at[slots].set(ts_sel),
+            seq=pool.seq,
+            abort_cnt=pool.abort_cnt.at[slots].set(ac_sel),
+            ready_epoch=pool.ready_epoch.at[slots].set(ready_sel),
+            entry_epoch=pool.entry_epoch,
+            occupied=pool.occupied.at[slots].set(occ_sel),
+            next_seq=pool.next_seq)

@@ -1,0 +1,167 @@
+"""The jitted epoch step + scan driver (reference call stack §3.B collapsed).
+
+One epoch performs what the reference spreads over client threads, IO
+threads, worker threads, the CC managers and 2PC:
+
+    refill   — admit fresh queries        (client_thread + new_txn_queue)
+    select   — oldest-B runnable txns     (work_queue dequeue loop)
+    plan     — declare padded RW-sets     (ycsb/tpcc/pps txn state machines)
+    validate — CC backend verdict         (concurrency_control/*)
+    execute  — gather/compute/scatter     (row_t reads + return_row commits)
+    update   — free/backoff/park slots    (txn_table + abort_queue)
+
+Everything is one XLA program; `run_epochs` wraps it in `lax.scan` so a
+benchmark window runs thousands of epochs without leaving the device.
+2PC itself has no analogue: epoch-snapshot validation decides all
+participants of a txn at once (the conflict matrix *is* the vote), which
+is precisely why the TPU build can win — prepare/ack round-trips
+(`system/txn.cpp:498-606`) become matmul cycles.
+
+Chained backends (CALVIN/TPU_BATCH) execute ``exec_subrounds`` waves:
+level-l txns read state that already includes writes of levels < l —
+deterministic dataflow equal to serial execution in sequence order.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from deneva_tpu.cc import AccessBatch, build_incidence, get_backend
+from deneva_tpu.config import Config, Mode
+from deneva_tpu.engine.pool import PoolState, TxnPool
+
+LAT_BUCKETS = 64
+
+
+@dataclass
+class EngineState:
+    db: Any                 # dict[str, DeviceTable]
+    cc_state: Any
+    pool: PoolState
+    rng: jax.Array
+    epoch: jax.Array        # int32
+    stats: dict             # str -> device scalar / latency histogram
+
+
+jax.tree_util.register_dataclass(
+    EngineState,
+    data_fields=["db", "cc_state", "pool", "rng", "epoch", "stats"],
+    meta_fields=[])
+
+
+def init_device_stats() -> dict:
+    z = lambda: jnp.zeros((), jnp.uint32)  # noqa: E731
+    return {
+        "generated_cnt": z(), "admitted_cnt": z(),
+        "total_txn_commit_cnt": z(), "total_txn_abort_cnt": z(),
+        "defer_cnt": z(), "write_cnt": z(), "read_checksum": z(),
+        "latency_hist": jnp.zeros((LAT_BUCKETS,), jnp.uint32),
+    }
+
+
+class Engine:
+    """Binds (config, workload, cc backend) into jitted step/scan fns."""
+
+    def __init__(self, cfg: Config, workload):
+        self.cfg = cfg
+        self.workload = workload
+        self.backend = get_backend(cfg.cc_alg)
+        cap = max(cfg.max_txn_in_flight, cfg.epoch_batch)
+        self.pool = TxnPool(capacity=cap, batch=cfg.epoch_batch,
+                            gen_chunk=cfg.epoch_batch,
+                            backoff=cfg.backoff)
+
+    # ------------------------------------------------------------------
+    def init_state(self, seed: int | None = None) -> EngineState:
+        cfg = self.cfg
+        db = self.workload.load()
+        empty_q = self.workload.generate(
+            jax.random.PRNGKey(0), self.pool.p)
+        pool = self.pool.create(jax.tree.map(jnp.zeros_like, empty_q))
+        return EngineState(
+            db=db, cc_state=self.backend.init_state(cfg), pool=pool,
+            rng=jax.random.PRNGKey(cfg.seed if seed is None else seed),
+            epoch=jnp.zeros((), jnp.int32), stats=init_device_stats())
+
+    # ------------------------------------------------------------------
+    def step(self, state: EngineState) -> EngineState:
+        cfg, wl, be = self.cfg, self.workload, self.backend
+        rng, gen_key = jax.random.split(state.rng)
+        stats = dict(state.stats)
+
+        # 1. admit fresh queries
+        newq = wl.generate(gen_key, self.pool.g)
+        pool, admitted = self.pool.refill(state.pool, newq, state.epoch)
+        stats["generated_cnt"] += jnp.uint32(self.pool.g)
+        stats["admitted_cnt"] += admitted.astype(jnp.uint32)
+
+        # 2. select epoch batch
+        slots, active, queries = self.pool.select(pool, state.epoch)
+
+        # 3. plan RW-sets
+        planned = wl.plan(state.db, queries)
+        batch = AccessBatch(
+            table_ids=planned["table_ids"], keys=planned["keys"],
+            is_read=planned["is_read"], is_write=planned["is_write"],
+            valid=planned["valid"],
+            ts=jnp.take(pool.ts, slots), rank=jnp.take(pool.seq, slots),
+            active=active)
+
+        # 4. validate
+        if cfg.mode == Mode.NOCC:
+            nocc = get_backend("NOCC")
+            verdict, cc_state = nocc.validate(cfg, state.cc_state, batch, None)
+        else:
+            inc = build_incidence(batch, cfg.conflict_buckets,
+                                  cfg.conflict_exact) if be.needs_incidence else None
+            verdict, cc_state = be.validate(cfg, state.cc_state, batch, inc)
+
+        # 5. execute committed txns
+        db = state.db
+        if cfg.mode in (Mode.NORMAL, Mode.NOCC):
+            if be.chained and cfg.mode == Mode.NORMAL:
+                for lvl in range(cfg.exec_subrounds):
+                    m = verdict.commit & (verdict.level == lvl)
+                    db = wl.execute(db, queries, m, verdict.order, stats)
+            else:
+                db = wl.execute(db, queries, verdict.commit, verdict.order,
+                                stats)
+        # Mode.SIMPLE / QRY_ONLY: ack without touching tables
+        # (reference SIMPLE_MODE / QRY_ONLY_MODE, config.h:276-281)
+
+        # 6. update pool + counters
+        pool = self.pool.update(pool, slots, active, verdict.commit,
+                                verdict.abort, state.epoch,
+                                be.fresh_ts_on_restart)
+        ncommit = (verdict.commit & active).sum(dtype=jnp.uint32)
+        stats["total_txn_commit_cnt"] += ncommit
+        stats["total_txn_abort_cnt"] += (verdict.abort & active).sum(dtype=jnp.uint32)
+        stats["defer_cnt"] += (verdict.defer & active).sum(dtype=jnp.uint32)
+        lat = state.epoch - jnp.take(pool.entry_epoch, slots)
+        lat = jnp.clip(lat, 0, LAT_BUCKETS - 1)
+        hist = stats["latency_hist"].at[lat].add(
+            (verdict.commit & active).astype(jnp.uint32))
+        stats["latency_hist"] = hist
+
+        return EngineState(db=db, cc_state=cc_state, pool=pool, rng=rng,
+                           epoch=state.epoch + 1, stats=stats)
+
+    # ------------------------------------------------------------------
+    @functools.cached_property
+    def jit_step(self):
+        return jax.jit(self.step, donate_argnums=0)
+
+    @functools.cached_property
+    def jit_run(self):
+        """scan ``n`` epochs on device; n is static per compile."""
+
+        @functools.partial(jax.jit, static_argnums=1, donate_argnums=0)
+        def run(state: EngineState, n: int) -> EngineState:
+            return jax.lax.scan(lambda s, _: (self.step(s), None), state,
+                                None, length=n)[0]
+        return run

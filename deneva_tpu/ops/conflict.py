@@ -138,15 +138,43 @@ def wavefront_levels(edges: jax.Array, max_level: int
     return jnp.minimum(lv, max_level), lv > max_level
 
 
+def weighted_levels(prec: jax.Array, strict: jax.Array, active: jax.Array,
+                    rounds: int) -> tuple[jax.Array, jax.Array]:
+    """Max-plus longest-path levels with {0,1} edge weights.
+
+    prec: bool[B, B] acyclic must-precede digraph (P[i, j] = i before j);
+    strict: bool[B, B] subset of prec whose edges cost +1 level (the
+    read-after-write visibility constraints); 0-weight edges only order
+    within a level.  ``rounds`` relaxation sweeps compute exact levels for
+    every node whose longest incoming *path* (in edges, any weight) is
+    < rounds.  Soundness contract: callers must only trust levels of txns
+    whose unweighted `precedence_levels` depth is below ``rounds`` (its
+    ``unstable`` mask enforces exactly that) — an under-relaxed level
+    could seat a reader beside an unseen writer.
+    """
+    p = prec & active[:, None] & active[None, :]
+    w = jnp.where(p & strict, 1, 0)
+    lv = jnp.zeros(active.shape, jnp.int32)
+
+    def body(_, lv):
+        cand = jnp.where(p, lv[:, None] + w, -1)
+        return jnp.maximum(lv, cand.max(axis=0))
+
+    return jax.lax.fori_loop(0, rounds, body, lv)
+
+
 def precedence_levels(prec: jax.Array, active: jax.Array, rounds: int
                       ) -> tuple[jax.Array, jax.Array]:
     """Longest-path levels of a *possibly cyclic* must-precede digraph.
 
     prec: bool[B, B], P[i, j] = "i must serialize before j".
-    Iterates ``l_j = 1 + max_{i: P[i,j]} l_i`` ``rounds`` times; any node
-    whose level still changes on the last round is in (or downstream of) a
-    cycle and is flagged unstable — MAAT aborts those (over-approximation,
-    so cycles can never slip through).
+    Iterates ``l_j = 1 + max_{i: P[i,j]} l_i`` ``rounds`` times.  A node is
+    flagged unstable if its level still changes on a probe round OR its
+    level reached ``rounds`` — after r all-weight-1 sweeps a node's level
+    is min(true longest-path depth, r), so ``lv >= rounds`` exactly marks
+    "depth not resolved within budget", which covers cycle members, their
+    downstream, and over-deep DAG chains; nodes below the bound have exact
+    depths.  Over-approximation: flagged txns abort/defer, never commit.
     """
     p = prec & active[:, None] & active[None, :]
     lv = jnp.zeros(active.shape, jnp.int32)
@@ -157,5 +185,5 @@ def precedence_levels(prec: jax.Array, active: jax.Array, rounds: int
 
     lv = jax.lax.fori_loop(0, rounds, body, lv)
     lv2 = body(0, lv)
-    unstable = (lv2 != lv) & active
+    unstable = ((lv2 != lv) | (lv >= rounds)) & active
     return lv, unstable

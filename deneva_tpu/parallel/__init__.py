@@ -1,0 +1,24 @@
+"""Device-mesh partition parallelism (SURVEY §2.10 → TPU mapping).
+
+The reference's first-class distribution axis is hash partitioning of the
+keyspace across server nodes (`system/global.h:294-306`), coordinated by
+2PC messages over nanomsg.  Here the same axis maps onto a
+`jax.sharding.Mesh`:
+
+* **table rows** and the T/O watermark tables shard over the ``part``
+  axis (each device owns a keyspace slice — the "node"),
+* **conflict-bucket incidence** shards over its bucket dimension, so the
+  conflict matmul contracts over a sharded dimension and XLA inserts the
+  cross-partition reduction (the 2PC vote collapsed into a psum over
+  ICI),
+* the transaction batch and pool stay replicated (every "node" sees the
+  epoch's full txn set, as Calvin's sequencer broadcast does).
+
+Multi-host distribution (separate processes, message passing) lives in
+`deneva_tpu.runtime`; this package is the single-process multi-chip path.
+"""
+
+from deneva_tpu.parallel.mesh import (  # noqa: F401
+    AXIS, make_mesh, use_mesh, shard_buckets, state_shardings,
+    make_sharded_run,
+)

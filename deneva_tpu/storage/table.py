@@ -66,10 +66,14 @@ class DeviceTable:
     @classmethod
     def create(cls, schema: TableSchema, capacity: int,
                full_row: bool = False) -> "DeviceTable":
+        # rows are padded to a multiple of 64 past the trash slot so the
+        # row dimension shards evenly over any mesh up to 64 devices
+        # (jax NamedSharding requires divisibility); pad rows are inert.
+        nrows = -(-(capacity + 1) // 64) * 64
         cols = {}
         for c in schema.columns:
             dtype, extra = _col_spec(c.ctype, c.size, full_row)
-            cols[c.name] = jnp.zeros((capacity + 1, *extra), dtype=dtype)
+            cols[c.name] = jnp.zeros((nrows, *extra), dtype=dtype)
         return cls(columns=cols, row_cnt=jnp.zeros((), jnp.int32),
                    name=schema.name, capacity=capacity, full_row=full_row)
 

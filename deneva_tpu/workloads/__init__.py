@@ -1,0 +1,23 @@
+"""Benchmarks (reference `benchmarks/`, SURVEY §2.5): YCSB, TPCC, PPS.
+
+A workload owns its schema/loader (L8), its device-side query generator
+(the reference's client-side `*QueryGenerator`), the *plan* that turns a
+query batch into padded RW-sets for CC validation, and the *execute* step
+that applies committed transactions to the device tables.
+"""
+
+from deneva_tpu.workloads.base import Workload, DB  # noqa: F401
+from deneva_tpu.workloads.ycsb import YCSBWorkload  # noqa: F401
+
+
+def get_workload(cfg):
+    from deneva_tpu.config import WorkloadKind
+    if cfg.workload == WorkloadKind.YCSB:
+        return YCSBWorkload(cfg)
+    if cfg.workload == WorkloadKind.TPCC:
+        from deneva_tpu.workloads.tpcc import TPCCWorkload
+        return TPCCWorkload(cfg)
+    if cfg.workload == WorkloadKind.PPS:
+        from deneva_tpu.workloads.pps import PPSWorkload
+        return PPSWorkload(cfg)
+    raise ValueError(f"no workload for {cfg.workload}")

@@ -1,0 +1,53 @@
+"""Workload interface (reference `system/wl.{h,cpp}`, `benchmarks/*_wl.*`).
+
+The reference couples workloads to threads: `Workload::get_txn_man` hands a
+per-thread txn-manager subclass whose ``run_txn`` advances a request-at-a-
+time state machine (`benchmarks/ycsb_txn.cpp:91-209`).  Here a workload is
+four pure functions over whole epochs:
+
+* ``load()``      — build device tables (the parallel loaders,
+                    `benchmarks/ycsb_wl.cpp:125-203`, become host numpy
+                    passes + one device_put).
+* ``generate()``  — a fresh batch of queries on device (the client query
+                    generators, `benchmarks/*_query.cpp`).
+* ``plan()``      — queries -> padded RW-set arrays (keys/tables/modes):
+                    what the reference discovers incrementally through its
+                    state machines is declared up front so the whole epoch
+                    can be validated at once.  Workloads whose keys depend
+                    on reads (PPS recon) resolve them here with gathers
+                    against the current snapshot.
+* ``execute()``   — apply committed txns: gather reads, compute, scatter
+                    writes (with last-writer resolution), append inserts.
+                    Called once per chained sub-round for deterministic
+                    backends.
+
+``DB`` is the carried table state; indexes with static contents live on
+the workload object itself (device arrays inside them still ride along as
+jit constants).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol
+
+import jax
+
+DB = dict  # table name -> DeviceTable; a pytree
+
+
+class Workload(Protocol):
+    def load(self) -> DB: ...
+
+    def generate(self, rng: jax.Array, n: int) -> Any:
+        """Return a query pytree with leading dim n."""
+        ...
+
+    def plan(self, db: DB, queries: Any) -> dict:
+        """Return dict(table_ids, keys, is_read, is_write, valid) [n, A]."""
+        ...
+
+    def execute(self, db: DB, queries: Any, mask: jax.Array,
+                order: jax.Array, stats: dict) -> DB:
+        """Apply txns selected by ``mask`` to ``db``; update device stats
+        dict in place (read checksums keep gathers alive under XLA)."""
+        ...

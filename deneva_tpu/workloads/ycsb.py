@@ -1,0 +1,127 @@
+"""YCSB (reference `benchmarks/ycsb_wl.cpp`, `ycsb_query.cpp`, `ycsb_txn.cpp`).
+
+One table of ``synth_table_size`` rows with 10 string fields
+(`benchmarks/YCSB_schema.txt`); queries are ``req_per_query`` accesses with
+zipfian keys and a per-request write probability
+(`ycsb_query.cpp:303-376`).  A request reads field F0 or blindly
+overwrites it (`ycsb_txn.cpp:177-209` does `get_value/set_value` on one
+field per request).
+
+TPU shape: the table is a `DeviceTable` (SoA, fingerprint strings), the
+primary index is the identity `DenseIndex` (YCSB keys are dense,
+`ycsb_wl.cpp:70-74`), queries are generated on device per epoch, and
+execute is one gather (reads, checksummed into stats so XLA cannot
+dead-code them) plus one last-writer scatter (writes).
+
+Multi-partition control (`FIRST_PART_LOCAL`, `PART_PER_TXN`, MPR
+`ycsb_query.cpp:303-376`) maps to the mesh build: keys are striped
+``slot % n_parts`` across devices, so a zipfian batch is naturally
+multi-partition; `deneva_tpu.parallel` documents the layout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.ops import Zipfian, last_writer
+from deneva_tpu.storage.catalog import parse_schema
+from deneva_tpu.storage.index import DenseIndex
+from deneva_tpu.storage.table import DeviceTable
+
+# benchmarks/YCSB_schema.txt: MAIN_TABLE, 10 x 100-byte string fields
+YCSB_SCHEMA = "TABLE=MAIN_TABLE\n" + "".join(
+    f"\t100,string,F{i}\n" for i in range(10)) + "INDEX=MAIN_INDEX\n\tMAIN_TABLE,0\n"
+
+TABLE = "MAIN_TABLE"
+TABLE_ID = 0
+
+
+@dataclass
+class YCSBQuery:
+    """One epoch's queries; pytree with leading dim n."""
+
+    keys: jax.Array      # int32[n, R]
+    is_write: jax.Array  # bool[n, R]
+
+
+jax.tree_util.register_dataclass(YCSBQuery, data_fields=["keys", "is_write"],
+                                 meta_fields=[])
+
+
+def _field_fingerprint(key: jax.Array | np.ndarray, version):
+    """Deterministic field value = f(key, version): lets consistency tests
+    recompute expected content without storing 100-byte payloads."""
+    k = jnp.asarray(key).astype(jnp.uint32)
+    v = jnp.asarray(version).astype(jnp.uint32)
+    return (k * jnp.uint32(2654435761)) ^ (v * jnp.uint32(0x9E3779B9)) | jnp.uint32(1)
+
+
+class YCSBWorkload:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.catalog = parse_schema(YCSB_SCHEMA)
+        self.n_rows = cfg.synth_table_size
+        self.index = DenseIndex(base=0, stride=1, size=self.n_rows,
+                                miss_slot=self.n_rows)
+        self.zipf = Zipfian(self.n_rows, cfg.zipf_theta)
+        self.n_req = cfg.req_per_query
+
+    # -- loader (ycsb_wl.cpp:125-203) ----------------------------------
+    def load(self):
+        tab = DeviceTable.create(self.catalog.table(TABLE), self.n_rows,
+                                 full_row=False)
+        keys = np.arange(self.n_rows, dtype=np.int32)
+        cols = {"F0": np.asarray(_field_fingerprint(keys, 0))}
+        # remaining fields share the same fingerprint law; only F0 is
+        # touched by queries (ycsb_txn.cpp reads/writes one field)
+        for name, v in cols.items():
+            tab.columns[name] = tab.columns[name].at[:self.n_rows].set(
+                jnp.asarray(v))
+        return {TABLE: tab}
+
+    # -- query generation (ycsb_query.cpp:303-376) ---------------------
+    def generate(self, rng: jax.Array, n: int) -> YCSBQuery:
+        k1, k2 = jax.random.split(rng)
+        keys = self.zipf.sample(k1, (n, self.n_req))
+        is_write = jax.random.bernoulli(k2, self.cfg.write_perc,
+                                        (n, self.n_req))
+        return YCSBQuery(keys=keys, is_write=is_write)
+
+    # -- RW-set planning ------------------------------------------------
+    def plan(self, db, q: YCSBQuery) -> dict:
+        shape = q.keys.shape
+        return dict(
+            table_ids=jnp.full(shape, TABLE_ID, jnp.int32),
+            keys=q.keys,
+            is_read=~q.is_write,
+            is_write=q.is_write,
+            valid=jnp.ones(shape, bool),
+        )
+
+    # -- execution (ycsb_txn.cpp:177-209 collapsed to one batch) -------
+    def execute(self, db, q: YCSBQuery, mask: jax.Array, order: jax.Array,
+                stats: dict):
+        tab: DeviceTable = db[TABLE]
+        slots = self.index.lookup(q.keys)                      # [n, R]
+        act = mask[:, None] & jnp.ones_like(q.is_write)
+        # reads: gather F0, fold into checksum (keeps the load alive)
+        rmask = act & ~q.is_write
+        vals = jnp.take(tab.columns["F0"], jnp.where(rmask, slots, tab.capacity),
+                        axis=0)
+        stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
+            jnp.where(rmask, vals, 0), dtype=jnp.uint32)
+        # writes: new fingerprint versioned by serialization order
+        wmask = (act & q.is_write).reshape(-1)
+        wslots = jnp.where(act & q.is_write, slots, tab.capacity).reshape(-1)
+        worder = jnp.broadcast_to(order[:, None], slots.shape).reshape(-1)
+        win = last_writer(wslots, worder, wmask, tab.capacity)
+        wvals = _field_fingerprint(q.keys.reshape(-1), worder)
+        db = dict(db)
+        db[TABLE] = tab.scatter(wslots, {"F0": wvals}, mask=win)
+        stats["write_cnt"] = stats["write_cnt"] + wmask.sum(dtype=jnp.uint32)
+        return db

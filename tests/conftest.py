@@ -3,18 +3,26 @@
 Tests run on a virtual 8-device CPU mesh (the analogue of the reference's
 IPC-on-one-box multi-node rig, `scripts/run_experiments.py:67` /
 `transport/transport.cpp:132` — SURVEY §4.4): sharding and collective code
-paths execute for real without TPU hardware.  Env vars must be set before
-the first `import jax` anywhere, hence this module-level block.
+paths execute for real without TPU hardware.
+
+This box's axon sitecustomize force-selects the TPU platform via
+``jax.config.update("jax_platforms", "axon,cpu")`` at interpreter start —
+env vars alone cannot override it, and initializing the axon backend dials
+the (single-client) TPU tunnel, which tests must never do.  So the
+override goes through jax.config, before any backend is initialized.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

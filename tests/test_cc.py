@@ -1,0 +1,281 @@
+"""CC backends: scripted interleavings + serializability oracles.
+
+Each txn script is a list of (key, mode) with mode 'r' | 'w' | 'rw'.
+The oracle checks the *semantic* contract of a Verdict under epoch-snapshot
+execution: committed reads must be correct in the claimed serialization
+order (no committed writer of a key ordered before a committed
+snapshot-reader of it, unless the backend chains levels and the reader's
+level is above the writer's).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from deneva_tpu.config import Config, CCAlg
+from deneva_tpu.cc import AccessBatch, build_incidence, get_backend
+
+
+CFG = Config(epoch_batch=16, conflict_buckets=4096, max_accesses=4,
+             req_per_query=4, synth_table_size=1024)
+
+
+def make_batch(txns, ts=None, rank=None, a=4):
+    # pad every batch to a fixed B so jit compiles once per algorithm
+    b, bp = len(txns), CFG.epoch_batch
+    assert b <= bp
+    keys = np.zeros((bp, a), np.int32)
+    is_r = np.zeros((bp, a), bool)
+    is_w = np.zeros((bp, a), bool)
+    valid = np.zeros((bp, a), bool)
+    for i, script in enumerate(txns):
+        assert len(script) <= a
+        for s, (key, mode) in enumerate(script):
+            keys[i, s] = key
+            valid[i, s] = True
+            is_r[i, s] = "r" in mode
+            is_w[i, s] = "w" in mode
+    ts = np.arange(1, b + 1, dtype=np.int32) if ts is None else np.asarray(ts, np.int32)
+    rank = np.arange(b, dtype=np.int32) if rank is None else np.asarray(rank, np.int32)
+    ts = np.concatenate([ts, np.full(bp - b, ts.max() + 1, np.int32)])
+    rank = np.concatenate([rank, np.arange(bp - b, dtype=np.int32) + rank.max() + 1])
+    active = np.zeros(bp, bool)
+    active[:b] = True
+    return AccessBatch(
+        table_ids=jnp.zeros((bp, a), jnp.int32), keys=jnp.asarray(keys),
+        is_read=jnp.asarray(is_r), is_write=jnp.asarray(is_w),
+        valid=jnp.asarray(valid), ts=jnp.asarray(ts), rank=jnp.asarray(rank),
+        active=jnp.asarray(active))
+
+
+import functools
+import jax
+
+
+@functools.lru_cache(maxsize=32)
+def _jitted_validate(alg, cfg):
+    be = get_backend(alg)
+
+    @jax.jit
+    def go(state, batch):
+        inc = build_incidence(batch, cfg.conflict_buckets, cfg.conflict_exact) \
+            if be.needs_incidence else None
+        return be.validate(cfg, state, batch, inc)
+    return go
+
+
+def run(alg, txns, cfg=CFG, state=None, **kw):
+    be = get_backend(alg)
+    batch = make_batch(txns, **kw)
+    if state is None:
+        state = be.init_state(cfg)
+    verdict, state = _jitted_validate(alg, cfg)(state, batch)
+    return verdict, state, batch
+
+
+def check_verdict(verdict, batch, txns, chained=False):
+    commit = np.asarray(verdict.commit)
+    abort = np.asarray(verdict.abort)
+    defer = np.asarray(verdict.defer)
+    order = np.asarray(verdict.order)
+    level = np.asarray(verdict.level)
+    active = np.asarray(batch.active)
+    # disjoint partition covering active
+    assert not (commit & abort).any() and not (commit & defer).any() \
+        and not (abort & defer).any()
+    assert ((commit | abort | defer) == active).all()
+    # serializability of the committed set
+    reads = [set(k for k, m in s if "r" in m) for s in txns]
+    writes = [set(k for k, m in s if "w" in m) for s in txns]
+    b = len(txns)
+    for i in range(b):
+        for j in range(b):
+            if i == j or not (commit[i] and commit[j]):
+                continue
+            if order[j] < order[i] and (writes[j] & reads[i]):
+                # j's write ordered before i's snapshot read of same key
+                if chained:
+                    assert level[i] > level[j], (i, j)
+                else:
+                    raise AssertionError(f"stale read: writer {j} < reader {i}")
+            if writes[i] & writes[j]:
+                assert order[i] != order[j]
+    return commit[:b], abort[:b], defer[:b]
+
+
+# ---- NO_WAIT -----------------------------------------------------------
+
+def test_no_wait_conflict_aborts_later():
+    v, _, batch = run("NO_WAIT", [[(5, "w")], [(5, "r")], [(7, "r")]])
+    c, a, d = check_verdict(v, batch, [[(5, "w")], [(5, "r")], [(7, "r")]])
+    assert c[0] and a[1] and c[2]
+
+def test_no_wait_read_read_no_conflict():
+    v, _, b = run("NO_WAIT", [[(5, "r")], [(5, "r")]])
+    c, a, d = check_verdict(v, b, [[(5, "r")], [(5, "r")]])
+    assert c.all()
+
+def test_no_wait_rank_decides():
+    v, _, b = run("NO_WAIT", [[(5, "w")], [(5, "w")]], rank=[9, 2])
+    c, a, d = check_verdict(v, b, [[(5, "w")], [(5, "w")]])
+    assert a[0] and c[1]
+
+
+# ---- WAIT_DIE ----------------------------------------------------------
+
+def test_wait_die_older_waits_younger_dies():
+    # txn0 owns (rank 0); txn1 older (smaller ts) -> waits; txn2 younger -> dies
+    txns = [[(5, "w")], [(5, "w")], [(5, "w")]]
+    v, _, b = run("WAIT_DIE", txns, ts=[50, 10, 90], rank=[0, 1, 2])
+    c, a, d = check_verdict(v, b, txns)
+    assert c[0] and d[1] and a[2]
+
+
+# ---- OCC ---------------------------------------------------------------
+
+def test_occ_reader_first_commits_both():
+    txns = [[(5, "r")], [(5, "w")]]
+    v, _, b = run("OCC", txns)
+    c, a, d = check_verdict(v, b, txns)
+    assert c.all()   # reader rank 0, writer rank 1: serial r->w valid
+
+def test_occ_writer_first_aborts_reader():
+    txns = [[(5, "w")], [(5, "r")]]
+    v, _, b = run("OCC", txns)
+    c, a, d = check_verdict(v, b, txns)
+    assert c[0] and a[1]
+
+def test_occ_blind_ww_conflicts():
+    txns = [[(5, "w")], [(5, "w")]]
+    v, _, b = run("OCC", txns)
+    c, a, d = check_verdict(v, b, txns)
+    assert c[0] and a[1]
+
+
+# ---- TIMESTAMP ---------------------------------------------------------
+
+def test_to_reader_after_writer_aborts():
+    txns = [[(5, "w")], [(5, "r")]]
+    v, _, b = run("TIMESTAMP", txns, ts=[1, 2])
+    c, a, d = check_verdict(v, b, txns)
+    assert c[0] and a[1]
+
+def test_to_reader_before_writer_both_commit():
+    txns = [[(5, "r")], [(5, "w")]]
+    v, _, b = run("TIMESTAMP", txns, ts=[1, 2])
+    c, a, d = check_verdict(v, b, txns)
+    assert c.all()
+
+def test_to_blind_ww_thomas_rule():
+    txns = [[(5, "w")], [(5, "w")]]
+    v, _, b = run("TIMESTAMP", txns, ts=[1, 2])
+    c, a, d = check_verdict(v, b, txns)
+    assert c.all()
+    assert np.asarray(v.order)[1] > np.asarray(v.order)[0]
+
+def test_to_watermarks_cross_epoch():
+    be = get_backend("TIMESTAMP")
+    st = be.init_state(CFG)
+    # epoch 1: writer at ts 10 commits
+    v, st, _ = run("TIMESTAMP", [[(5, "w")]], ts=[10], state=st)
+    assert np.asarray(v.commit)[0]
+    # epoch 2: stale reader ts 5 aborts; fresh reader ts 15 commits;
+    # stale writer ts 7 aborts
+    txns = [[(5, "r")], [(5, "r")], [(5, "w")]]
+    v, st, b = run("TIMESTAMP", txns, ts=[5, 15, 7], state=st)
+    c, a, d = check_verdict(v, b, txns)
+    assert a[0] and c[1] and a[2]
+
+
+# ---- MVCC --------------------------------------------------------------
+
+def test_mvcc_readonly_always_commits():
+    be = get_backend("MVCC")
+    st = be.init_state(CFG)
+    v, st, _ = run("MVCC", [[(5, "w")]], ts=[10], state=st)
+    # stale read-only txn commits under MVCC (old version), aborts under T/O
+    v, st, b = run("MVCC", [[(5, "r")]], ts=[5], state=st)
+    assert np.asarray(v.commit)[0]
+
+def test_mvcc_rw_txn_still_validates():
+    be = get_backend("MVCC")
+    st = be.init_state(CFG)
+    v, st, _ = run("MVCC", [[(5, "w")]], ts=[10], state=st)
+    # read-write txn with stale write ts aborts (rts/wts watermark)
+    v, st, b = run("MVCC", [[(5, "rw")]], ts=[7], state=st)
+    assert np.asarray(v.abort)[0]
+
+
+# ---- MAAT --------------------------------------------------------------
+
+def test_maat_reader_writer_any_rank_commit():
+    # writer arrives first by rank; MAAT dynamically orders reader before it
+    txns = [[(5, "w")], [(5, "r")]]
+    v, _, b = run("MAAT", txns)
+    c, a, d = check_verdict(v, b, txns)
+    assert c.all()
+    assert np.asarray(v.order)[1] < np.asarray(v.order)[0]
+
+def test_maat_write_skew_cycle_aborts():
+    txns = [[(1, "r"), (2, "w")], [(2, "r"), (1, "w")]]
+    v, _, b = run("MAAT", txns)
+    c, a, d = check_verdict(v, b, txns)
+    assert a.any() and not c.all()
+
+def test_maat_blind_ww_both_commit():
+    txns = [[(5, "w")], [(5, "w")], [(5, "r")]]
+    v, _, b = run("MAAT", txns)
+    c, a, d = check_verdict(v, b, txns)
+    assert c.all()
+
+
+# ---- CALVIN / TPU_BATCH ------------------------------------------------
+
+@pytest.mark.parametrize("alg", ["CALVIN", "TPU_BATCH"])
+def test_calvin_never_aborts_levels_chain(alg):
+    txns = [[(5, "w")], [(5, "rw")], [(5, "r")], [(9, "r")]]
+    v, _, b = run(alg, txns)
+    c, a, d = check_verdict(v, b, txns, chained=True)
+    assert not a.any()
+    assert c.all()
+    lv = np.asarray(v.level)
+    assert lv[0] == 0 and lv[1] == 1 and lv[2] == 2 and lv[3] == 0
+
+@pytest.mark.parametrize("alg", ["CALVIN", "TPU_BATCH"])
+def test_calvin_deep_chain_defers_deterministically(alg):
+    txns = [[(5, "rw")] for _ in range(10)]   # chain depth 10 > exec_subrounds
+    v, _, b = run(alg, txns)
+    c, a, d = check_verdict(v, b, txns, chained=True)
+    assert not a.any()
+    s = CFG.exec_subrounds
+    assert c[:s].all() and d[s:].all()
+
+
+# ---- NOCC + randomized cross-algorithm oracle --------------------------
+
+def test_nocc_commits_everything():
+    txns = [[(5, "w")], [(5, "w")], [(5, "rw")]]
+    v, _, b = run("NOCC", txns)
+    assert np.asarray(v.commit)[:3].all()
+
+@pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "OCC", "TIMESTAMP",
+                                 "MVCC", "MAAT", "CALVIN", "TPU_BATCH"])
+def test_randomized_serializability(alg):
+    rng = np.random.default_rng(42)
+    be = get_backend(alg)
+    st = be.init_state(CFG)
+    ts_base = 1
+    for trial in range(6):
+        txns = []
+        for _ in range(12):
+            script = []
+            for _ in range(rng.integers(1, 5)):
+                key = int(rng.integers(0, 8))       # tiny keyspace: hot
+                mode = rng.choice(["r", "w", "rw"])
+                script.append((key, mode))
+            txns.append(script)
+        ts = ts_base + rng.permutation(12).astype(np.int32)
+        ts_base += 12
+        v, st, b = run(alg, txns, state=st, ts=ts)
+        check_verdict(v, b, txns, chained=be.chained)
+        assert np.asarray(v.commit).sum() >= 1
