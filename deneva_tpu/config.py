@@ -113,6 +113,10 @@ class Config:
     wh_update: bool = True
     mpr_neworder: float = 0.01     # remote-warehouse item probability
     tpcc_full_schema: bool = False
+    cust_per_dist: int = 3000      # CUST_PER_DIST_NORM (config.h:188)
+    max_items: int = 100000        # MAX_ITEMS_NORM (config.h:187)
+    max_items_per_txn: int = 15    # MAX_ITEMS_PER_TXN (config.h:189)
+    insert_table_cap: int = 1 << 20  # ring capacity of HISTORY/ORDER/... tables
 
     # ---- PPS (reference config.h:235-242) ----
     pps_table_size: int = 100000
@@ -186,6 +190,10 @@ class Config:
                    "max_accesses must cover req_per_query")
             _check(abs(self.read_perc + self.write_perc - 1.0) < 1e-6,
                    "read_perc + write_perc must sum to 1")
+        if self.workload == WorkloadKind.TPCC:
+            _check(self.max_accesses >= 3 + self.max_items_per_txn,
+                   "TPCC max_accesses must cover wh+dist+cust+items "
+                   f"(>= {3 + self.max_items_per_txn})")
         _check(self.isolation_level in (
             "SERIALIZABLE", "READ_COMMITTED", "READ_UNCOMMITTED", "NOLOCK"),
             f"bad isolation_level {self.isolation_level!r}")

@@ -61,11 +61,14 @@ class DeviceTable:
     name: str
     capacity: int
     full_row: bool
+    ring: bool = False     # append wraps (windowed retention for insert-only
+    #                        tables: HISTORY/ORDER/ORDER-LINE keep the last
+    #                        `capacity` rows instead of growing unboundedly)
 
     # ------------------------------------------------------------------
     @classmethod
     def create(cls, schema: TableSchema, capacity: int,
-               full_row: bool = False) -> "DeviceTable":
+               full_row: bool = False, ring: bool = False) -> "DeviceTable":
         # rows are padded to a multiple of 64 past the trash slot so the
         # row dimension shards evenly over any mesh up to 64 devices
         # (jax NamedSharding requires divisibility); pad rows are inert.
@@ -75,7 +78,8 @@ class DeviceTable:
             dtype, extra = _col_spec(c.ctype, c.size, full_row)
             cols[c.name] = jnp.zeros((nrows, *extra), dtype=dtype)
         return cls(columns=cols, row_cnt=jnp.zeros((), jnp.int32),
-                   name=schema.name, capacity=capacity, full_row=full_row)
+                   name=schema.name, capacity=capacity, full_row=full_row,
+                   ring=ring)
 
     @property
     def trash_slot(self) -> int:
@@ -125,13 +129,17 @@ class DeviceTable:
         mask = mask.astype(jnp.int32)
         offs = jnp.cumsum(mask) - mask
         slots = self.row_cnt + offs
-        slots = jnp.where((mask > 0) & (slots < self.capacity),
-                          slots, self.capacity)
+        if self.ring:
+            slots = jnp.where(mask > 0, slots % self.capacity, self.capacity)
+            new_cnt = self.row_cnt + mask.sum()   # cursor runs free, mod on use
+        else:
+            slots = jnp.where((mask > 0) & (slots < self.capacity),
+                              slots, self.capacity)
+            new_cnt = jnp.minimum(self.row_cnt + mask.sum(),
+                                  jnp.int32(self.capacity))
         cols = dict(self.columns)
         for n, v in rows.items():
             cols[n] = cols[n].at[slots].set(v.astype(cols[n].dtype))
-        new_cnt = jnp.minimum(self.row_cnt + mask.sum(),
-                              jnp.int32(self.capacity))
         return self._replace(columns=cols, row_cnt=new_cnt), slots
 
     # ------------------------------------------------------------------
@@ -141,7 +149,8 @@ class DeviceTable:
 
     def _replace(self, **kw) -> "DeviceTable":
         d = dict(columns=self.columns, row_cnt=self.row_cnt, name=self.name,
-                 capacity=self.capacity, full_row=self.full_row)
+                 capacity=self.capacity, full_row=self.full_row,
+                 ring=self.ring)
         d.update(kw)
         return DeviceTable(**d)
 
@@ -158,5 +167,5 @@ def _sanitize(slots: jax.Array, capacity: int,
 jax.tree_util.register_dataclass(
     DeviceTable,
     data_fields=["columns", "row_cnt"],
-    meta_fields=["name", "capacity", "full_row"],
+    meta_fields=["name", "capacity", "full_row", "ring"],
 )

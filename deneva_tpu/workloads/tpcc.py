@@ -1,0 +1,457 @@
+"""TPC-C (reference `benchmarks/tpcc_wl.cpp`, `tpcc_query.cpp`, `tpcc_txn.cpp`).
+
+Payment + NewOrder only, like the reference (`tpcc_query.cpp:122-141`).
+Nine tables per `benchmarks/TPCC_short_schema.txt`; composite keys follow
+`benchmarks/tpcc_helper.h:24-30` (distKey/custKey/stockKey) flattened to
+dense int32 slot spaces so every primary index is a free `DenseIndex`.
+
+TPU shape — the reference's request-at-a-time state machines
+(PAYMENT0-5 / NEWORDER0-9, `tpcc_txn.cpp:247-470`) become:
+
+* ``generate`` — whole-epoch device sampling of query structs with the
+  reference's exact distributions (`tpcc_query.cpp:150-260`): payment
+  remote-customer prob 0.15, by-last-name prob 60 %, NURand(1023) customer
+  and NURand(8191) item selection, ol_cnt ~ URand(5,15), remote supply
+  warehouse prob 0.01 gated by MPR.
+* ``plan`` — the full RW-set declared up front: warehouse/district/
+  customer rows + up to 15 stock rows.  ITEM reads are *excluded* from
+  the CC access list: the ITEM table is never written after load (the
+  reference still routes item reads through `row_t::get_row`, but they
+  can never conflict), so dropping them shrinks the conflict problem by
+  ~45 % with identical serializability.
+* ``execute`` — one batched pass per epoch (or per chained level):
+  commutative balance/YTD updates via ``scatter_add`` (exact under
+  duplicates), the non-commutative stock-quantity rule via gather/
+  last-writer scatter, and O_ID allocation as a *per-district segmented
+  prefix sum* over the committed batch — the epoch analogue of
+  D_NEXT_O_ID++ under the district row lock (`tpcc_txn.cpp` new_order_2).
+  ORDER / NEW-ORDER / ORDER-LINE / HISTORY inserts append into
+  ring-retention tables (`table_t::get_new_row` without the latch).
+
+By-last-name lookup (CUSTOMER_LAST_IDX, a nonunique hash index in the
+reference): the loader assigns customer ``c`` the lastname id ``c % 1000``
+(the reference's loader uses `Lastname(c_id % 1000)` for the first 1000 and
+random beyond, `tpcc_wl.cpp` init_cust), so "find middle customer with
+lastname L in (w,d)" is pure arithmetic: ``c_id = L + 1000*(cust_per_dist
+// 1000 // 2)``.  The index is its own closed form — no probe needed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.ops import last_writer
+from deneva_tpu.storage.catalog import parse_schema
+from deneva_tpu.storage.index import DenseIndex
+from deneva_tpu.storage.table import DeviceTable
+
+# ---------------------------------------------------------------------------
+# schema (column set of benchmarks/TPCC_short_schema.txt)
+
+_SCHEMA_COLS = {
+    "WAREHOUSE": [("W_ID", "int64_t"), ("W_TAX", "double"),
+                  ("W_YTD", "double")],
+    "DISTRICT": [("D_ID", "int64_t"), ("D_W_ID", "int64_t"),
+                 ("D_TAX", "double"), ("D_YTD", "double"),
+                 ("D_NEXT_O_ID", "int64_t")],
+    "CUSTOMER": [("C_ID", "int64_t"), ("C_D_ID", "int64_t"),
+                 ("C_W_ID", "int64_t"), ("C_LAST", "int64_t"),
+                 ("C_DISCOUNT", "double"), ("C_BALANCE", "double"),
+                 ("C_YTD_PAYMENT", "double"), ("C_PAYMENT_CNT", "int64_t")],
+    "HISTORY": [("H_C_ID", "int64_t"), ("H_C_D_ID", "int64_t"),
+                ("H_C_W_ID", "int64_t"), ("H_D_ID", "int64_t"),
+                ("H_W_ID", "int64_t"), ("H_AMOUNT", "double")],
+    "NEW-ORDER": [("NO_O_ID", "int64_t"), ("NO_D_ID", "int64_t"),
+                  ("NO_W_ID", "int64_t")],
+    "ORDER": [("O_ID", "int64_t"), ("O_C_ID", "int64_t"),
+              ("O_D_ID", "int64_t"), ("O_W_ID", "int64_t"),
+              ("O_ENTRY_D", "int64_t"), ("O_OL_CNT", "int64_t"),
+              ("O_ALL_LOCAL", "int64_t")],
+    "ORDER-LINE": [("OL_O_ID", "int64_t"), ("OL_D_ID", "int64_t"),
+                   ("OL_W_ID", "int64_t"), ("OL_NUMBER", "int64_t"),
+                   ("OL_I_ID", "int64_t"), ("OL_QUANTITY", "int64_t")],
+    "ITEM": [("I_ID", "int64_t"), ("I_IM_ID", "int64_t"),
+             ("I_PRICE", "int64_t")],
+    "STOCK": [("S_I_ID", "int64_t"), ("S_W_ID", "int64_t"),
+              ("S_QUANTITY", "int64_t"), ("S_REMOTE_CNT", "int64_t")],
+}
+
+TPCC_SCHEMA = "".join(
+    f"TABLE={t}\n" + "".join(f"\t8,{ct},{cn}\n" for cn, ct in cols)
+    for t, cols in _SCHEMA_COLS.items())
+
+# table ids for CC access identity (order matters: stable across runs)
+TID = {name: i for i, name in enumerate(_SCHEMA_COLS)}
+
+TPCC_PAYMENT = 0
+TPCC_NEW_ORDER = 1
+
+_LASTNAMES = 1000          # Lastname(NURand(255,0,999)), tpcc_helper.cpp
+
+
+@dataclass
+class TPCCQuery:
+    """One epoch of TPC-C queries; pytree with leading dim n.
+
+    Mirrors `TPCCQuery` / `Item_no` (`benchmarks/tpcc_query.h`) with the
+    item list padded to ``max_items_per_txn``.
+    """
+
+    txn_type: jax.Array     # int32[n]  TPCC_PAYMENT | TPCC_NEW_ORDER
+    w_id: jax.Array         # int32[n]  home warehouse (0-based)
+    d_id: jax.Array         # int32[n]
+    c_id: jax.Array         # int32[n]  resolved customer (by-lastname folded in)
+    c_w_id: jax.Array       # int32[n]  payment customer warehouse
+    c_d_id: jax.Array       # int32[n]
+    h_amount: jax.Array     # float32[n]
+    ol_cnt: jax.Array       # int32[n]
+    items: jax.Array        # int32[n, I] item ids; duplicates invalidated
+    item_valid: jax.Array   # bool[n, I]
+    supply_w: jax.Array     # int32[n, I]
+    quantity: jax.Array     # int32[n, I]
+
+
+jax.tree_util.register_dataclass(
+    TPCCQuery,
+    data_fields=["txn_type", "w_id", "d_id", "c_id", "c_w_id", "c_d_id",
+                 "h_amount", "ol_cnt", "items", "item_valid", "supply_w",
+                 "quantity"],
+    meta_fields=[])
+
+
+def _nurand(key: jax.Array, A: int, n: int, shape) -> jax.Array:
+    """TPC-C NURand(A, 0, n-1) with C=0 (`tpcc_helper.cpp` NURand; the
+    reference draws C once per run — a constant offset mod n)."""
+    k1, k2 = jax.random.split(key)
+    a = jax.random.randint(k1, shape, 0, A + 1)
+    b = jax.random.randint(k2, shape, 0, n)
+    return (a | b) % n
+
+
+class TPCCWorkload:
+    """Payment + NewOrder over 9 device tables."""
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.catalog = parse_schema(TPCC_SCHEMA)
+        self.n_wh = cfg.num_wh
+        self.n_dist = 10                     # DIST_PER_WARE (tpcc_const.h)
+        self.cust_per_dist = cfg.cust_per_dist
+        self.max_items = cfg.max_items
+        self.ipt = cfg.max_items_per_txn     # MAX_ITEMS_PER_TXN=15 (config.h:189)
+        need = 3 + self.ipt                  # wh + dist + cust + stock rows
+        if cfg.max_accesses < need:
+            raise ValueError(
+                f"TPCC needs max_accesses >= {need}, got {cfg.max_accesses}")
+        self.n_districts = self.n_wh * self.n_dist
+        self.n_cust = self.n_districts * self.cust_per_dist
+        self.n_stock = self.n_wh * self.max_items
+        # flattened composite keys and the per-district sort key must fit
+        # int32 (storage/table.py's stated key contract)
+        lim = 2**31 - 1
+        if max(self.n_stock, self.n_cust) > lim:
+            raise ValueError("TPCC key space exceeds int32: shrink "
+                             "num_wh/max_items/cust_per_dist")
+        if (self.n_districts + 1) * 2 * cfg.epoch_batch > lim:
+            raise ValueError("num_wh*10*2*epoch_batch must fit int32")
+
+    # -- composite keys (tpcc_helper.h:24-30, flattened dense) ----------
+    def dist_key(self, w, d):
+        return w * self.n_dist + d
+
+    def cust_key(self, w, d, c):
+        return self.dist_key(w, d) * self.cust_per_dist + c
+
+    def stock_key(self, w, i):
+        return w * self.max_items + i
+
+    # -- loader (tpcc_wl.cpp:89-152 parallel loaders) -------------------
+    def load(self):
+        cfg = self.cfg
+        db = {}
+
+        def tab(name, cap, ring=False):
+            t = DeviceTable.create(self.catalog.table(name), cap, ring=ring)
+            db[name] = t
+            return t
+
+        wh = tab("WAREHOUSE", self.n_wh)
+        w_ids = np.arange(self.n_wh, dtype=np.int32)
+        db["WAREHOUSE"] = _fill(wh, self.n_wh, {
+            "W_ID": w_ids,
+            "W_TAX": _rand01(w_ids, 7) * 0.2,       # URand(0,.2) (init_wh)
+            "W_YTD": np.full(self.n_wh, 300000.0, np.float32)})
+
+        dist = tab("DISTRICT", self.n_districts)
+        d_ids = np.arange(self.n_districts, dtype=np.int32)
+        db["DISTRICT"] = _fill(dist, self.n_districts, {
+            "D_ID": d_ids % self.n_dist,
+            "D_W_ID": d_ids // self.n_dist,
+            "D_TAX": _rand01(d_ids, 11) * 0.2,
+            "D_YTD": np.full(self.n_districts, 30000.0, np.float32),
+            "D_NEXT_O_ID": np.full(self.n_districts, 3001, np.int32)})
+
+        cust = tab("CUSTOMER", self.n_cust)
+        c_ids = np.arange(self.n_cust, dtype=np.int32)
+        c_local = c_ids % self.cust_per_dist
+        db["CUSTOMER"] = _fill(cust, self.n_cust, {
+            "C_ID": c_local,
+            "C_D_ID": (c_ids // self.cust_per_dist) % self.n_dist,
+            "C_W_ID": c_ids // (self.cust_per_dist * self.n_dist),
+            "C_LAST": c_local % _LASTNAMES,
+            "C_DISCOUNT": _rand01(c_ids, 13) * 0.5,
+            "C_BALANCE": np.full(self.n_cust, -10.0, np.float32),
+            "C_YTD_PAYMENT": np.full(self.n_cust, 10.0, np.float32),
+            "C_PAYMENT_CNT": np.ones(self.n_cust, np.int32)})
+
+        item = tab("ITEM", self.max_items)
+        i_ids = np.arange(self.max_items, dtype=np.int32)
+        db["ITEM"] = _fill(item, self.max_items, {
+            "I_ID": i_ids,
+            "I_IM_ID": (i_ids.astype(np.int64) * 2654435761 % 10000
+                        ).astype(np.int32),
+            "I_PRICE": (1 + i_ids.astype(np.int64) * 48271 % 100
+                        ).astype(np.int32)})
+
+        stock = tab("STOCK", self.n_stock)
+        s_ids = np.arange(self.n_stock, dtype=np.int32)
+        db["STOCK"] = _fill(stock, self.n_stock, {
+            "S_I_ID": s_ids % self.max_items,
+            "S_W_ID": s_ids // self.max_items,
+            "S_QUANTITY": (10 + s_ids * 69621 % 91).astype(np.int32),
+            "S_REMOTE_CNT": np.zeros(self.n_stock, np.int32)})
+
+        cap = cfg.insert_table_cap
+        tab("HISTORY", cap, ring=True)
+        tab("ORDER", cap, ring=True)
+        tab("NEW-ORDER", cap, ring=True)
+        tab("ORDER-LINE", cap * 2, ring=True)
+        return db
+
+    # -- generation (tpcc_query.cpp:144-260) ----------------------------
+    def generate(self, rng: jax.Array, n: int) -> TPCCQuery:
+        cfg = self.cfg
+        ks = jax.random.split(rng, 12)
+        is_pay = jax.random.bernoulli(ks[0], cfg.perc_payment, (n,))
+        w_id = jax.random.randint(ks[1], (n,), 0, self.n_wh)
+        d_id = jax.random.randint(ks[2], (n,), 0, self.n_dist)
+
+        # payment customer: remote (w', d') with prob 0.15 (tpcc_query.cpp:168-186)
+        remote = jax.random.bernoulli(ks[3], 0.15, (n,)) & (self.n_wh > 1)
+        rw = jax.random.randint(ks[4], (n,), 0, max(self.n_wh - 1, 1))
+        rw = jnp.where(rw >= w_id, rw + 1, rw)          # != w_id
+        c_w_id = jnp.where(remote, rw, w_id)
+        c_d_id = jnp.where(remote,
+                           jax.random.randint(ks[5], (n,), 0, self.n_dist),
+                           d_id)
+
+        # by-last-name 60% resolves to the middle same-lastname customer
+        by_last = jax.random.bernoulli(ks[6], 0.6, (n,))
+        lastname = _nurand(ks[7], 255, _LASTNAMES, (n,))
+        per_name = max(self.cust_per_dist // _LASTNAMES, 1)
+        mid = lastname + _LASTNAMES * (per_name // 2)
+        c_direct = _nurand(ks[8], 1023, self.cust_per_dist, (n,))
+        c_id = jnp.where(by_last & is_pay,
+                         jnp.minimum(mid, self.cust_per_dist - 1), c_direct)
+
+        h_amount = jax.random.uniform(ks[9], (n,), jnp.float32, 1.0, 5000.0)
+
+        # new-order item list (tpcc_query.cpp:221-256)
+        I = self.ipt
+        ol_cnt = jax.random.randint(ks[10], (n,), 5, I + 1)
+        ki, kq, kr, kw = jax.random.split(ks[11], 4)
+        items = _nurand(ki, 8191, self.max_items, (n, I))
+        lane = jnp.arange(I)
+        in_cnt = lane[None, :] < ol_cnt[:, None]
+        # reference rejects duplicate item ids (tpcc_query.cpp:237); here
+        # duplicates beyond the first are invalidated (collision odds
+        # ~I^2/2/max_items per txn)
+        first = jnp.argmax(items[:, :, None] == items[:, None, :], axis=1)
+        item_valid = in_cnt & (first == lane[None, :])
+        quantity = jax.random.randint(kq, (n, I), 1, 11)
+        kr1, kr2 = jax.random.split(kr)
+        rem_item = (jax.random.bernoulli(kr1, 0.01, (n, I))
+                    & jax.random.bernoulli(kr2, cfg.mpr, (n, 1))
+                    & (self.n_wh > 1))
+        rsup = jax.random.randint(kw, (n, I), 0, max(self.n_wh - 1, 1))
+        rsup = jnp.where(rsup >= w_id[:, None], rsup + 1, rsup)
+        supply_w = jnp.where(rem_item, rsup, w_id[:, None])
+
+        return TPCCQuery(
+            txn_type=jnp.where(is_pay, TPCC_PAYMENT, TPCC_NEW_ORDER
+                               ).astype(jnp.int32),
+            w_id=w_id, d_id=d_id, c_id=c_id, c_w_id=c_w_id, c_d_id=c_d_id,
+            h_amount=h_amount, ol_cnt=ol_cnt,
+            items=items, item_valid=item_valid, supply_w=supply_w,
+            quantity=quantity)
+
+    # -- RW-set planning (tpcc_txn.cpp state machines, declared up front)
+    def plan(self, db, q: TPCCQuery) -> dict:
+        cfg = self.cfg
+        n = q.w_id.shape[0]
+        A = cfg.max_accesses
+        is_pay = q.txn_type == TPCC_PAYMENT
+
+        tables = jnp.zeros((n, A), jnp.int32)
+        keys = jnp.zeros((n, A), jnp.int32)
+        is_read = jnp.zeros((n, A), bool)
+        is_write = jnp.zeros((n, A), bool)
+        valid = jnp.zeros((n, A), bool)
+
+        def put(a, tid, key, r, w, v):
+            nonlocal tables, keys, is_read, is_write, valid
+            tables = tables.at[:, a].set(tid)
+            keys = keys.at[:, a].set(key)
+            is_read = is_read.at[:, a].set(r)
+            is_write = is_write.at[:, a].set(w)
+            valid = valid.at[:, a].set(v)
+
+        one = jnp.ones((n,), bool)
+        # 0: warehouse — payment updates W_YTD (run_payment_0), neworder
+        #    reads W_TAX (new_order_0)
+        wh_write = is_pay & cfg.wh_update
+        put(0, TID["WAREHOUSE"], q.w_id, one, wh_write, one)
+        # 1: district — payment D_YTD += (run_payment_2/3); neworder
+        #    D_NEXT_O_ID++ (new_order_2)
+        put(1, TID["DISTRICT"], self.dist_key(q.w_id, q.d_id), one, one, one)
+        # 2: customer — payment balance update at (c_w,c_d); neworder
+        #    reads C_DISCOUNT at home (new_order_4)
+        ck = jnp.where(is_pay, self.cust_key(q.c_w_id, q.c_d_id, q.c_id),
+                       self.cust_key(q.w_id, q.d_id, q.c_id))
+        put(2, TID["CUSTOMER"], ck, one, is_pay, one)
+        # 3..3+I: stock rows (new_order_8); ITEM reads excluded (immutable)
+        sk = self.stock_key(q.supply_w, q.items)
+        iv = q.item_valid & ~is_pay[:, None]
+        for j in range(self.ipt):
+            put(3 + j, TID["STOCK"], sk[:, j], iv[:, j], iv[:, j], iv[:, j])
+        return dict(table_ids=tables, keys=keys, is_read=is_read,
+                    is_write=is_write, valid=valid)
+
+    # -- execution ------------------------------------------------------
+    def execute(self, db, q: TPCCQuery, mask: jax.Array, order: jax.Array,
+                stats: dict):
+        db = dict(db)
+        is_pay = q.txn_type == TPCC_PAYMENT
+        pay = mask & is_pay
+        neworder = mask & ~is_pay
+        db = self._exec_payment(db, q, pay, stats)
+        db = self._exec_neworder(db, q, neworder, order, stats)
+        return db
+
+    def _exec_payment(self, db, q, m, stats):
+        """run_payment_0..5 (`tpcc_txn.cpp:472-`): YTD/balance updates are
+        commutative -> exact batched scatter_add."""
+        amt = jnp.where(m, q.h_amount, 0.0)
+        if self.cfg.wh_update:
+            db["WAREHOUSE"] = db["WAREHOUSE"].scatter_add(
+                q.w_id, {"W_YTD": amt}, mask=m)
+        db["DISTRICT"] = db["DISTRICT"].scatter_add(
+            self.dist_key(q.w_id, q.d_id), {"D_YTD": amt}, mask=m)
+        ck = self.cust_key(q.c_w_id, q.c_d_id, q.c_id)
+        db["CUSTOMER"] = db["CUSTOMER"].scatter_add(
+            ck, {"C_BALANCE": -amt, "C_YTD_PAYMENT": amt,
+                 "C_PAYMENT_CNT": m.astype(jnp.int32)}, mask=m)
+        hist, _ = db["HISTORY"].append(
+            {"H_C_ID": q.c_id, "H_C_D_ID": q.c_d_id, "H_C_W_ID": q.c_w_id,
+             "H_D_ID": q.d_id, "H_W_ID": q.w_id, "H_AMOUNT": q.h_amount}, m)
+        db["HISTORY"] = hist
+        return db
+
+    def _exec_neworder(self, db, q, m, order, stats):
+        """new_order_0..9 (`tpcc_txn.cpp:`): O_ID allocation is a
+        per-district segmented prefix sum over the committed batch in
+        serialization order — D_NEXT_O_ID++ under the row latch, batched."""
+        n = q.w_id.shape[0]
+        dist = db["DISTRICT"]
+        dk = self.dist_key(q.w_id, q.d_id)
+
+        # taxes / discount reads feed the checksum (keeps gathers alive)
+        w_tax = db["WAREHOUSE"].gather(q.w_id, ("W_TAX",))["W_TAX"]
+        d = dist.gather(dk, ("D_TAX", "D_NEXT_O_ID"))
+        c_disc = db["CUSTOMER"].gather(
+            self.cust_key(q.w_id, q.d_id, q.c_id), ("C_DISCOUNT",))["C_DISCOUNT"]
+        stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
+            jnp.where(m, (w_tax + d["D_TAX"] + c_disc) * 1000, 0)
+        ).astype(jnp.uint32)
+
+        # o_id = snapshot next_o_id + rank among committed same-district
+        # neworders ordered by serialization order
+        big = jnp.int32(jnp.iinfo(jnp.int32).max)
+        # bounded segment id (masked rows share one trailing segment) so
+        # the composite sort key stays within int32
+        seg = jnp.where(m, dk, jnp.int32(self.n_districts))
+        order_rank = jnp.argsort(jnp.argsort(jnp.where(m, order, big)))
+        sort_key = seg * (2 * n) + order_rank.astype(jnp.int32)
+        perm = jnp.argsort(sort_key)
+        sorted_seg = jnp.take(seg, perm)
+        new_segment = jnp.concatenate(
+            [jnp.ones((1,), bool), sorted_seg[1:] != sorted_seg[:-1]])
+        pos = jnp.arange(n) - jax.lax.cummax(
+            jnp.where(new_segment, jnp.arange(n), 0))
+        rank = jnp.zeros((n,), jnp.int32).at[perm].set(pos.astype(jnp.int32))
+        o_id = d["D_NEXT_O_ID"] + rank
+
+        db["DISTRICT"] = dist.scatter_add(
+            dk, {"D_NEXT_O_ID": m.astype(jnp.int32)}, mask=m)
+
+        # stock update (new_order_8): non-commutative quantity rule ->
+        # gather/modify/last-writer scatter; S_REMOTE_CNT is scatter_add
+        I = self.ipt
+        iv = (q.item_valid & m[:, None]).reshape(-1)
+        sk = self.stock_key(q.supply_w, q.items).reshape(-1)
+        qty = q.quantity.reshape(-1)
+        stock = db["STOCK"]
+        s_q = stock.gather(sk, ("S_QUANTITY",))["S_QUANTITY"]
+        # strict: replenish at s_q - qty <= 10 (tpcc_txn.cpp new_order_8/9)
+        new_q = jnp.where(s_q - qty > 10, s_q - qty, s_q - qty + 91)
+        worder = jnp.broadcast_to(order[:, None], (n, I)).reshape(-1)
+        win = last_writer(jnp.where(iv, sk, stock.capacity), worder, iv,
+                          stock.capacity)
+        stock = stock.scatter(sk, {"S_QUANTITY": new_q}, mask=win)
+        remote = (q.supply_w != q.w_id[:, None]).reshape(-1)
+        db["STOCK"] = stock.scatter_add(
+            sk, {"S_REMOTE_CNT": (iv & remote).astype(jnp.int32)},
+            mask=iv & remote)
+
+        # inserts: ORDER, NEW-ORDER, ORDER-LINE (new_order_1 / _3 / _9)
+        all_local = jnp.all(~q.item_valid | (q.supply_w == q.w_id[:, None]),
+                            axis=1)
+        db["ORDER"], _ = db["ORDER"].append(
+            {"O_ID": o_id, "O_C_ID": q.c_id, "O_D_ID": q.d_id,
+             "O_W_ID": q.w_id, "O_ENTRY_D": jnp.full((n,), 2013),
+             "O_OL_CNT": q.ol_cnt,
+             "O_ALL_LOCAL": all_local.astype(jnp.int32)}, m)
+        db["NEW-ORDER"], _ = db["NEW-ORDER"].append(
+            {"NO_O_ID": o_id, "NO_D_ID": q.d_id, "NO_W_ID": q.w_id}, m)
+        ol_m = (q.item_valid & m[:, None]).reshape(-1)
+        bcast = lambda x: jnp.broadcast_to(x[:, None], (n, I)).reshape(-1)  # noqa: E731
+        db["ORDER-LINE"], _ = db["ORDER-LINE"].append(
+            {"OL_O_ID": bcast(o_id), "OL_D_ID": bcast(q.d_id),
+             "OL_W_ID": bcast(q.w_id),
+             "OL_NUMBER": jnp.broadcast_to(jnp.arange(I)[None], (n, I)
+                                           ).reshape(-1),
+             "OL_I_ID": q.items.reshape(-1),
+             "OL_QUANTITY": q.quantity.reshape(-1)}, ol_m)
+
+        stats["write_cnt"] = stats["write_cnt"] + \
+            (iv.sum() + m.sum() * 2).astype(jnp.uint32)
+        return db
+
+
+def _rand01(ids: np.ndarray, salt: int) -> np.ndarray:
+    """Deterministic per-row uniform [0,1) for loader columns."""
+    h = (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
+         + np.uint64(salt)) & np.uint64(0xFFFFFFFF)
+    return (h / np.float64(2**32)).astype(np.float32)
+
+
+def _fill(tab: DeviceTable, n: int, cols: dict) -> DeviceTable:
+    out = dict(tab.columns)
+    for name, v in cols.items():
+        out[name] = out[name].at[:n].set(jnp.asarray(v, out[name].dtype))
+    return tab._replace(columns=out, row_cnt=jnp.int32(n))

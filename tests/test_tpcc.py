@@ -1,0 +1,160 @@
+"""TPC-C workload: loader invariants, generation distributions, money
+conservation, D_NEXT_O_ID / order-insert consistency (the reference's
+consistency oracle is `YCSB_ABORT_MODE`-style spot checks; here we assert
+TPC-C's actual audit invariants over the device tables)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deneva_tpu.config import Config
+from deneva_tpu.engine import Engine
+from deneva_tpu.workloads import get_workload
+from deneva_tpu.workloads.tpcc import TPCC_NEW_ORDER, TPCC_PAYMENT
+
+
+def tpcc_cfg(**kw):
+    base = dict(workload="TPCC", num_wh=2, cust_per_dist=120,
+                max_items=200, max_items_per_txn=5, max_accesses=8,
+                epoch_batch=64, conflict_buckets=1024,
+                max_txn_in_flight=256, insert_table_cap=1 << 14,
+                warmup_secs=0.0, done_secs=0.2)
+    base.update(kw)
+    from deneva_tpu.config import WorkloadKind, CCAlg
+    base["workload"] = WorkloadKind(base["workload"])
+    if "cc_alg" in base:
+        base["cc_alg"] = CCAlg(base["cc_alg"])
+    return Config(**base)
+
+
+def run_epochs(cfg, n=25, seed=0):
+    eng = Engine(cfg, get_workload(cfg))
+    state = eng.init_state(seed)
+    state = eng.jit_run(state, n)
+    return jax.device_get(state)
+
+
+def test_loader_shapes_and_invariants():
+    cfg = tpcc_cfg()
+    wl = get_workload(cfg)
+    db = wl.load()
+    assert set(db) == {"WAREHOUSE", "DISTRICT", "CUSTOMER", "HISTORY",
+                       "NEW-ORDER", "ORDER", "ORDER-LINE", "ITEM", "STOCK"}
+    assert int(db["DISTRICT"].row_cnt) == 2 * 10
+    next_o = db["DISTRICT"].host_column("D_NEXT_O_ID")
+    assert (next_o == 3001).all()
+    cw = db["CUSTOMER"].host_column("C_W_ID")
+    assert cw.min() == 0 and cw.max() == 1
+    sq = db["STOCK"].host_column("S_QUANTITY")
+    assert sq.min() >= 10 and sq.max() <= 100
+
+
+def test_generation_distributions():
+    cfg = tpcc_cfg(perc_payment=0.5)
+    wl = get_workload(cfg)
+    q = jax.device_get(wl.generate(jax.random.PRNGKey(0), 4096))
+    pay = q.txn_type == TPCC_PAYMENT
+    assert 0.4 < pay.mean() < 0.6
+    assert q.w_id.min() >= 0 and q.w_id.max() < cfg.num_wh
+    assert q.d_id.max() < 10
+    assert (q.c_id < cfg.cust_per_dist).all()
+    # remote payment customer ~15% (tpcc_query.cpp:168-186)
+    rem = (q.c_w_id != q.w_id)[pay]
+    assert 0.08 < rem.mean() < 0.25
+    no = ~pay
+    assert q.ol_cnt[no].min() >= 5 and q.ol_cnt[no].max() <= 5
+    # valid items are within cnt and distinct
+    for i in np.where(no)[0][:50]:
+        v = q.item_valid[i]
+        ids = q.items[i][v]
+        assert len(set(ids.tolist())) == len(ids)
+
+
+@pytest.mark.parametrize("alg", ["NOCC", "OCC", "TPU_BATCH", "CALVIN",
+                                 "NO_WAIT", "MVCC"])
+def test_tpcc_runs_and_commits(alg):
+    cfg = tpcc_cfg(cc_alg=alg)
+    state = run_epochs(cfg)
+    commits = int(state.stats["total_txn_commit_cnt"])
+    assert commits > 0
+    if alg in ("CALVIN", "TPU_BATCH"):
+        assert int(state.stats["total_txn_abort_cnt"]) == 0
+
+
+def test_money_conservation_and_order_consistency():
+    """TPC-C audit: sum(D_YTD)+sum(W_YTD) grows by exactly 2x the committed
+    payment amounts; orders inserted == sum of D_NEXT_O_ID advances."""
+    cfg = tpcc_cfg(cc_alg="TPU_BATCH", perc_payment=0.5)
+    wl = get_workload(cfg)
+    eng = Engine(cfg, wl)
+    state = eng.init_state(0)
+    d0 = jax.device_get(state.db)
+    state = eng.jit_run(state, 30)
+    d1 = jax.device_get(state.db)
+
+    h = d1["HISTORY"]
+    n_hist = int(h.row_cnt)
+    assert n_hist < cfg.insert_table_cap, "ring wrapped; test invalid"
+    paid = np.asarray(h.columns["H_AMOUNT"])[:n_hist].sum()
+
+    dytd = (d1["DISTRICT"].host_column("D_YTD").astype(np.float64).sum()
+            - d0["DISTRICT"].host_column("D_YTD").astype(np.float64).sum())
+    wytd = (d1["WAREHOUSE"].host_column("W_YTD").astype(np.float64).sum()
+            - d0["WAREHOUSE"].host_column("W_YTD").astype(np.float64).sum())
+    assert n_hist > 0
+    np.testing.assert_allclose(dytd, paid, rtol=1e-5)
+    np.testing.assert_allclose(wytd, paid, rtol=1e-5)
+
+    # customer balance decreased by total paid
+    bal = (d0["CUSTOMER"].host_column("C_BALANCE").astype(np.float64).sum()
+           - d1["CUSTOMER"].host_column("C_BALANCE").astype(np.float64).sum())
+    np.testing.assert_allclose(bal, paid, rtol=1e-5)
+
+    # order-id accounting: next_o_id advances == ORDER rows == NEW-ORDER rows
+    adv = int((d1["DISTRICT"].host_column("D_NEXT_O_ID")
+               - d0["DISTRICT"].host_column("D_NEXT_O_ID")).sum())
+    assert adv == int(d1["ORDER"].row_cnt) == int(d1["NEW-ORDER"].row_cnt)
+    assert adv > 0
+
+    # per-district order ids are exactly [3001, 3001+adv_d) with no dups
+    n_ord = int(d1["ORDER"].row_cnt)
+    o_d = np.asarray(d1["ORDER"].columns["O_D_ID"])[:n_ord]
+    o_w = np.asarray(d1["ORDER"].columns["O_W_ID"])[:n_ord]
+    o_id = np.asarray(d1["ORDER"].columns["O_ID"])[:n_ord]
+    next_o = d1["DISTRICT"].host_column("D_NEXT_O_ID")
+    for w in range(cfg.num_wh):
+        for d in range(10):
+            ids = np.sort(o_id[(o_w == w) & (o_d == d)])
+            hi = next_o[w * 10 + d]
+            assert (ids == np.arange(3001, hi)).all(), (w, d)
+
+    # order lines reference real orders; avg just under ol_cnt because
+    # duplicate sampled items are invalidated rather than resampled
+    n_ol = int(d1["ORDER-LINE"].row_cnt)
+    assert n_ol >= n_ord * 4
+
+
+def test_stock_quantity_rule():
+    """S_QUANTITY stays in (0, 101): the new_order_8 replenish rule."""
+    cfg = tpcc_cfg(cc_alg="TPU_BATCH", perc_payment=0.0, num_wh=1,
+                   max_items=50)
+    state = run_epochs(cfg, n=40)
+    sq = np.asarray(state.db["STOCK"].columns["S_QUANTITY"])[:50]
+    assert sq.min() > -10 and sq.max() <= 101
+    assert int(state.stats["total_txn_commit_cnt"]) > 0
+    rc = np.asarray(state.db["STOCK"].columns["S_REMOTE_CNT"])[:50]
+    assert (rc == 0).all()  # single warehouse -> no remote supplies
+
+
+def test_ring_append_wraps():
+    from deneva_tpu.storage.catalog import parse_schema
+    from deneva_tpu.storage.table import DeviceTable
+    cat = parse_schema("TABLE=T\n\t8,int64_t,A\n")
+    t = DeviceTable.create(cat.table("T"), 8, ring=True)
+    for i in range(3):
+        t, slots = t.append({"A": jnp.arange(5) + i * 5},
+                            jnp.ones(5, bool))
+    assert int(t.row_cnt) == 15
+    vals = np.sort(np.asarray(t.columns["A"])[:8])
+    np.testing.assert_array_equal(vals, np.arange(7, 15))
