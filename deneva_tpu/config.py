@@ -116,10 +116,15 @@ class Config:
     cust_per_dist: int = 3000      # CUST_PER_DIST_NORM (config.h:188)
     max_items: int = 100000        # MAX_ITEMS_NORM (config.h:187)
     max_items_per_txn: int = 15    # MAX_ITEMS_PER_TXN (config.h:189)
-    insert_table_cap: int = 1 << 20  # ring capacity of HISTORY/ORDER/... tables
+    insert_table_cap: int = 1 << 17  # ring capacity of HISTORY/ORDER/... tables
+    #                                  (ORDER-LINE gets cap*max_items_per_txn)
 
-    # ---- PPS (reference config.h:235-242) ----
+    # ---- PPS (reference config.h:226-242) ----
     pps_table_size: int = 100000
+    pps_parts_cnt: int = 10000       # MAX_PPS_PART_KEY
+    pps_products_cnt: int = 1000     # MAX_PPS_PRODUCT_KEY
+    pps_suppliers_cnt: int = 1000    # MAX_PPS_SUPPLIER_KEY
+    pps_parts_per: int = 10          # MAX_PPS_PART_PER_PRODUCT
     perc_getparts: float = 0.0
     perc_getproducts: float = 0.0
     perc_getsuppliers: float = 0.0
@@ -208,6 +213,9 @@ class Config:
                    + self.perc_getpartbyproduct + self.perc_getpartbysupplier
                    + self.perc_orderproduct + self.perc_updateproductpart + self.perc_updatepart)
             _check(abs(mix - 1.0) < 1e-6, "PPS txn mix must sum to 1")
+            _check(self.max_accesses >= 1 + 2 * self.pps_parts_per,
+                   "PPS max_accesses must cover anchor + mapping + parts "
+                   f"(>= {1 + 2 * self.pps_parts_per})")
         return self
 
     # -- CLI bridge -----------------------------------------------------

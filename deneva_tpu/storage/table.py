@@ -155,6 +155,16 @@ class DeviceTable:
         return DeviceTable(**d)
 
 
+def fill_columns(tab: DeviceTable, n: int, cols: dict) -> DeviceTable:
+    """Loader helper: set the first ``n`` rows of the named columns and
+    advance ``row_cnt`` (the parallel loaders of SURVEY §2.5 reduced to
+    one sliced device write per column)."""
+    out = dict(tab.columns)
+    for name, v in cols.items():
+        out[name] = out[name].at[:n].set(jnp.asarray(v, out[name].dtype))
+    return tab._replace(columns=out, row_cnt=jnp.int32(n))
+
+
 def _sanitize(slots: jax.Array, capacity: int,
               mask: jax.Array | None = None) -> jax.Array:
     slots = slots.astype(jnp.int32)

@@ -1,0 +1,257 @@
+"""PPS — Product-Parts-Suppliers (reference `benchmarks/pps_wl.cpp`,
+`pps_query.cpp`, `pps_txn.cpp`).
+
+Five tables (`benchmarks/PPS_schema.txt`): PARTS (10k), PRODUCTS (1k),
+SUPPLIERS (1k), USES (product -> 10 parts), SUPPLIES (supplier -> 10
+parts).  Eight transaction types mixed by ``perc_*`` config
+(`config.h:235-242`):
+
+  GETPART / GETPRODUCT / GETSUPPLIER    — one-row reads
+  GETPARTBYPRODUCT / GETPARTBYSUPPLIER — secondary-index walks: read the
+      anchor row, the 10 USES/SUPPLIES mapping rows, then the referenced
+      part rows (`pps_txn.cpp:729-808,893-960`)
+  ORDERPRODUCT    — the mapping walk, then PART_AMOUNT -= 1 on each used
+      part (`pps_txn.cpp:962-973` run_orderproduct_5)
+  UPDATEPRODUCTPART — write the product's part field
+      (`pps_txn.cpp:975-982` set_value(1, part_key))
+  UPDATEPART      — PART_AMOUNT += 100 (`pps_txn.cpp:997-1006`)
+
+**The recon path** (SURVEY §7: the most exotic reference machinery): under
+Calvin the part keys behind a product are unknown until USES is read, so
+the sequencer pre-runs a reconnaissance txn and restarts the real txn with
+the keys filled in (`system/sequencer.cpp:88-115`, `:239-257`).  Here every
+transaction's RW-set is planned against the epoch snapshot: ``plan`` simply
+*gathers* the USES/SUPPLIES mapping rows on device and declares the
+resolved part rows in the same RW-set — reconnaissance is one gather,
+and the restart loop vanishes.  The mapping reads are declared as CC reads
+(exactly the rows the reference locks), so a concurrent writer of the
+mapping would conflict and serialize correctly; in PPS (as in the
+reference) the USES/SUPPLIES tables are never written after load, so the
+snapshot plan is always exact.
+
+TPU shape: all primary keys are dense -> free `DenseIndex`; the nonunique
+USES/SUPPLIES indexes (count-suffixed probes `pps_txn.cpp:755-768`) are
+dense [anchor*10 + j] layouts — the index walk is an affine gather.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.ops import last_writer
+from deneva_tpu.storage.catalog import parse_schema
+from deneva_tpu.storage.table import DeviceTable, fill_columns
+
+_FIELDS = "".join(f"\t10,string,FIELD{i}\n" for i in range(1, 11))
+PPS_SCHEMA = (
+    "TABLE=PARTS\n\t8,int64_t,PART_KEY\n\t8,int64_t,PART_AMOUNT\n" + _FIELDS
+    + "TABLE=PRODUCTS\n\t8,int64_t,PRODUCT_KEY\n\t8,int64_t,PRODUCT_PART\n"
+    + _FIELDS
+    + "TABLE=SUPPLIERS\n\t8,int64_t,SUPPLIER_KEY\n" + _FIELDS
+    + "TABLE=USES\n\t8,int64_t,PRODUCT_KEY\n\t8,int64_t,PART_KEY\n"
+    + "TABLE=SUPPLIES\n\t8,int64_t,SUPPLIER_KEY\n\t8,int64_t,PART_KEY\n")
+
+TID = {"PARTS": 20, "PRODUCTS": 21, "SUPPLIERS": 22, "USES": 23,
+       "SUPPLIES": 24}
+
+(GETPART, GETPRODUCT, GETSUPPLIER, GETPARTBYPRODUCT, GETPARTBYSUPPLIER,
+ ORDERPRODUCT, UPDATEPRODUCTPART, UPDATEPART) = range(8)
+
+
+@dataclass
+class PPSQuery:
+    """One epoch of PPS queries (reference `PPSQuery`,
+    `benchmarks/pps_query.cpp:40-120`); part_keys recon happens in plan."""
+
+    txn_type: jax.Array      # int32[n] 0..7
+    part_key: jax.Array      # int32[n]
+    product_key: jax.Array   # int32[n]
+    supplier_key: jax.Array  # int32[n]
+
+
+jax.tree_util.register_dataclass(
+    PPSQuery,
+    data_fields=["txn_type", "part_key", "product_key", "supplier_key"],
+    meta_fields=[])
+
+
+class PPSWorkload:
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+        self.catalog = parse_schema(PPS_SCHEMA)
+        self.n_parts = cfg.pps_parts_cnt
+        self.n_products = cfg.pps_products_cnt
+        self.n_suppliers = cfg.pps_suppliers_cnt
+        self.per = cfg.pps_parts_per        # MAX_PPS_PART_PER_PRODUCT (config.h:230)
+        need = 1 + 2 * self.per
+        if cfg.max_accesses < need:
+            raise ValueError(f"PPS needs max_accesses >= {need}")
+        # txn-type mix (config.h:235-242); order matches the enum
+        self.mix = np.array([
+            cfg.perc_getparts, cfg.perc_getproducts, cfg.perc_getsuppliers,
+            cfg.perc_getpartbyproduct, cfg.perc_getpartbysupplier,
+            cfg.perc_orderproduct, cfg.perc_updateproductpart,
+            cfg.perc_updatepart], np.float64)
+        assert abs(self.mix.sum() - 1.0) < 1e-6
+
+    # -- loader (pps_wl.cpp:71-111 threadInit*) -------------------------
+    def load(self):
+        db = {}
+
+        def fill(name, cap, cols):
+            t = DeviceTable.create(self.catalog.table(name), cap)
+            db[name] = fill_columns(t, cap, cols)
+
+        p_ids = np.arange(self.n_parts, dtype=np.int32)
+        fill("PARTS", self.n_parts,
+             {"PART_KEY": p_ids,
+              "PART_AMOUNT": np.full(self.n_parts, 10000, np.int32)})
+        pr_ids = np.arange(self.n_products, dtype=np.int32)
+        fill("PRODUCTS", self.n_products,
+             {"PRODUCT_KEY": pr_ids,
+              "PRODUCT_PART": _map_part(pr_ids, 0, 0, self.n_parts)})
+        s_ids = np.arange(self.n_suppliers, dtype=np.int32)
+        fill("SUPPLIERS", self.n_suppliers, {"SUPPLIER_KEY": s_ids})
+
+        # mapping tables: row (anchor*per + j) -> part (pps_wl.cpp uses
+        # URand parts per anchor; here a deterministic hash map)
+        u = np.arange(self.n_products * self.per, dtype=np.int32)
+        fill("USES", len(u),
+             {"PRODUCT_KEY": u // self.per,
+              "PART_KEY": _map_part(u // self.per, u % self.per, 1,
+                                    self.n_parts)})
+        s = np.arange(self.n_suppliers * self.per, dtype=np.int32)
+        fill("SUPPLIES", len(s),
+             {"SUPPLIER_KEY": s // self.per,
+              "PART_KEY": _map_part(s // self.per, s % self.per, 2,
+                                    self.n_parts)})
+        return db
+
+    # -- generation (pps_query.cpp:40-120) ------------------------------
+    def generate(self, rng: jax.Array, n: int) -> PPSQuery:
+        k0, k1, k2, k3 = jax.random.split(rng, 4)
+        cum = jnp.asarray(np.cumsum(self.mix), jnp.float32)
+        r = jax.random.uniform(k0, (n,))
+        txn_type = jnp.sum(r[:, None] >= cum[None, :], axis=1
+                           ).astype(jnp.int32)
+        return PPSQuery(
+            txn_type=jnp.clip(txn_type, 0, 7),
+            part_key=jax.random.randint(k1, (n,), 0, self.n_parts),
+            product_key=jax.random.randint(k2, (n,), 0, self.n_products),
+            supplier_key=jax.random.randint(k3, (n,), 0, self.n_suppliers))
+
+    # -- RW-set planning with on-device recon ---------------------------
+    def plan(self, db, q: PPSQuery) -> dict:
+        n = q.txn_type.shape[0]
+        A = self.cfg.max_accesses
+        t = q.txn_type
+        per = self.per
+
+        anchor_is_part = (t == GETPART) | (t == UPDATEPART)
+        anchor_is_supp = (t == GETSUPPLIER) | (t == GETPARTBYSUPPLIER)
+        by_prod = ((t == GETPARTBYPRODUCT) | (t == ORDERPRODUCT))
+        walks = by_prod | (t == GETPARTBYSUPPLIER)
+
+        tables = jnp.zeros((n, A), jnp.int32)
+        keys = jnp.zeros((n, A), jnp.int32)
+        is_read = jnp.zeros((n, A), bool)
+        is_write = jnp.zeros((n, A), bool)
+        valid = jnp.zeros((n, A), bool)
+
+        # access 0: anchor row
+        a_tid = jnp.where(anchor_is_part, TID["PARTS"],
+                          jnp.where(anchor_is_supp, TID["SUPPLIERS"],
+                                    TID["PRODUCTS"]))
+        a_key = jnp.where(anchor_is_part, q.part_key,
+                          jnp.where(anchor_is_supp, q.supplier_key,
+                                    q.product_key))
+        a_write = (t == UPDATEPRODUCTPART) | (t == UPDATEPART)
+        tables = tables.at[:, 0].set(a_tid)
+        keys = keys.at[:, 0].set(a_key)
+        is_read = is_read.at[:, 0].set(True)
+        is_write = is_write.at[:, 0].set(a_write)
+        valid = valid.at[:, 0].set(True)
+
+        # accesses 1..per: USES/SUPPLIES mapping rows (reads);
+        # recon: gather the referenced part keys from the snapshot
+        lane = jnp.arange(per)
+        map_key = jnp.where(by_prod[:, None], q.product_key[:, None],
+                            q.supplier_key[:, None]) * per + lane[None, :]
+        map_tid = jnp.where(by_prod, TID["USES"], TID["SUPPLIES"])
+        part_keys = jnp.where(
+            by_prod[:, None],
+            jnp.take(db["USES"].columns["PART_KEY"], map_key, axis=0),
+            jnp.take(db["SUPPLIES"].columns["PART_KEY"], map_key, axis=0))
+        wmask = walks[:, None] & jnp.ones((n, per), bool)
+        tables = tables.at[:, 1:1 + per].set(map_tid[:, None])
+        keys = keys.at[:, 1:1 + per].set(map_key)
+        is_read = is_read.at[:, 1:1 + per].set(wmask)
+        valid = valid.at[:, 1:1 + per].set(wmask)
+
+        # accesses 1+per..1+2*per: resolved part rows
+        pw = (t == ORDERPRODUCT)[:, None] & wmask
+        tables = tables.at[:, 1 + per:1 + 2 * per].set(TID["PARTS"])
+        keys = keys.at[:, 1 + per:1 + 2 * per].set(part_keys)
+        is_read = is_read.at[:, 1 + per:1 + 2 * per].set(wmask)
+        is_write = is_write.at[:, 1 + per:1 + 2 * per].set(pw)
+        valid = valid.at[:, 1 + per:1 + 2 * per].set(wmask)
+
+        return dict(table_ids=tables, keys=keys, is_read=is_read,
+                    is_write=is_write, valid=valid)
+
+    # -- execution ------------------------------------------------------
+    def execute(self, db, q: PPSQuery, mask: jax.Array, order: jax.Array,
+                stats: dict):
+        db = dict(db)
+        t = q.txn_type
+        per = self.per
+        n = t.shape[0]
+
+        # reads feed the checksum (anchor row field)
+        anchor_amt = db["PARTS"].gather(q.part_key, ("PART_AMOUNT",))[
+            "PART_AMOUNT"]
+        stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
+            jnp.where(mask & (t == GETPART), anchor_amt, 0)
+        ).astype(jnp.uint32)
+
+        # ORDERPRODUCT: PART_AMOUNT -= 1 on each part of the product
+        om = mask & (t == ORDERPRODUCT)
+        lane = jnp.arange(per)
+        ukey = q.product_key[:, None] * per + lane[None, :]
+        parts = jnp.take(db["USES"].columns["PART_KEY"], ukey, axis=0)
+        m2 = om[:, None] & jnp.ones((n, per), bool)
+        db["PARTS"] = db["PARTS"].scatter_add(
+            parts.reshape(-1),
+            {"PART_AMOUNT": jnp.where(m2, -1, 0).reshape(-1)},
+            mask=m2.reshape(-1))
+
+        # UPDATEPART: PART_AMOUNT += 100 (run_updatepart_1)
+        um = mask & (t == UPDATEPART)
+        db["PARTS"] = db["PARTS"].scatter_add(
+            q.part_key, {"PART_AMOUNT": jnp.where(um, 100, 0)}, mask=um)
+
+        # UPDATEPRODUCTPART: product's part field = part_key
+        # (run_updateproductpart_1 set_value(1, part_key))
+        pm = mask & (t == UPDATEPRODUCTPART)
+        win = last_writer(jnp.where(pm, q.product_key,
+                                    db["PRODUCTS"].capacity),
+                          order, pm, db["PRODUCTS"].capacity)
+        db["PRODUCTS"] = db["PRODUCTS"].scatter(
+            q.product_key, {"PRODUCT_PART": q.part_key}, mask=win)
+
+        stats["write_cnt"] = stats["write_cnt"] + (
+            (om.sum() * per) + um.sum() + pm.sum()).astype(jnp.uint32)
+        return db
+
+
+def _map_part(anchor, j, salt, n_parts) -> np.ndarray:
+    """Deterministic anchor->part mapping for USES/SUPPLIES (the
+    reference loader draws URand parts, pps_wl.cpp threadInitUses)."""
+    h = (np.asarray(anchor).astype(np.int64) * 1000003 + np.asarray(j) * 7919
+         + salt * 104729) % 2654435761
+    return (h % n_parts).astype(np.int32)

@@ -47,8 +47,7 @@ import numpy as np
 from deneva_tpu.config import Config
 from deneva_tpu.ops import last_writer
 from deneva_tpu.storage.catalog import parse_schema
-from deneva_tpu.storage.index import DenseIndex
-from deneva_tpu.storage.table import DeviceTable
+from deneva_tpu.storage.table import DeviceTable, fill_columns
 
 # ---------------------------------------------------------------------------
 # schema (column set of benchmarks/TPCC_short_schema.txt)
@@ -144,6 +143,9 @@ class TPCCWorkload:
         self.cust_per_dist = cfg.cust_per_dist
         self.max_items = cfg.max_items
         self.ipt = cfg.max_items_per_txn     # MAX_ITEMS_PER_TXN=15 (config.h:189)
+        # effective lastname population: every district must contain at
+        # least one customer per lastname for the closed-form lookup
+        self.lastnames = min(_LASTNAMES, self.cust_per_dist)
         need = 3 + self.ipt                  # wh + dist + cust + stock rows
         if cfg.max_accesses < need:
             raise ValueError(
@@ -182,14 +184,14 @@ class TPCCWorkload:
 
         wh = tab("WAREHOUSE", self.n_wh)
         w_ids = np.arange(self.n_wh, dtype=np.int32)
-        db["WAREHOUSE"] = _fill(wh, self.n_wh, {
+        db["WAREHOUSE"] = fill_columns(wh, self.n_wh, {
             "W_ID": w_ids,
             "W_TAX": _rand01(w_ids, 7) * 0.2,       # URand(0,.2) (init_wh)
             "W_YTD": np.full(self.n_wh, 300000.0, np.float32)})
 
         dist = tab("DISTRICT", self.n_districts)
         d_ids = np.arange(self.n_districts, dtype=np.int32)
-        db["DISTRICT"] = _fill(dist, self.n_districts, {
+        db["DISTRICT"] = fill_columns(dist, self.n_districts, {
             "D_ID": d_ids % self.n_dist,
             "D_W_ID": d_ids // self.n_dist,
             "D_TAX": _rand01(d_ids, 11) * 0.2,
@@ -199,11 +201,11 @@ class TPCCWorkload:
         cust = tab("CUSTOMER", self.n_cust)
         c_ids = np.arange(self.n_cust, dtype=np.int32)
         c_local = c_ids % self.cust_per_dist
-        db["CUSTOMER"] = _fill(cust, self.n_cust, {
+        db["CUSTOMER"] = fill_columns(cust, self.n_cust, {
             "C_ID": c_local,
             "C_D_ID": (c_ids // self.cust_per_dist) % self.n_dist,
             "C_W_ID": c_ids // (self.cust_per_dist * self.n_dist),
-            "C_LAST": c_local % _LASTNAMES,
+            "C_LAST": c_local % self.lastnames,
             "C_DISCOUNT": _rand01(c_ids, 13) * 0.5,
             "C_BALANCE": np.full(self.n_cust, -10.0, np.float32),
             "C_YTD_PAYMENT": np.full(self.n_cust, 10.0, np.float32),
@@ -211,7 +213,7 @@ class TPCCWorkload:
 
         item = tab("ITEM", self.max_items)
         i_ids = np.arange(self.max_items, dtype=np.int32)
-        db["ITEM"] = _fill(item, self.max_items, {
+        db["ITEM"] = fill_columns(item, self.max_items, {
             "I_ID": i_ids,
             "I_IM_ID": (i_ids.astype(np.int64) * 2654435761 % 10000
                         ).astype(np.int32),
@@ -220,7 +222,7 @@ class TPCCWorkload:
 
         stock = tab("STOCK", self.n_stock)
         s_ids = np.arange(self.n_stock, dtype=np.int32)
-        db["STOCK"] = _fill(stock, self.n_stock, {
+        db["STOCK"] = fill_columns(stock, self.n_stock, {
             "S_I_ID": s_ids % self.max_items,
             "S_W_ID": s_ids // self.max_items,
             "S_QUANTITY": (10 + s_ids * 69621 % 91).astype(np.int32),
@@ -230,7 +232,8 @@ class TPCCWorkload:
         tab("HISTORY", cap, ring=True)
         tab("ORDER", cap, ring=True)
         tab("NEW-ORDER", cap, ring=True)
-        tab("ORDER-LINE", cap * 2, ring=True)
+        # lines wrap no earlier than their orders (<= ipt lines per order)
+        tab("ORDER-LINE", cap * self.ipt, ring=True)
         return db
 
     # -- generation (tpcc_query.cpp:144-260) ----------------------------
@@ -250,14 +253,15 @@ class TPCCWorkload:
                            jax.random.randint(ks[5], (n,), 0, self.n_dist),
                            d_id)
 
-        # by-last-name 60% resolves to the middle same-lastname customer
+        # by-last-name 60% resolves to the middle same-lastname customer:
+        # customers with lastname L are {L, L+names, L+2*names, ...}
         by_last = jax.random.bernoulli(ks[6], 0.6, (n,))
-        lastname = _nurand(ks[7], 255, _LASTNAMES, (n,))
-        per_name = max(self.cust_per_dist // _LASTNAMES, 1)
-        mid = lastname + _LASTNAMES * (per_name // 2)
+        names = self.lastnames
+        lastname = _nurand(ks[7], 255, names, (n,))
+        per_name = self.cust_per_dist // names
+        mid = lastname + names * (per_name // 2)
         c_direct = _nurand(ks[8], 1023, self.cust_per_dist, (n,))
-        c_id = jnp.where(by_last & is_pay,
-                         jnp.minimum(mid, self.cust_per_dist - 1), c_direct)
+        c_id = jnp.where(by_last & is_pay, mid, c_direct)
 
         h_amount = jax.random.uniform(ks[9], (n,), jnp.float32, 1.0, 5000.0)
 
@@ -276,7 +280,7 @@ class TPCCWorkload:
         quantity = jax.random.randint(kq, (n, I), 1, 11)
         kr1, kr2 = jax.random.split(kr)
         rem_item = (jax.random.bernoulli(kr1, 0.01, (n, I))
-                    & jax.random.bernoulli(kr2, cfg.mpr, (n, 1))
+                    & jax.random.bernoulli(kr2, cfg.mpr_neworder, (n, 1))
                     & (self.n_wh > 1))
         rsup = jax.random.randint(kw, (n, I), 0, max(self.n_wh - 1, 1))
         rsup = jnp.where(rsup >= w_id[:, None], rsup + 1, rsup)
@@ -360,6 +364,9 @@ class TPCCWorkload:
             {"H_C_ID": q.c_id, "H_C_D_ID": q.c_d_id, "H_C_W_ID": q.c_w_id,
              "H_D_ID": q.d_id, "H_W_ID": q.w_id, "H_AMOUNT": q.h_amount}, m)
         db["HISTORY"] = hist
+        # W_YTD + D_YTD + 3 customer cols + HISTORY row per payment
+        stats["write_cnt"] = stats["write_cnt"] + \
+            (m.sum() * 6).astype(jnp.uint32)
         return db
 
     def _exec_neworder(self, db, q, m, order, stats):
@@ -448,10 +455,3 @@ def _rand01(ids: np.ndarray, salt: int) -> np.ndarray:
     h = (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
          + np.uint64(salt)) & np.uint64(0xFFFFFFFF)
     return (h / np.float64(2**32)).astype(np.float32)
-
-
-def _fill(tab: DeviceTable, n: int, cols: dict) -> DeviceTable:
-    out = dict(tab.columns)
-    for name, v in cols.items():
-        out[name] = out[name].at[:n].set(jnp.asarray(v, out[name].dtype))
-    return tab._replace(columns=out, row_cnt=jnp.int32(n))

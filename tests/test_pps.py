@@ -1,0 +1,105 @@
+"""PPS workload: loader, mix distribution, recon-path correctness
+(planned part accesses must equal the snapshot USES mapping), and
+PART_AMOUNT accounting across ORDERPRODUCT/UPDATEPART."""
+
+import numpy as np
+import jax
+import pytest
+
+from deneva_tpu.config import CCAlg, Config, WorkloadKind
+from deneva_tpu.engine import Engine
+from deneva_tpu.workloads import get_workload
+from deneva_tpu.workloads.pps import (
+    GETPARTBYPRODUCT, ORDERPRODUCT, TID, UPDATEPART, UPDATEPRODUCTPART)
+
+
+def pps_cfg(**kw):
+    base = dict(workload=WorkloadKind.PPS, pps_parts_cnt=500,
+                pps_products_cnt=100, pps_suppliers_cnt=100, pps_parts_per=4,
+                max_accesses=9, epoch_batch=64, conflict_buckets=1024,
+                max_txn_in_flight=256, warmup_secs=0.0, done_secs=0.2)
+    base.update(kw)
+    if "cc_alg" in base:
+        base["cc_alg"] = CCAlg(base["cc_alg"])
+    return Config(**base)
+
+
+def test_loader_and_mapping():
+    cfg = pps_cfg()
+    wl = get_workload(cfg)
+    db = wl.load()
+    assert set(db) == {"PARTS", "PRODUCTS", "SUPPLIERS", "USES", "SUPPLIES"}
+    assert int(db["USES"].row_cnt) == 100 * 4
+    pk = db["USES"].host_column("PART_KEY")
+    assert pk.min() >= 0 and pk.max() < 500
+    assert (db["PARTS"].host_column("PART_AMOUNT") == 10000).all()
+
+
+def test_mix_distribution():
+    cfg = pps_cfg(perc_getpartbyproduct=0.5, perc_orderproduct=0.25,
+                  perc_updateproductpart=0.25, perc_updatepart=0.0)
+    wl = get_workload(cfg)
+    q = jax.device_get(wl.generate(jax.random.PRNGKey(1), 8192))
+    frac = np.bincount(q.txn_type, minlength=8) / 8192
+    assert abs(frac[GETPARTBYPRODUCT] - 0.5) < 0.05
+    assert abs(frac[ORDERPRODUCT] - 0.25) < 0.04
+    assert abs(frac[UPDATEPRODUCTPART] - 0.25) < 0.04
+    assert frac[UPDATEPART] == 0
+
+
+def test_recon_plan_matches_snapshot():
+    """plan() must declare exactly the part rows the USES snapshot maps:
+    the reference's sequencer recon-restart (system/sequencer.cpp:88-115)
+    collapsed into one gather."""
+    cfg = pps_cfg()
+    wl = get_workload(cfg)
+    db = wl.load()
+    q = wl.generate(jax.random.PRNGKey(2), 64)
+    p = jax.device_get(wl.plan(db, q))
+    qh = jax.device_get(q)
+    uses = db["USES"].host_column("PART_KEY")
+    per = cfg.pps_parts_per
+    for i in np.where(qh.txn_type == GETPARTBYPRODUCT)[0]:
+        want = uses[qh.product_key[i] * per:(qh.product_key[i] + 1) * per]
+        got = p["keys"][i, 1 + per:1 + 2 * per]
+        np.testing.assert_array_equal(np.sort(got), np.sort(want))
+        assert p["table_ids"][i, 1 + per] == TID["PARTS"]
+        assert not p["is_write"][i, 1 + per:1 + 2 * per].any()
+    for i in np.where(qh.txn_type == ORDERPRODUCT)[0]:
+        assert p["is_write"][i, 1 + per:1 + 2 * per].all()
+
+
+@pytest.mark.parametrize("alg", ["NOCC", "OCC", "TPU_BATCH", "CALVIN"])
+def test_pps_runs_and_commits(alg):
+    cfg = pps_cfg(cc_alg=alg)
+    eng = Engine(cfg, get_workload(cfg))
+    state = eng.init_state(0)
+    state = eng.jit_run(state, 25)
+    stats = jax.device_get(state.stats)
+    assert int(stats["total_txn_commit_cnt"]) > 0
+
+
+def _amount_delta(cfg, epochs=20):
+    wl = get_workload(cfg)
+    eng = Engine(cfg, wl)
+    state = eng.init_state(3)
+    a0 = wl.load()["PARTS"].host_column("PART_AMOUNT").astype(np.int64).sum()
+    state = eng.jit_run(state, epochs)
+    st = jax.device_get(state)
+    a1 = np.asarray(st.db["PARTS"].columns["PART_AMOUNT"])[
+        :cfg.pps_parts_cnt].astype(np.int64).sum()
+    return a1 - a0, int(st.stats["total_txn_commit_cnt"])
+
+
+def test_part_amount_accounting():
+    """Exact accounting per txn type (pure mixes so the audit is exact):
+    UPDATEPART adds 100/commit; ORDERPRODUCT subtracts parts_per/commit."""
+    delta, commits = _amount_delta(pps_cfg(
+        cc_alg="TPU_BATCH", perc_getpartbyproduct=0.0, perc_orderproduct=0.0,
+        perc_updateproductpart=0.0, perc_updatepart=1.0))
+    assert commits > 0 and delta == 100 * commits
+
+    delta, commits = _amount_delta(pps_cfg(
+        cc_alg="TPU_BATCH", perc_getpartbyproduct=0.0, perc_orderproduct=1.0,
+        perc_updateproductpart=0.0, perc_updatepart=0.0))
+    assert commits > 0 and delta == -4 * commits
