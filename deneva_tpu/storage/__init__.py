@@ -13,4 +13,4 @@ owned by `deneva_tpu.cc`, not inside the row (the reference's
 
 from deneva_tpu.storage.catalog import Catalog, TableSchema, Column, parse_schema  # noqa: F401
 from deneva_tpu.storage.table import DeviceTable  # noqa: F401
-from deneva_tpu.storage.index import DenseIndex, HashIndex  # noqa: F401
+from deneva_tpu.storage.index import DenseIndex, HashIndex, SortedIndex  # noqa: F401

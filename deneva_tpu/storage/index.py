@@ -116,6 +116,101 @@ class HashIndex:
         return found
 
 
+@dataclass
+class SortedIndex:
+    """Ordered key -> slot index (reference `storage/index_btree.{h,cpp}`,
+    `INDEX_STRUCT=IDX_BTREE`, `system/global.h:320-324`).
+
+    The reference's latched B+-tree (`index_btree.cpp:21`, fanout
+    `BTREE_ORDER`) exists to give ordered probes + range scans under
+    per-node latches.  On TPU the idiomatic ordered index is a *sorted
+    array* probed with vectorized binary search (`jnp.searchsorted` lowers
+    to a fully parallel O(log n) ladder — the whole epoch probes at once,
+    no latches needed because mutation happens between epochs).  Range
+    scans return a fixed-width padded window, keeping shapes static for
+    XLA.
+
+    Supports nonunique keys (reference `index_btree` via `itemid_t`
+    chains): ``lookup`` returns the *first* matching slot,
+    ``lookup_count`` the run length, ``range_slots`` a padded window of
+    row slots starting at the match.
+    """
+
+    keys: jax.Array        # int32[n] ascending (duplicates allowed)
+    slots: jax.Array       # int32[n] row slot per key entry
+    # -- static --
+    n: int
+    miss_slot: int
+
+    @classmethod
+    def build(cls, keys: np.ndarray, slots: np.ndarray,
+              miss_slot: int) -> "SortedIndex":
+        keys = np.asarray(keys, np.int32)
+        slots = np.asarray(slots, np.int32)
+        assert keys.ndim == 1 and keys.shape == slots.shape
+        order = np.argsort(keys, kind="stable")
+        return cls(keys=jnp.asarray(keys[order]),
+                   slots=jnp.asarray(slots[order]),
+                   n=int(len(keys)), miss_slot=miss_slot)
+
+    def _lower(self, q: jax.Array) -> jax.Array:
+        return jnp.searchsorted(self.keys, q.astype(jnp.int32),
+                                side="left").astype(jnp.int32)
+
+    def lookup(self, q: jax.Array) -> jax.Array:
+        """First slot whose key == q; misses -> miss_slot."""
+        if self.n == 0:
+            return jnp.full(jnp.shape(q), jnp.int32(self.miss_slot))
+        lo = jnp.clip(self._lower(q), 0, self.n - 1)
+        hit = jnp.take(self.keys, lo) == q.astype(jnp.int32)
+        return jnp.where(hit, jnp.take(self.slots, lo),
+                         jnp.int32(self.miss_slot))
+
+    def lookup_count(self, q: jax.Array) -> jax.Array:
+        """Number of entries with key == q (nonunique support)."""
+        q = q.astype(jnp.int32)
+        lo = self._lower(q)
+        hi = jnp.searchsorted(self.keys, q, side="right").astype(jnp.int32)
+        return hi - lo
+
+    def _window(self, q_lo: jax.Array, width: int
+                ) -> tuple[jax.Array, jax.Array, jax.Array]:
+        """(clipped positions, slots, in-bounds mask) of the ``width`` index
+        entries with key >= q_lo — the shared leaf-walk of both scans."""
+        start = self._lower(q_lo)
+        pos = start[..., None] + jnp.arange(width, dtype=jnp.int32)
+        ok = pos < self.n
+        pos = jnp.clip(pos, 0, self.n - 1)
+        slots = jnp.where(ok, jnp.take(self.slots, pos),
+                          jnp.int32(self.miss_slot))
+        return pos, slots, ok
+
+    def _empty_window(self, q_lo: jax.Array, width: int
+                      ) -> tuple[jax.Array, jax.Array]:
+        shape = jnp.shape(q_lo) + (width,)
+        return (jnp.full(shape, jnp.int32(self.miss_slot)),
+                jnp.zeros(shape, bool))
+
+    def range_slots(self, q_lo: jax.Array, width: int) -> tuple[jax.Array, jax.Array]:
+        """Padded ordered scan: the ``width`` index entries with key >= q_lo
+        (reference B+-tree leaf walk).  Returns (slots[..., width],
+        valid[..., width]); entries past the end are miss_slot/invalid."""
+        if self.n == 0:
+            return self._empty_window(q_lo, width)
+        _, slots, ok = self._window(q_lo, width)
+        return slots, ok
+
+    def range_between(self, q_lo: jax.Array, q_hi: jax.Array, width: int
+                      ) -> tuple[jax.Array, jax.Array]:
+        """Padded scan of keys in [q_lo, q_hi]; width caps the window."""
+        if self.n == 0:
+            return self._empty_window(q_lo, width)
+        pos, slots, ok = self._window(q_lo, width)
+        inside = ok & (jnp.take(self.keys, pos)
+                       <= q_hi.astype(jnp.int32)[..., None])
+        return jnp.where(inside, slots, jnp.int32(self.miss_slot)), inside
+
+
 def _hash_np(k: np.ndarray, cap: int) -> np.ndarray:
     return ((k.astype(np.uint32) * _MULT) >> np.uint32(16)).astype(np.int64) & (cap - 1)
 
@@ -129,4 +224,10 @@ jax.tree_util.register_dataclass(
     HashIndex,
     data_fields=["keys", "slots"],
     meta_fields=["cap", "max_probe", "miss_slot"],
+)
+
+jax.tree_util.register_dataclass(
+    SortedIndex,
+    data_fields=["keys", "slots"],
+    meta_fields=["n", "miss_slot"],
 )

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from deneva_tpu.storage import (Catalog, DenseIndex, DeviceTable, HashIndex,
-                                parse_schema)
+                                SortedIndex, parse_schema)
 
 YCSB_SCHEMA = """\
 //size, type, name
@@ -108,3 +108,58 @@ def test_hash_index_rejects_duplicates():
     with pytest.raises(ValueError):
         HashIndex.build(np.array([5, 5], np.int32), np.array([0, 1], np.int32),
                         miss_slot=0)
+
+
+def test_sorted_index_lookup_and_misses():
+    keys = np.array([40, 10, 30, 20], np.int32)
+    slots = np.array([4, 1, 3, 2], np.int32)
+    idx = SortedIndex.build(keys, slots, miss_slot=99)
+    out = np.asarray(idx.lookup(jnp.array([10, 20, 30, 40, 25, 5, 50])))
+    np.testing.assert_array_equal(out, [1, 2, 3, 4, 99, 99, 99])
+
+
+def test_sorted_index_nonunique_first_and_count():
+    # nonunique keys: reference index_btree via itemid_t chains
+    keys = np.array([7, 7, 7, 9], np.int32)
+    slots = np.array([0, 1, 2, 3], np.int32)
+    idx = SortedIndex.build(keys, slots, miss_slot=-1)
+    assert int(idx.lookup(jnp.array(7))) == 0  # stable: first inserted
+    np.testing.assert_array_equal(
+        np.asarray(idx.lookup_count(jnp.array([7, 9, 8]))), [3, 1, 0])
+
+
+def test_sorted_index_range_scan_padded():
+    keys = np.arange(0, 100, 10, dtype=np.int32)          # 0,10,...,90
+    slots = np.arange(10, dtype=np.int32)
+    idx = SortedIndex.build(keys, slots, miss_slot=-1)
+    s, ok = idx.range_slots(jnp.array([35]), width=4)     # keys 40,50,60,70
+    np.testing.assert_array_equal(np.asarray(s)[0], [4, 5, 6, 7])
+    assert bool(np.all(np.asarray(ok)[0]))
+    # past-the-end padding
+    s, ok = idx.range_slots(jnp.array([85]), width=4)     # only 90 remains
+    np.testing.assert_array_equal(np.asarray(ok)[0], [True, False, False, False])
+    np.testing.assert_array_equal(np.asarray(s)[0], [9, -1, -1, -1])
+
+
+def test_sorted_index_empty_returns_misses():
+    idx = SortedIndex.build(np.array([], np.int32), np.array([], np.int32),
+                            miss_slot=99)
+    np.testing.assert_array_equal(np.asarray(idx.lookup(jnp.array([1, 2]))),
+                                  [99, 99])
+    np.testing.assert_array_equal(np.asarray(idx.lookup_count(jnp.array([1]))),
+                                  [0])
+    s, ok = idx.range_slots(jnp.array([0]), width=3)
+    np.testing.assert_array_equal(np.asarray(s)[0], [99, 99, 99])
+    assert not np.any(np.asarray(ok))
+    s, ok = idx.range_between(jnp.array([0]), jnp.array([5]), width=3)
+    assert not np.any(np.asarray(ok))
+
+
+def test_sorted_index_range_between():
+    keys = np.arange(0, 100, 10, dtype=np.int32)
+    slots = np.arange(10, dtype=np.int32)
+    idx = SortedIndex.build(keys, slots, miss_slot=-1)
+    s, ok = idx.range_between(jnp.array([20]), jnp.array([45]), width=8)
+    np.testing.assert_array_equal(np.asarray(ok)[0],
+                                  [True, True, True, False, False, False, False, False])
+    np.testing.assert_array_equal(np.asarray(s)[0][:3], [2, 3, 4])
