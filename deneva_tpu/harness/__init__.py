@@ -1,0 +1,23 @@
+"""Experiment harness (reference `scripts/`, SURVEY §2.9).
+
+The reference drives everything from Python: `experiments.py` maps an
+experiment name to a list of config permutations, `run_experiments.py`
+rewrites `config.h`, recompiles and launches per point, and
+`parse_results.py` / `latency_stats.py` regex the `[summary]` lines back
+into tables.  Here configs are runtime values, so an experiment is simply
+``name -> list[Config]``; no recompiles, one process.
+
+Public surface:
+
+* `experiment_map` / `get_experiment(name, quick=...)` — named sweeps
+  (`deneva_tpu.harness.experiments`).
+* `run_experiment(name, out_dir=...)` — execute every point, write one
+  output file per point (`deneva_tpu.harness.run`), return parsed rows.
+* `parse` — `[summary]`-line parsing + result-table assembly
+  (`deneva_tpu.harness.parse`).
+"""
+
+from deneva_tpu.harness.experiments import experiment_map, get_experiment  # noqa: F401
+from deneva_tpu.harness.parse import (load_results, outfile_name,  # noqa: F401
+                                      parse_file, results_table)
+from deneva_tpu.harness.run import run_experiment  # noqa: F401

@@ -1,0 +1,142 @@
+"""Named experiment sweeps (reference `scripts/experiments.py:51-300`).
+
+Each experiment is a function returning ``list[Config]``.  The reference
+encodes sweeps as dict permutations rewritten into `config.h`
+(`scripts/run_experiments.py:83-96`); here they are plain `Config.replace`
+chains over a base config that mirrors the paper defaults
+(`scripts/experiments.py:346-420`), scaled by a ``quick`` factor so the
+same definitions serve CI smoke runs and real benchmark runs.
+
+The reference's node-count axis (1-64 server nodes) maps to the keyspace
+``part_cnt``: partitions are the unit the conflict matmul contracts over
+and what a multi-chip mesh shards (SURVEY §2.10, §7) — scaling table size
+with partition count exactly like `ycsb_scaling` scales 16M rows/node.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from deneva_tpu.config import CCAlg, Config
+
+# the six algorithms the paper sweeps (README:24-35) + the TPU backend
+PAPER_ALGS = ("NO_WAIT", "WAIT_DIE", "TIMESTAMP", "MVCC", "OCC", "MAAT",
+              "CALVIN")
+ALL_ALGS = PAPER_ALGS + ("TPU_BATCH",)
+
+
+def paper_base(quick: bool) -> Config:
+    """Paper defaults (`scripts/experiments.py:346-420`): 16M rows/part,
+    10 req/txn, 50% writes, TIF 10000, 1min+1min windows — divided down
+    for quick mode."""
+    if quick:
+        return Config(
+            synth_table_size=1 << 14, req_per_query=4, max_accesses=4,
+            epoch_batch=128, conflict_buckets=512, max_txn_in_flight=1024,
+            warmup_secs=0.2, done_secs=0.5)
+    return Config(
+        synth_table_size=2097152 * 8, req_per_query=10, max_accesses=16,
+        epoch_batch=2048, conflict_buckets=8192, max_txn_in_flight=10000,
+        warmup_secs=10.0, done_secs=30.0)
+
+
+def _alg_sweep(base: Config, algs=ALL_ALGS) -> list[Config]:
+    return [base.replace(cc_alg=CCAlg(a)) for a in algs]
+
+
+def ycsb_scaling(quick: bool) -> list[Config]:
+    """`scripts/experiments.py:61-76`: partition scaling, table grows with
+    part count, zipf 0.6."""
+    base = paper_base(quick).replace(zipf_theta=0.6)
+    parts = (1, 2, 4) if quick else (1, 2, 4, 8)
+    out = []
+    for n in parts:
+        b = base.replace(part_cnt=n, node_cnt=n,
+                         synth_table_size=base.synth_table_size * n,
+                         conflict_buckets=base.conflict_buckets * n)
+        out.extend(_alg_sweep(b))
+    return out
+
+
+def ycsb_skew(quick: bool) -> list[Config]:
+    """`scripts/experiments.py` ycsb_skew: zipf sweep at fixed size."""
+    base = paper_base(quick)
+    thetas = (0.0, 0.6, 0.9) if quick else (0.0, 0.3, 0.6, 0.7, 0.8, 0.9)
+    return [c for t in thetas for c in _alg_sweep(base.replace(zipf_theta=t))]
+
+
+def ycsb_writes(quick: bool) -> list[Config]:
+    """Write-fraction sweep (paper fig: update rate)."""
+    base = paper_base(quick).replace(zipf_theta=0.6)
+    fr = (0.0, 0.5, 1.0) if quick else (0.0, 0.2, 0.5, 0.8, 1.0)
+    return [c for w in fr
+            for c in _alg_sweep(base.replace(read_perc=1 - w, write_perc=w))]
+
+
+def ycsb_partitions(quick: bool) -> list[Config]:
+    """`scripts/experiments.py` ycsb_partitions: parts-per-txn sweep."""
+    base = paper_base(quick).replace(part_cnt=4, node_cnt=4, mpr=1.0)
+    ppt = (1, 2, 4) if quick else (1, 2, 4)
+    return [c for p in ppt for c in _alg_sweep(base.replace(part_per_txn=p))]
+
+
+def ycsb_inflight(quick: bool) -> list[Config]:
+    """TIF sweep (client admission pressure; `MAX_TXN_IN_FLIGHT`)."""
+    base = paper_base(quick).replace(zipf_theta=0.6)
+    tifs = (256, 1024) if quick else (1000, 10000, 100000)
+    return [c for t in tifs
+            for c in _alg_sweep(base.replace(max_txn_in_flight=t))]
+
+
+def isolation_levels(quick: bool) -> list[Config]:
+    """`scripts/experiments.py` isolation_levels: NO_WAIT at four levels."""
+    base = paper_base(quick).replace(zipf_theta=0.6, cc_alg=CCAlg.NO_WAIT)
+    return [base.replace(isolation_level=lvl)
+            for lvl in ("SERIALIZABLE", "READ_COMMITTED", "READ_UNCOMMITTED",
+                        "NOLOCK")]
+
+
+def tpcc_scaling(quick: bool) -> list[Config]:
+    """`scripts/experiments.py:188-235`: warehouse scaling × payment mix."""
+    base = paper_base(quick).replace(workload="TPCC", max_accesses=32)
+    whs = (4,) if quick else (4, 16, 64)
+    percs = (0.0, 0.5, 1.0)
+    return [c for wh in whs for p in percs
+            for c in _alg_sweep(base.replace(num_wh=wh, perc_payment=p))]
+
+
+def pps_scaling(quick: bool) -> list[Config]:
+    """`scripts/experiments.py:51-59`: PPS default mix."""
+    base = paper_base(quick).replace(workload="PPS", max_accesses=32)
+    if quick:
+        base = base.replace(pps_parts_cnt=1024, pps_products_cnt=256,
+                            pps_suppliers_cnt=256, pps_parts_per=4,
+                            max_accesses=16)
+    return _alg_sweep(base)
+
+
+def modes(quick: bool) -> list[Config]:
+    """Degraded-mode oracles (SURVEY §4.2): layer-isolation bounds."""
+    base = paper_base(quick).replace(zipf_theta=0.6, cc_alg=CCAlg.TPU_BATCH)
+    return [base.replace(mode=m)
+            for m in ("SIMPLE", "NOCC", "QRY_ONLY", "NORMAL")]
+
+
+experiment_map: dict[str, Callable[[bool], list[Config]]] = {
+    "ycsb_scaling": ycsb_scaling,
+    "ycsb_skew": ycsb_skew,
+    "ycsb_writes": ycsb_writes,
+    "ycsb_partitions": ycsb_partitions,
+    "ycsb_inflight": ycsb_inflight,
+    "isolation_levels": isolation_levels,
+    "tpcc_scaling": tpcc_scaling,
+    "pps_scaling": pps_scaling,
+    "modes": modes,
+}
+
+
+def get_experiment(name: str, quick: bool = False) -> list[Config]:
+    if name not in experiment_map:
+        raise KeyError(
+            f"unknown experiment {name!r}; have {sorted(experiment_map)}")
+    return experiment_map[name](quick)

@@ -1,0 +1,131 @@
+"""Result parsing (reference `scripts/parse_results.py`, `latency_stats.py`,
+`scripts/helper.py` output-file naming).
+
+The reference regexes `[summary] k=v,...` lines out of per-run output
+files whose names encode the config via SHORTNAMES (`helper.py:59+`).
+Same contract here: `outfile_name` encodes the sweep-relevant fields,
+`parse_file` recovers the summary dict, `results_table` joins a directory
+of results into rows for plotting / regression checks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from typing import Any
+
+from deneva_tpu.config import Config
+from deneva_tpu.stats import parse_summary
+
+# config field -> short name in output files (reference SHORTNAMES)
+SHORTNAMES = {
+    "workload": "WL", "cc_alg": "CC", "mode": "MODE",
+    "node_cnt": "N", "part_cnt": "P", "zipf_theta": "SKEW",
+    "write_perc": "WR", "part_per_txn": "PPT",
+    "max_txn_in_flight": "TIF", "num_wh": "WH",
+    "perc_payment": "PAY", "isolation_level": "ISO",
+    "epoch_batch": "EB", "load_rate": "LR",
+}
+
+_DEFAULT = Config()
+
+
+def outfile_name(cfg: Config) -> str:
+    """Encode the non-default sweep fields into a filename stem.  Fields
+    outside SHORTNAMES that differ from the default fold into a short
+    hash suffix so two distinct configs never share a filename."""
+    parts = []
+    extra = []
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        if v == getattr(_DEFAULT, f.name):
+            continue
+        sv = v.value if hasattr(v, "value") else v
+        if f.name in SHORTNAMES:
+            if f.name not in ("workload", "cc_alg"):
+                parts.append(f"{SHORTNAMES[f.name]}-{sv}")
+        else:
+            extra.append(f"{f.name}={sv}")
+    if extra:
+        h = hashlib.sha1(";".join(extra).encode()).hexdigest()[:6]
+        parts.append(f"H-{h}")
+    wl = getattr(cfg.workload, "value", cfg.workload)
+    alg = getattr(cfg.cc_alg, "value", cfg.cc_alg)
+    return "_".join([wl, alg] + parts) + ".out"
+
+
+def _parse_lines(path: str) -> tuple[dict[str, Any], str | None]:
+    """One pass over an output file: (`# cfg` echo dict, last summary line)."""
+    cfg: dict[str, Any] = {}
+    summary = None
+    with open(path) as f:
+        for line in f:
+            if line.startswith("# cfg "):
+                k, v = line[len("# cfg "):].strip().split("=", 1)
+                cfg[k] = _auto(v)
+            elif "[summary]" in line:
+                summary = line
+    return cfg, summary
+
+
+def parse_file(path: str) -> dict[str, float] | None:
+    """Last `[summary]` line of one output file -> field dict (reference
+    `parse_results.py:19-38` takes the server summary the same way)."""
+    _, summary = _parse_lines(path)
+    return parse_summary(summary) if summary else None
+
+
+def load_results(out_dir: str, only: list[str] | None = None
+                 ) -> list[dict[str, Any]]:
+    """All parsed rows of a result directory, one dict per output file,
+    with the config echo (`# cfg key=value` header lines) merged in.
+    ``only`` restricts to a set of filenames (the runner passes the files
+    it just wrote, keeping stale points of earlier sweeps out)."""
+    rows = []
+    names = sorted(os.listdir(out_dir)) if only is None else sorted(only)
+    for name in names:
+        if not name.endswith(".out"):
+            continue
+        path = os.path.join(out_dir, name)
+        row: dict[str, Any] = {"file": name}
+        cfg, summary = _parse_lines(path)
+        row.update(cfg)
+        if summary:
+            row.update(parse_summary(summary))
+        rows.append(row)
+    return rows
+
+
+def results_table(out_dir: str, x: str, y: str = "tput",
+                  series: str = "cc_alg") -> dict[Any, list[tuple]]:
+    """Pivot rows into {series_value: [(x, y), ...]} — the shape
+    `scripts/plot.py` consumes."""
+    table: dict[Any, list[tuple]] = {}
+    for row in load_results(out_dir):
+        if x not in row or y not in row:
+            continue
+        table.setdefault(row.get(series), []).append((row[x], row[y]))
+    for pts in table.values():
+        pts.sort()
+    return table
+
+
+def cfg_header(cfg: Config) -> str:
+    """`# cfg key=value` echo lines the runner prepends to each output file
+    so parsing never has to re-derive the config from the filename."""
+    lines = []
+    for f in dataclasses.fields(cfg):
+        v = getattr(cfg, f.name)
+        sv = v.value if hasattr(v, "value") else v
+        lines.append(f"# cfg {f.name}={sv}")
+    return "\n".join(lines) + "\n"
+
+
+def _auto(v: str) -> Any:
+    for conv in (int, float):
+        try:
+            return conv(v)
+        except ValueError:
+            pass
+    return v

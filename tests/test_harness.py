@@ -1,0 +1,73 @@
+"""Experiment harness tests (SURVEY §2.9): experiment map, runner output
+files, `[summary]` parsing round-trip."""
+
+from deneva_tpu.config import CCAlg, Config, Mode
+from deneva_tpu.harness import (experiment_map, get_experiment, load_results,
+                                outfile_name, parse_file, results_table)
+from deneva_tpu.harness.run import run_point
+
+
+def test_experiment_map_builds_valid_configs():
+    for name in experiment_map:
+        cfgs = get_experiment(name, quick=True)
+        assert cfgs, name
+        for cfg in cfgs:
+            assert isinstance(cfg, Config)
+            cfg.validate()
+
+
+def test_experiment_sweeps_cover_paper_axes():
+    skew = get_experiment("ycsb_skew", quick=True)
+    assert {c.zipf_theta for c in skew} == {0.0, 0.6, 0.9}
+    algs = {c.cc_alg for c in skew}
+    assert {CCAlg.NO_WAIT, CCAlg.WAIT_DIE, CCAlg.TIMESTAMP, CCAlg.MVCC,
+            CCAlg.OCC, CCAlg.MAAT, CCAlg.CALVIN, CCAlg.TPU_BATCH} <= algs
+    iso = get_experiment("isolation_levels", quick=True)
+    assert {c.isolation_level for c in iso} == {
+        "SERIALIZABLE", "READ_COMMITTED", "READ_UNCOMMITTED", "NOLOCK"}
+    scaling = get_experiment("ycsb_scaling", quick=True)
+    by_part = {c.part_cnt for c in scaling}
+    assert by_part == {1, 2, 4}
+    # table grows with part count like the reference's 16M rows/node
+    one = next(c for c in scaling if c.part_cnt == 1)
+    four = next(c for c in scaling if c.part_cnt == 4)
+    assert four.synth_table_size == 4 * one.synth_table_size
+
+
+def test_outfile_name_encodes_sweep_fields():
+    cfg = Config(zipf_theta=0.9, cc_alg=CCAlg.OCC)
+    name = outfile_name(cfg)
+    assert name.startswith("YCSB_OCC") and "SKEW-0.9" in name
+    assert name != outfile_name(cfg.replace(zipf_theta=0.8))
+    # fields outside SHORTNAMES must still change the name (hash suffix)
+    assert name != outfile_name(cfg.replace(seed=7))
+    assert name != outfile_name(cfg.replace(synth_table_size=1 << 10))
+
+
+def test_run_point_and_parse_roundtrip(tmp_path):
+    cfg = Config(
+        workload="YCSB", cc_alg=CCAlg.TPU_BATCH, mode=Mode.NORMAL,
+        synth_table_size=1 << 12, epoch_batch=64, conflict_buckets=256,
+        max_txn_in_flight=256, req_per_query=4, max_accesses=4,
+        warmup_secs=0.1, done_secs=0.3)
+    path = run_point(cfg, str(tmp_path))
+    fields = parse_file(path)
+    assert fields is not None and fields["total_txn_commit_cnt"] > 0
+    rows = load_results(str(tmp_path))
+    assert len(rows) == 1
+    row = rows[0]
+    # config echo merged in
+    assert row["cc_alg"] == "TPU_BATCH" and row["epoch_batch"] == 64
+    assert row["tput"] > 0
+    table = results_table(str(tmp_path), x="zipf_theta")
+    assert "TPU_BATCH" in table
+    x, y = table["TPU_BATCH"][0]
+    assert x == 0.6 and y == row["tput"]
+
+
+def test_parse_file_none_when_no_summary(tmp_path):
+    p = tmp_path / "x.out"
+    p.write_text("# cfg cc_alg=OCC\n# run failed\n")
+    assert parse_file(str(p)) is None
+    rows = load_results(str(tmp_path))
+    assert rows[0]["cc_alg"] == "OCC" and "tput" not in rows[0]
