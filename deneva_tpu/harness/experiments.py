@@ -75,8 +75,9 @@ def ycsb_writes(quick: bool) -> list[Config]:
 
 def ycsb_partitions(quick: bool) -> list[Config]:
     """`scripts/experiments.py` ycsb_partitions: parts-per-txn sweep."""
-    base = paper_base(quick).replace(part_cnt=4, node_cnt=4, mpr=1.0)
-    ppt = (1, 2, 4) if quick else (1, 2, 4)
+    n = 4 if quick else 8
+    base = paper_base(quick).replace(part_cnt=n, node_cnt=n, mpr=1.0)
+    ppt = (1, 2, 4) if quick else (1, 2, 4, 8)
     return [c for p in ppt for c in _alg_sweep(base.replace(part_per_txn=p))]
 
 

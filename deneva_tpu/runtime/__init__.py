@@ -1,0 +1,11 @@
+"""Host runtime: native transport bindings + multi-process client/server
+execution (reference `transport/`, `client/`, `system/io_thread.cpp`).
+
+The compute path stays JAX/XLA on device; everything around it — sockets,
+message batching, IO threads, queues — is the C++ library under
+``native/`` (SURVEY §2 requires native runtime components, no Python
+stand-ins: Python here only *binds* the C API and orchestrates
+processes)."""
+
+from deneva_tpu.runtime.native import (NativeTransport, RTYPE,  # noqa: F401
+                                       ensure_built)

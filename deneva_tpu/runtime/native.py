@@ -1,0 +1,237 @@
+"""ctypes bindings for the native host runtime (``native/`` C++ library).
+
+pybind11 is not in the image, so the boundary is a plain C API
+(`native/include/deneva_host.h`) loaded with ctypes; numpy arrays cross
+zero-copy via ``ndarray.ctypes``.  The library is rebuilt on demand when
+sources are newer than the binary (the reference rebuilds per config via
+`scripts/run_experiments.py:83-96`; we rebuild only on source change —
+config is runtime state here).
+"""
+
+from __future__ import annotations
+
+import ctypes as C
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_NATIVE = os.path.join(_ROOT, "native")
+_LIB = os.path.join(_NATIVE, "build", "libdeneva_host.so")
+
+_lock = threading.Lock()
+_lib: C.CDLL | None = None
+
+RTYPE = {
+    "INIT_DONE": 1, "CL_QRY_BATCH": 2, "CL_RSP": 3, "RDONE": 4,
+    "EPOCH_BLOB": 5, "LOG_MSG": 6, "LOG_RSP": 7, "PING": 8, "PONG": 9,
+    "SHUTDOWN": 10,
+}
+RTYPE_NAME = {v: k for k, v in RTYPE.items()}
+
+STAT_NAMES = ("msg_sent", "msg_rcvd", "bytes_sent", "bytes_rcvd",
+              "batches_sent", "send_queue_depth", "recv_queue_depth")
+
+
+def ensure_built() -> str:
+    """Build ``libdeneva_host.so`` if missing/stale; return its path."""
+    srcs = [os.path.join(_NATIVE, "src", "transport.cc"),
+            os.path.join(_NATIVE, "src", "mpmc_queue.h"),
+            os.path.join(_NATIVE, "include", "deneva_host.h")]
+    stale = (not os.path.exists(_LIB)
+             or any(os.path.getmtime(s) > os.path.getmtime(_LIB)
+                    for s in srcs))
+    if stale:
+        proc = subprocess.run(["make", "-C", _NATIVE], capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"native build failed:\n{proc.stderr}")
+    return _LIB
+
+
+def _load() -> C.CDLL:
+    global _lib
+    with _lock:
+        if _lib is None:
+            lib = C.CDLL(ensure_built())
+            lib.dt_create.restype = C.c_void_p
+            lib.dt_create.argtypes = [C.c_uint32, C.c_char_p, C.c_uint32,
+                                      C.c_uint32, C.c_uint32]
+            lib.dt_start.restype = C.c_int
+            lib.dt_start.argtypes = [C.c_void_p, C.c_int]
+            lib.dt_send.restype = C.c_int
+            lib.dt_send.argtypes = [C.c_void_p, C.c_uint32, C.c_uint16,
+                                    C.c_void_p, C.c_uint32]
+            lib.dt_recv.restype = C.c_long
+            lib.dt_recv.argtypes = [C.c_void_p, C.c_void_p, C.c_uint32,
+                                    C.POINTER(C.c_uint32),
+                                    C.POINTER(C.c_uint16), C.c_long,
+                                    C.POINTER(C.c_uint32)]
+            lib.dt_set_delay_us.argtypes = [C.c_void_p, C.c_uint64]
+            lib.dt_stats.argtypes = [C.c_void_p, C.POINTER(C.c_uint64)]
+            lib.dt_ping.restype = C.c_long
+            lib.dt_ping.argtypes = [C.c_void_p, C.c_uint32, C.c_uint32,
+                                    C.c_uint32]
+            lib.dt_destroy.argtypes = [C.c_void_p]
+            lib.dt_qrybatch_encode.restype = C.c_long
+            lib.dt_qrybatch_encode.argtypes = [
+                C.c_uint32, C.c_uint32, C.c_uint32, C.c_void_p, C.c_void_p,
+                C.c_void_p, C.c_void_p, C.c_void_p, C.c_size_t]
+            lib.dt_qrybatch_decode.restype = C.c_long
+            lib.dt_qrybatch_decode.argtypes = [
+                C.c_void_p, C.c_size_t, C.POINTER(C.c_uint32),
+                C.POINTER(C.c_uint32), C.POINTER(C.c_uint32), C.c_void_p,
+                C.c_void_p, C.c_void_p, C.c_void_p, C.c_size_t]
+            _lib = lib
+    return _lib
+
+
+def ipc_endpoints(n_nodes: int, run_id: str, base_dir: str = "/tmp") -> str:
+    """Endpoint table for same-host IPC runs (`ifconfig.txt` +
+    `ipc://node_N.ipc`, `transport/transport.cpp:132-133`)."""
+    return "".join(f"{i} ipc {base_dir}/dt_{run_id}_n{i}.sock\n"
+                   for i in range(n_nodes))
+
+
+def tcp_endpoints(n_nodes: int, base_port: int = 17000,
+                  host: str = "127.0.0.1") -> str:
+    return "".join(f"{i} tcp {host}:{base_port + i}\n"
+                   for i in range(n_nodes))
+
+
+class NativeTransport:
+    """One node's handle on the mesh (reference `Transport`,
+    `transport/transport.cpp:171`)."""
+
+    def __init__(self, node_id: int, endpoints: str, n_nodes: int,
+                 msg_size_max: int = 4096, flush_timeout_us: int = 200):
+        self._lib = _load()
+        self._h = self._lib.dt_create(node_id, endpoints.encode(), n_nodes,
+                                      msg_size_max, flush_timeout_us)
+        if not self._h:
+            raise RuntimeError("dt_create failed (bad endpoint table?)")
+        self.node_id = node_id
+        self.n_nodes = n_nodes
+        self._recv_buf = np.empty(1 << 20, np.uint8)
+
+    def start(self, timeout_ms: int = 10000) -> None:
+        if self._lib.dt_start(self._h, timeout_ms) != 0:
+            raise RuntimeError(f"node {self.node_id}: mesh setup failed")
+
+    def send(self, dest: int, rtype: int | str, payload: bytes | np.ndarray
+             = b"") -> None:
+        if isinstance(rtype, str):
+            rtype = RTYPE[rtype]
+        buf = payload if isinstance(payload, bytes) else payload.tobytes()
+        rc = self._lib.dt_send(self._h, dest, rtype, buf, len(buf))
+        if rc != 0:
+            raise RuntimeError(f"send to {dest} failed")
+
+    def recv(self, timeout_us: int = -1) -> tuple[int, str, bytes] | None:
+        """(src, rtype_name, payload) or None on timeout."""
+        src = C.c_uint32()
+        rt = C.c_uint16()
+        need = C.c_uint32()
+        while True:
+            n = self._lib.dt_recv(
+                self._h, self._recv_buf.ctypes.data_as(C.c_void_p),
+                len(self._recv_buf), C.byref(src), C.byref(rt), timeout_us,
+                C.byref(need))
+            if n == -1:
+                return None
+            if n == -2:
+                self._recv_buf = np.empty(int(need.value) * 2, np.uint8)
+                continue
+            return (src.value, RTYPE_NAME.get(rt.value, str(rt.value)),
+                    bytes(self._recv_buf[:n].tobytes()))
+
+    def set_delay_us(self, us: int) -> None:
+        self._lib.dt_set_delay_us(self._h, us)
+
+    def stats(self) -> dict[str, int]:
+        out = (C.c_uint64 * len(STAT_NAMES))()
+        self._lib.dt_stats(self._h, out)
+        return dict(zip(STAT_NAMES, [int(v) for v in out]))
+
+    def ping(self, peer: int, rounds: int = 10) -> float:
+        """Mean round-trip in microseconds (NETWORK_TEST)."""
+        ns = self._lib.dt_ping(self._h, peer, rounds, 8)
+        if ns < 0:
+            raise RuntimeError(f"ping {peer} failed")
+        return ns / 1000.0
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.dt_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---- columnar query batches -------------------------------------------
+
+def encode_qrybatch(startts: np.ndarray, keys: np.ndarray,
+                    types: np.ndarray, scalars: np.ndarray | None = None
+                    ) -> bytes:
+    """CL_QRY batch -> wire bytes (columnar; server feeds these straight
+    into the device pool refill)."""
+    lib = _load()
+    n, width = keys.shape
+    startts = np.ascontiguousarray(startts, np.int64)
+    keys = np.ascontiguousarray(keys, np.int32)
+    types = np.ascontiguousarray(types, np.int8)
+    if scalars is None:
+        scalars = np.zeros((n, 0), np.int32)
+    scalars = np.ascontiguousarray(scalars, np.int32)
+    n_scalars = scalars.shape[1] if scalars.ndim == 2 else 0
+    need = lib.dt_qrybatch_encode(n, width, n_scalars, None, None, None,
+                                  None, None, 0)
+    out = np.empty(need, np.uint8)
+    rc = lib.dt_qrybatch_encode(
+        n, width, n_scalars,
+        startts.ctypes.data_as(C.c_void_p), keys.ctypes.data_as(C.c_void_p),
+        types.ctypes.data_as(C.c_void_p),
+        scalars.ctypes.data_as(C.c_void_p),
+        out.ctypes.data_as(C.c_void_p), need)
+    if rc < 0:
+        raise RuntimeError("qrybatch encode failed")
+    return out.tobytes()
+
+
+def decode_qrybatch(buf: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                                         np.ndarray]:
+    """Wire bytes -> (startts[n], keys[n,w], types[n,w], scalars[n,s])."""
+    lib = _load()
+    n = C.c_uint32()
+    w = C.c_uint32()
+    s = C.c_uint32()
+    rc = lib.dt_qrybatch_decode(buf, len(buf), C.byref(n), C.byref(w),
+                                C.byref(s), None, None, None, None, 0)
+    if rc < 0:
+        raise RuntimeError("qrybatch decode failed (truncated)")
+    N, W, S = int(n.value), int(w.value), int(s.value)
+    startts = np.empty(N, np.int64)
+    keys = np.empty((N, W), np.int32)
+    types = np.empty((N, W), np.int8)
+    scalars = np.empty((N, S), np.int32)
+    rc = lib.dt_qrybatch_decode(
+        buf, len(buf), C.byref(n), C.byref(w), C.byref(s),
+        startts.ctypes.data_as(C.c_void_p), keys.ctypes.data_as(C.c_void_p),
+        types.ctypes.data_as(C.c_void_p),
+        scalars.ctypes.data_as(C.c_void_p), N * W)
+    if rc < 0:
+        raise RuntimeError("qrybatch decode failed")
+    return startts, keys, types, scalars

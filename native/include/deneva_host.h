@@ -1,0 +1,132 @@
+/* C API of the native host runtime (reference `transport/`, SURVEY §2.6).
+ *
+ * The reference's communication backend is an N×N nanomsg PAIR mesh with
+ * per-send-thread batching mbufs (`transport/transport.cpp:171-304`,
+ * `transport/msg_thread.cpp:44-118`).  This library provides the same
+ * capability over raw sockets (TCP or Unix-domain; nanomsg is not in the
+ * image and adds nothing over length-framed streams):
+ *
+ *   - full mesh of stream sockets, one connection per peer pair,
+ *     established by a bind/connect handshake keyed on node id;
+ *   - length-framed binary messages with a fixed header
+ *     {len, rtype, flags, src};
+ *   - per-destination send batching up to msg_size_max bytes or a flush
+ *     timeout (the reference's mbuf, `transport/msg_thread.cpp:96-101`);
+ *   - a sender thread and a poll-based receiver thread feeding a bounded
+ *     MPMC queue (the reference's output/input threads,
+ *     `system/io_thread.cpp`);
+ *   - artificial send-delay injection (NETWORK_DELAY_TEST,
+ *     `system/msg_queue.cpp:104-125`) and a ping-pong self test
+ *     (NETWORK_TEST, `system/main.cpp:346-387`);
+ *   - monotonically increasing stats counters.
+ *
+ * Consumed from Python via ctypes (no pybind11 in the image); the Python
+ * side never touches sockets.
+ */
+
+#ifndef DENEVA_HOST_H
+#define DENEVA_HOST_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct dt_transport dt_transport;
+
+/* Message types on the wire (reference RemReqType, system/global.h:237-262).
+ * Payloads are opaque to the transport; the columnar query codecs below
+ * and the Python runtime define the bodies. */
+enum dt_rtype {
+  DT_INIT_DONE = 1,   /* setup barrier (reference INIT_DONE) */
+  DT_CL_QRY_BATCH = 2,/* columnar client query block (CL_QRY batch) */
+  DT_CL_RSP = 3,      /* per-txn client response (CL_RSP) */
+  DT_RDONE = 4,       /* epoch done marker (Calvin RDONE) */
+  DT_EPOCH_BLOB = 5,  /* server<->server epoch payload (RW-sets/verdicts) */
+  DT_LOG_MSG = 6,     /* replica log shipping (LOG_MSG) */
+  DT_LOG_RSP = 7,     /* replica ack (LOG_MSG_RSP) */
+  DT_PING = 8,        /* NETWORK_TEST ping */
+  DT_PONG = 9,        /* NETWORK_TEST pong */
+  DT_SHUTDOWN = 10,   /* orderly teardown */
+};
+
+/* Stats slot indices for dt_stats(). */
+enum dt_stat {
+  DT_STAT_MSG_SENT = 0,
+  DT_STAT_MSG_RCVD = 1,
+  DT_STAT_BYTES_SENT = 2,
+  DT_STAT_BYTES_RCVD = 3,
+  DT_STAT_BATCHES_SENT = 4,
+  DT_STAT_SEND_QUEUE_DEPTH = 5,
+  DT_STAT_RECV_QUEUE_DEPTH = 6,
+  DT_STAT_COUNT = 7
+};
+
+/* endpoints: n_nodes lines "node_id proto addr", e.g.
+ *   "0 ipc /tmp/dt_node0.sock\n1 tcp 127.0.0.1:17001\n"
+ * (the reference's ifconfig.txt, transport/transport.cpp:28-44).
+ * Returns NULL on parse error. */
+dt_transport *dt_create(uint32_t node_id, const char *endpoints,
+                        uint32_t n_nodes, uint32_t msg_size_max,
+                        uint32_t flush_timeout_us);
+
+/* Bind own endpoint, connect the full mesh, start sender+receiver threads.
+ * Blocks until every peer link is up or timeout_ms elapses.
+ * Returns 0 on success. */
+int dt_start(dt_transport *t, int timeout_ms);
+
+/* Enqueue one message to dest (batched; thread-safe).  Returns 0 on
+ * success, -1 if the transport is shut down or dest invalid. */
+int dt_send(dt_transport *t, uint32_t dest, uint16_t rtype,
+            const uint8_t *payload, uint32_t len);
+
+/* Pop one received message.  Returns payload length >= 0 and fills
+ * src/rtype, or -1 on timeout, -2 if buf too small (message stays
+ * queued; required size in *len_needed if non-NULL). timeout_us < 0
+ * blocks indefinitely. */
+long dt_recv(dt_transport *t, uint8_t *buf, uint32_t cap, uint32_t *src,
+             uint16_t *rtype, long timeout_us, uint32_t *len_needed);
+
+/* Force all batching buffers onto the wire now. */
+void dt_flush(dt_transport *t);
+
+/* Artificial send delay (NETWORK_DELAY_TEST): frames stay in the batch
+ * queue for at least delay_us before hitting the socket. */
+void dt_set_delay_us(dt_transport *t, uint64_t delay_us);
+
+/* Copy DT_STAT_COUNT counters into out. */
+void dt_stats(const dt_transport *t, uint64_t *out);
+
+/* Ping-pong round trips against peer; returns mean round-trip ns, or -1.
+ * (reference NETWORK_TEST, system/main.cpp:346-387) */
+long dt_ping(dt_transport *t, uint32_t peer, uint32_t rounds,
+             uint32_t payload_len);
+
+/* Stop threads, close sockets, free. Safe on NULL. */
+void dt_destroy(dt_transport *t);
+
+/* ---- columnar query-batch codec -------------------------------------
+ * CL_QRY batches travel as columnar blocks so the server can hand them
+ * straight to the device pool: n queries × fixed width key/type arrays
+ * plus per-query scalars.  Layout (little-endian):
+ *   uint32 n, uint32 width, uint32 n_scalars
+ *   int64 client_startts[n]
+ *   int32 keys[n*width], int8 types[n*width]
+ *   int32 scalars[n*n_scalars]
+ * Returns bytes written (call with out=NULL to size), -1 on error. */
+long dt_qrybatch_encode(uint32_t n, uint32_t width, uint32_t n_scalars,
+                        const int64_t *startts, const int32_t *keys,
+                        const int8_t *types, const int32_t *scalars,
+                        uint8_t *out, size_t cap);
+long dt_qrybatch_decode(const uint8_t *buf, size_t len, uint32_t *n,
+                        uint32_t *width, uint32_t *n_scalars,
+                        int64_t *startts, int32_t *keys, int8_t *types,
+                        int32_t *scalars, size_t arrays_cap);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* DENEVA_HOST_H */

@@ -1,0 +1,88 @@
+// Bounded-stat MPMC message queue (reference work/msg queues,
+// `system/work_queue.cpp`, `system/msg_queue.cpp` — boost::lockfree there;
+// mutex+condvar here: the hot path is batched, so queue ops are amortized
+// over whole message batches and contention is negligible).
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <utility>
+
+namespace deneva {
+
+template <typename T>
+class MpmcQueue {
+ public:
+  void push(T v) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      q_.push_back(std::move(v));
+    }
+    cv_.notify_one();
+  }
+
+  // timeout_us < 0: block until item or shutdown; 0: non-blocking.
+  // Returns false on timeout/shutdown-empty.
+  bool pop(T *out, long timeout_us) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (q_.empty()) {
+      if (timeout_us == 0) return false;
+      auto ready = [&] { return !q_.empty() || stopped_; };
+      if (timeout_us < 0) {
+        cv_.wait(lk, ready);
+      } else {
+        cv_.wait_for(lk, std::chrono::microseconds(timeout_us), ready);
+      }
+      if (q_.empty()) return false;
+    }
+    *out = std::move(q_.front());
+    q_.pop_front();
+    return true;
+  }
+
+  // Pop the head only if `accept(head)` returns true, all under one lock
+  // (no pointer escapes, FIFO preserved).  Returns 1 popped, 0 head
+  // rejected (stays at the front), -1 timeout/empty.
+  template <typename F>
+  int pop_if(T *out, F &&accept, long timeout_us) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (q_.empty()) {
+      if (timeout_us == 0) return -1;
+      auto ready = [&] { return !q_.empty() || stopped_; };
+      if (timeout_us < 0) {
+        cv_.wait(lk, ready);
+      } else {
+        cv_.wait_for(lk, std::chrono::microseconds(timeout_us), ready);
+      }
+      if (q_.empty()) return -1;
+    }
+    if (!accept(q_.front())) return 0;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    return 1;
+  }
+
+  void stop() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stopped_ = true;
+    }
+    cv_.notify_all();
+  }
+
+  size_t size() const {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+ private:
+  mutable std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<T> q_;
+  bool stopped_ = false;
+};
+
+}  // namespace deneva

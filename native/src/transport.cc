@@ -1,0 +1,619 @@
+// Native transport: full-mesh stream sockets + batching + IO threads.
+// See include/deneva_host.h for the contract and the reference mapping
+// (`transport/transport.cpp`, `transport/msg_thread.cpp`,
+// `system/io_thread.cpp`).
+
+#include "deneva_host.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "mpmc_queue.h"
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+uint64_t now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+constexpr uint32_t kHelloMagic = 0xD27EAF01u;
+
+// Wire frame header (little-endian; both ends are x86/ARM LE here —
+// the reference's COPY_BUF serialization makes the same assumption).
+struct FrameHdr {
+  uint32_t paylen;
+  uint16_t rtype;
+  uint16_t pad;
+  uint32_t src;
+};
+static_assert(sizeof(FrameHdr) == 12, "frame header must be 12 bytes");
+
+struct Endpoint {
+  bool ipc = false;
+  std::string addr;  // path (ipc) or host:port (tcp)
+};
+
+struct RecvMsg {
+  uint32_t src = 0;
+  uint16_t rtype = 0;
+  std::vector<uint8_t> payload;
+};
+
+struct OutFrame {
+  uint32_t dest;
+  uint64_t ready_us;  // delay injection
+  std::vector<uint8_t> bytes;  // header + payload
+};
+
+ssize_t write_all(int fd, const uint8_t *p, size_t n) {
+  size_t done = 0;
+  while (done < n) {
+    ssize_t w = ::write(fd, p + done, n - done);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    done += static_cast<size_t>(w);
+  }
+  return static_cast<ssize_t>(done);
+}
+
+}  // namespace
+
+struct dt_transport {
+  uint32_t node_id = 0;
+  uint32_t n_nodes = 0;
+  uint32_t msg_size_max = 4096;
+  uint32_t flush_timeout_us = 200;
+  std::vector<Endpoint> eps;
+
+  // peer_fd is written only during dt_start (before IO threads exist) and
+  // by the destructor (after they join) — read-only while threads run.
+  // Disconnects are flagged in peer_dead; fds stay open until teardown so
+  // the sender can never write to a recycled descriptor.
+  std::vector<int> peer_fd;          // fd per node id (-1 = none/self)
+  std::vector<std::atomic<bool>> peer_dead;
+  int listen_fd = -1;
+
+  // flush protocol: dt_flush bumps flush_req; the sender empties every
+  // mbuf whenever flush_done lags flush_req, then catches it up.
+  std::atomic<uint64_t> flush_req{0};
+  std::atomic<uint64_t> flush_done{0};
+  std::atomic<uint64_t> mbuf_bytes{0};   // bytes sitting in batch buffers
+
+  deneva::MpmcQueue<OutFrame> send_q;
+  deneva::MpmcQueue<RecvMsg> recv_q;
+
+  std::thread sender, receiver;
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> delay_us{0};
+  std::atomic<uint64_t> stats[DT_STAT_COUNT]{};
+
+  // per-dest batch accumulation (sender thread only)
+  struct Mbuf {
+    std::vector<uint8_t> buf;
+    uint64_t first_us = 0;
+  };
+  std::vector<Mbuf> mbufs;
+
+  // ping bookkeeping: receiver thread answers pings itself and routes
+  // pongs here instead of the application queue
+  deneva::MpmcQueue<uint64_t> pong_q;
+
+  ~dt_transport() {
+    stop.store(true);
+    send_q.stop();
+    recv_q.stop();
+    pong_q.stop();
+    if (sender.joinable()) sender.join();
+    if (receiver.joinable()) receiver.join();
+    for (int fd : peer_fd)
+      if (fd >= 0) ::close(fd);
+    if (listen_fd >= 0) ::close(listen_fd);
+    if (node_id < eps.size() && eps[node_id].ipc)
+      ::unlink(eps[node_id].addr.c_str());
+  }
+
+  void bump(dt_stat s, uint64_t v = 1) {
+    stats[s].fetch_add(v, std::memory_order_relaxed);
+  }
+
+  // ---- mesh setup ----------------------------------------------------
+
+  int make_listen() {
+    const Endpoint &ep = eps[node_id];
+    if (ep.ipc) {
+      listen_fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+      if (listen_fd < 0) return -1;
+      sockaddr_un sa{};
+      sa.sun_family = AF_UNIX;
+      std::snprintf(sa.sun_path, sizeof(sa.sun_path), "%s", ep.addr.c_str());
+      ::unlink(ep.addr.c_str());
+      if (::bind(listen_fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa)) < 0)
+        return -1;
+    } else {
+      listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (listen_fd < 0) return -1;
+      int one = 1;
+      ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+      sockaddr_in sa{};
+      if (parse_tcp(ep.addr, &sa) != 0) return -1;
+      if (::bind(listen_fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa)) < 0)
+        return -1;
+    }
+    return ::listen(listen_fd, static_cast<int>(n_nodes));
+  }
+
+  static int parse_tcp(const std::string &addr, sockaddr_in *sa) {
+    auto colon = addr.rfind(':');
+    if (colon == std::string::npos) return -1;
+    std::string host = addr.substr(0, colon);
+    int port = std::atoi(addr.c_str() + colon + 1);
+    sa->sin_family = AF_INET;
+    sa->sin_port = htons(static_cast<uint16_t>(port));
+    if (host.empty() || host == "*") {
+      sa->sin_addr.s_addr = INADDR_ANY;
+    } else if (::inet_pton(AF_INET, host.c_str(), &sa->sin_addr) != 1) {
+      return -1;
+    }
+    return 0;
+  }
+
+  int connect_peer(uint32_t peer, uint64_t deadline_us) {
+    const Endpoint &ep = eps[peer];
+    while (!stop.load()) {
+      int fd;
+      int rc;
+      if (ep.ipc) {
+        fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+        sockaddr_un sa{};
+        sa.sun_family = AF_UNIX;
+        std::snprintf(sa.sun_path, sizeof(sa.sun_path), "%s",
+                      ep.addr.c_str());
+        rc = ::connect(fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa));
+      } else {
+        fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in sa{};
+        if (parse_tcp(ep.addr, &sa) != 0) {
+          ::close(fd);
+          return -1;
+        }
+        rc = ::connect(fd, reinterpret_cast<sockaddr *>(&sa), sizeof(sa));
+      }
+      if (rc == 0) {
+        uint32_t hello[2] = {kHelloMagic, node_id};
+        if (write_all(fd, reinterpret_cast<uint8_t *>(hello),
+                      sizeof(hello)) < 0) {
+          ::close(fd);
+          return -1;
+        }
+        tune(fd);
+        peer_fd[peer] = fd;
+        return 0;
+      }
+      ::close(fd);
+      if (now_us() > deadline_us) return -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    return -1;
+  }
+
+  int accept_one(uint64_t deadline_us) {
+    while (!stop.load()) {
+      pollfd pf{listen_fd, POLLIN, 0};
+      int pr = ::poll(&pf, 1, 50);
+      if (pr > 0) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) continue;
+        uint32_t hello[2] = {0, 0};
+        size_t got = 0;
+        while (got < sizeof(hello)) {
+          ssize_t r = ::read(fd, reinterpret_cast<uint8_t *>(hello) + got,
+                             sizeof(hello) - got);
+          if (r <= 0) break;
+          got += static_cast<size_t>(r);
+        }
+        if (got != sizeof(hello) || hello[0] != kHelloMagic ||
+            hello[1] >= n_nodes) {
+          ::close(fd);
+          continue;
+        }
+        tune(fd);
+        peer_fd[hello[1]] = fd;
+        return 0;
+      }
+      if (now_us() > deadline_us) return -1;
+    }
+    return -1;
+  }
+
+  static void tune(int fd) {
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // harmless EOPNOTSUPP on unix sockets
+  }
+
+  // ---- sender --------------------------------------------------------
+
+  void flush_dest(uint32_t dest) {
+    Mbuf &mb = mbufs[dest];
+    if (mb.buf.empty()) return;
+    int fd = peer_fd[dest];
+    if (fd >= 0 && !peer_dead[dest].load(std::memory_order_relaxed) &&
+        write_all(fd, mb.buf.data(), mb.buf.size()) >= 0) {
+      bump(DT_STAT_BATCHES_SENT);
+      bump(DT_STAT_BYTES_SENT, mb.buf.size());
+    }
+    mbuf_bytes.fetch_sub(mb.buf.size(), std::memory_order_relaxed);
+    mb.buf.clear();
+    mb.first_us = 0;
+  }
+
+  void sender_loop() {
+    std::vector<OutFrame> delayed;
+    while (!stop.load()) {
+      OutFrame f;
+      // wait at most the flush timeout so timed flushes happen
+      long wait = static_cast<long>(
+          flush_timeout_us ? flush_timeout_us : 100);
+      if (!delayed.empty() || flush_req.load() != flush_done.load())
+        wait = 100;  // stay responsive while frames are parked
+      bool got = send_q.pop(&f, wait);
+      uint64_t now = now_us();
+      if (got) {
+        if (f.ready_us > now) {
+          delayed.push_back(std::move(f));
+        } else {
+          append(std::move(f), now);
+        }
+      }
+      // release matured delayed frames
+      for (size_t i = 0; i < delayed.size();) {
+        if (delayed[i].ready_us <= now) {
+          append(std::move(delayed[i]), now);
+          delayed.erase(delayed.begin() + static_cast<long>(i));
+        } else {
+          ++i;
+        }
+      }
+      // flush full/timed-out buffers; when idle (or told to) flush all
+      uint64_t freq = flush_req.load(std::memory_order_acquire);
+      bool force = freq != flush_done.load(std::memory_order_relaxed);
+      for (uint32_t d = 0; d < n_nodes; ++d) {
+        Mbuf &mb = mbufs[d];
+        if (mb.buf.empty()) continue;
+        bool full = mb.buf.size() >= msg_size_max;
+        bool timed = flush_timeout_us == 0 ||
+                     now - mb.first_us >= flush_timeout_us;
+        bool idle = !got && delayed.empty();
+        if (full || timed || idle || force) flush_dest(d);
+      }
+      if (force) flush_done.store(freq, std::memory_order_release);
+    }
+    // drain on shutdown: queued frames AND parked delayed frames
+    OutFrame f;
+    while (send_q.pop(&f, 0)) append(std::move(f), now_us());
+    for (auto &df : delayed) append(std::move(df), now_us());
+    for (uint32_t d = 0; d < n_nodes; ++d) flush_dest(d);
+  }
+
+  void append(OutFrame f, uint64_t now) {
+    Mbuf &mb = mbufs[f.dest];
+    if (mb.buf.empty()) mb.first_us = now;
+    mb.buf.insert(mb.buf.end(), f.bytes.begin(), f.bytes.end());
+    mbuf_bytes.fetch_add(f.bytes.size(), std::memory_order_relaxed);
+    bump(DT_STAT_MSG_SENT);
+    if (mb.buf.size() >= msg_size_max) flush_dest(f.dest);
+  }
+
+  // ---- receiver ------------------------------------------------------
+
+  void receiver_loop() {
+    std::vector<std::vector<uint8_t>> streams(n_nodes);
+    std::vector<pollfd> pfds;
+    std::vector<uint32_t> ids;
+    while (!stop.load()) {
+      pfds.clear();
+      ids.clear();
+      for (uint32_t p = 0; p < n_nodes; ++p) {
+        if (peer_fd[p] >= 0 &&
+            !peer_dead[p].load(std::memory_order_relaxed)) {
+          pfds.push_back({peer_fd[p], POLLIN, 0});
+          ids.push_back(p);
+        }
+      }
+      if (pfds.empty()) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(5));
+        continue;
+      }
+      int pr = ::poll(pfds.data(), pfds.size(), 20);
+      if (pr <= 0) continue;
+      for (size_t i = 0; i < pfds.size(); ++i) {
+        if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+        uint8_t chunk[65536];
+        ssize_t r = ::read(pfds[i].fd, chunk, sizeof(chunk));
+        if (r <= 0) {
+          if (r == 0 || (errno != EINTR && errno != EAGAIN)) {
+            // flag only; the fd stays open until the destructor so the
+            // sender never races a close/recycle
+            peer_dead[ids[i]].store(true, std::memory_order_relaxed);
+          }
+          continue;
+        }
+        bump(DT_STAT_BYTES_RCVD, static_cast<uint64_t>(r));
+        auto &st = streams[ids[i]];
+        st.insert(st.end(), chunk, chunk + r);
+        parse_stream(st);
+      }
+    }
+  }
+
+  void parse_stream(std::vector<uint8_t> &st) {
+    size_t off = 0;
+    while (st.size() - off >= sizeof(FrameHdr)) {
+      FrameHdr h;
+      std::memcpy(&h, st.data() + off, sizeof(h));
+      if (st.size() - off < sizeof(h) + h.paylen) break;
+      const uint8_t *pay = st.data() + off + sizeof(h);
+      deliver(h, pay);
+      off += sizeof(h) + h.paylen;
+    }
+    if (off) st.erase(st.begin(), st.begin() + static_cast<long>(off));
+  }
+
+  void deliver(const FrameHdr &h, const uint8_t *pay) {
+    bump(DT_STAT_MSG_RCVD);
+    if (h.rtype == DT_PING) {
+      // answer at transport level: echo payload back as PONG
+      enqueue(h.src, DT_PONG, pay, h.paylen);
+      return;
+    }
+    if (h.rtype == DT_PONG && h.paylen == sizeof(uint64_t)) {
+      uint64_t t0;
+      std::memcpy(&t0, pay, sizeof(t0));
+      pong_q.push(t0);
+      return;
+    }
+    RecvMsg m;
+    m.src = h.src;
+    m.rtype = h.rtype;
+    m.payload.assign(pay, pay + h.paylen);
+    recv_q.push(std::move(m));
+  }
+
+  int enqueue(uint32_t dest, uint16_t rtype, const uint8_t *payload,
+              uint32_t len) {
+    if (dest >= n_nodes || stop.load()) return -1;
+    FrameHdr h{len, rtype, 0, node_id};
+    if (dest == node_id) {
+      // loopback: skip the wire entirely
+      deliver(h, payload);
+      bump(DT_STAT_MSG_SENT);
+      return 0;
+    }
+    OutFrame f;
+    f.dest = dest;
+    uint64_t d = delay_us.load(std::memory_order_relaxed);
+    f.ready_us = d ? now_us() + d : 0;
+    f.bytes.resize(sizeof(h) + len);
+    std::memcpy(f.bytes.data(), &h, sizeof(h));
+    if (len) std::memcpy(f.bytes.data() + sizeof(h), payload, len);
+    send_q.push(std::move(f));
+    return 0;
+  }
+};
+
+// ---- C API -----------------------------------------------------------
+
+extern "C" {
+
+dt_transport *dt_create(uint32_t node_id, const char *endpoints,
+                        uint32_t n_nodes, uint32_t msg_size_max,
+                        uint32_t flush_timeout_us) {
+  if (!endpoints || node_id >= n_nodes || n_nodes == 0) return nullptr;
+  auto *t = new dt_transport();
+  t->node_id = node_id;
+  t->n_nodes = n_nodes;
+  t->msg_size_max = msg_size_max ? msg_size_max : 4096;
+  t->flush_timeout_us = flush_timeout_us;
+  t->eps.resize(n_nodes);
+  t->peer_fd.assign(n_nodes, -1);
+  t->peer_dead = std::vector<std::atomic<bool>>(n_nodes);
+  t->mbufs.resize(n_nodes);
+
+  std::string text(endpoints);
+  size_t pos = 0;
+  uint32_t seen = 0;
+  while (pos < text.size()) {
+    size_t eol = text.find('\n', pos);
+    if (eol == std::string::npos) eol = text.size();
+    std::string line = text.substr(pos, eol - pos);
+    pos = eol + 1;
+    if (line.empty()) continue;
+    char proto[16];
+    char addr[256];
+    unsigned id;
+    if (std::sscanf(line.c_str(), "%u %15s %255s", &id, proto, addr) != 3 ||
+        id >= n_nodes) {
+      delete t;
+      return nullptr;
+    }
+    t->eps[id].ipc = std::strcmp(proto, "ipc") == 0;
+    t->eps[id].addr = addr;
+    ++seen;
+  }
+  if (seen < n_nodes) {
+    delete t;
+    return nullptr;
+  }
+  return t;
+}
+
+int dt_start(dt_transport *t, int timeout_ms) {
+  if (!t) return -1;
+  uint64_t deadline = now_us() + static_cast<uint64_t>(timeout_ms) * 1000;
+  if (t->n_nodes > 1) {
+    if (t->make_listen() != 0) return -1;
+    // accept from higher ids in a helper thread while we dial lower ids
+    uint32_t n_accept = t->n_nodes - 1 - t->node_id;
+    std::thread acceptor([t, n_accept, deadline] {
+      for (uint32_t k = 0; k < n_accept; ++k)
+        if (t->accept_one(deadline) != 0) return;
+    });
+    int rc = 0;
+    for (uint32_t p = 0; p < t->node_id; ++p)
+      if (t->connect_peer(p, deadline) != 0) rc = -1;
+    acceptor.join();
+    if (rc != 0) return -1;
+    for (uint32_t p = 0; p < t->n_nodes; ++p)
+      if (p != t->node_id && t->peer_fd[p] < 0) return -1;
+  }
+  t->sender = std::thread([t] { t->sender_loop(); });
+  t->receiver = std::thread([t] { t->receiver_loop(); });
+  return 0;
+}
+
+int dt_send(dt_transport *t, uint32_t dest, uint16_t rtype,
+            const uint8_t *payload, uint32_t len) {
+  if (!t) return -1;
+  return t->enqueue(dest, rtype, payload, len);
+}
+
+long dt_recv(dt_transport *t, uint8_t *buf, uint32_t cap, uint32_t *src,
+             uint16_t *rtype, long timeout_us, uint32_t *len_needed) {
+  if (!t) return -1;
+  RecvMsg m;
+  uint32_t need = 0;
+  // single-lock conditional pop: a too-large head stays at the front
+  // (FIFO preserved) and its size is reported for buffer growth
+  int rc = t->recv_q.pop_if(
+      &m,
+      [&](const RecvMsg &head) {
+        if (head.payload.size() > cap) {
+          need = static_cast<uint32_t>(head.payload.size());
+          return false;
+        }
+        return true;
+      },
+      timeout_us);
+  if (rc == -1) return -1;
+  if (rc == 0) {
+    if (len_needed) *len_needed = need;
+    return -2;
+  }
+  if (src) *src = m.src;
+  if (rtype) *rtype = m.rtype;
+  if (!m.payload.empty()) std::memcpy(buf, m.payload.data(), m.payload.size());
+  return static_cast<long>(m.payload.size());
+}
+
+void dt_flush(dt_transport *t) {
+  if (!t || !t->sender.joinable()) return;
+  uint64_t ticket = t->flush_req.fetch_add(1, std::memory_order_acq_rel) + 1;
+  uint64_t deadline = now_us() + 1'000'000;  // 1s bound
+  while (t->flush_done.load(std::memory_order_acquire) < ticket &&
+         !t->stop.load() && now_us() < deadline) {
+    std::this_thread::sleep_for(std::chrono::microseconds(50));
+  }
+}
+
+void dt_set_delay_us(dt_transport *t, uint64_t delay_us) {
+  if (t) t->delay_us.store(delay_us, std::memory_order_relaxed);
+}
+
+void dt_stats(const dt_transport *t, uint64_t *out) {
+  if (!t || !out) return;
+  for (int i = 0; i < DT_STAT_COUNT; ++i)
+    out[i] = t->stats[i].load(std::memory_order_relaxed);
+  out[DT_STAT_SEND_QUEUE_DEPTH] = t->send_q.size();
+  out[DT_STAT_RECV_QUEUE_DEPTH] = t->recv_q.size();
+}
+
+long dt_ping(dt_transport *t, uint32_t peer, uint32_t rounds,
+             uint32_t payload_len) {
+  if (!t || peer >= t->n_nodes || rounds == 0) return -1;
+  (void)payload_len;  // round-trip carries the 8-byte timestamp
+  uint64_t total_ns = 0;
+  for (uint32_t i = 0; i < rounds; ++i) {
+    uint64_t t0 = now_us();
+    if (t->enqueue(peer, DT_PING, reinterpret_cast<uint8_t *>(&t0),
+                   sizeof(t0)) != 0)
+      return -1;
+    uint64_t echoed;
+    if (!t->pong_q.pop(&echoed, 2'000'000)) return -1;  // 2s timeout
+    total_ns += (now_us() - echoed) * 1000;
+  }
+  return static_cast<long>(total_ns / rounds);
+}
+
+void dt_destroy(dt_transport *t) { delete t; }
+
+// ---- columnar query-batch codec ---------------------------------------
+
+long dt_qrybatch_encode(uint32_t n, uint32_t width, uint32_t n_scalars,
+                        const int64_t *startts, const int32_t *keys,
+                        const int8_t *types, const int32_t *scalars,
+                        uint8_t *out, size_t cap) {
+  size_t need = 12 + size_t(n) * 8 + size_t(n) * width * 4 +
+                size_t(n) * width + size_t(n) * n_scalars * 4;
+  if (!out) return static_cast<long>(need);
+  if (cap < need) return -1;
+  uint32_t hdr[3] = {n, width, n_scalars};
+  uint8_t *p = out;
+  std::memcpy(p, hdr, 12);
+  p += 12;
+  std::memcpy(p, startts, size_t(n) * 8);
+  p += size_t(n) * 8;
+  std::memcpy(p, keys, size_t(n) * width * 4);
+  p += size_t(n) * width * 4;
+  std::memcpy(p, types, size_t(n) * width);
+  p += size_t(n) * width;
+  if (n_scalars) std::memcpy(p, scalars, size_t(n) * n_scalars * 4);
+  return static_cast<long>(need);
+}
+
+long dt_qrybatch_decode(const uint8_t *buf, size_t len, uint32_t *n,
+                        uint32_t *width, uint32_t *n_scalars,
+                        int64_t *startts, int32_t *keys, int8_t *types,
+                        int32_t *scalars, size_t arrays_cap) {
+  if (!buf || len < 12) return -1;
+  uint32_t hdr[3];
+  std::memcpy(hdr, buf, 12);
+  uint32_t N = hdr[0], W = hdr[1], S = hdr[2];
+  size_t need = 12 + size_t(N) * 8 + size_t(N) * W * 4 + size_t(N) * W +
+                size_t(N) * S * 4;
+  if (len < need) return -1;
+  if (n) *n = N;
+  if (width) *width = W;
+  if (n_scalars) *n_scalars = S;
+  if (!startts) return static_cast<long>(need);  // size-probe call
+  if (arrays_cap < size_t(N) * W) return -2;
+  const uint8_t *p = buf + 12;
+  std::memcpy(startts, p, size_t(N) * 8);
+  p += size_t(N) * 8;
+  std::memcpy(keys, p, size_t(N) * W * 4);
+  p += size_t(N) * W * 4;
+  std::memcpy(types, p, size_t(N) * W);
+  p += size_t(N) * W;
+  if (S && scalars) std::memcpy(scalars, p, size_t(N) * S * 4);
+  return static_cast<long>(need);
+}
+
+}  // extern "C"

@@ -1,0 +1,189 @@
+"""Native transport tests (SURVEY §2.6): in-process multi-node mesh over
+Unix-domain sockets — the reference's IPC single-box integration rig
+(`transport/transport.cpp:132-133`, SURVEY §4.4)."""
+
+import threading
+import uuid
+
+import numpy as np
+import pytest
+
+from deneva_tpu.runtime.native import (NativeTransport, decode_qrybatch,
+                                       encode_qrybatch, ensure_built,
+                                       ipc_endpoints)
+
+
+@pytest.fixture(scope="module")
+def lib():
+    return ensure_built()
+
+
+def _mesh(n):
+    eps = ipc_endpoints(n, uuid.uuid4().hex[:8])
+    nodes = [NativeTransport(i, eps, n) for i in range(n)]
+    # dt_start blocks until the full mesh is up -> start concurrently
+    threads = [threading.Thread(target=t.start) for t in nodes]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    return nodes
+
+
+def test_build(lib):
+    import os
+    assert os.path.exists(lib)
+
+
+def test_two_node_send_recv(lib):
+    a, b = _mesh(2)
+    try:
+        a.send(1, "INIT_DONE", b"hello")
+        got = b.recv(timeout_us=2_000_000)
+        assert got == (0, "INIT_DONE", b"hello")
+        b.send(0, "CL_RSP", b"resp")
+        got = a.recv(timeout_us=2_000_000)
+        assert got == (1, "CL_RSP", b"resp")
+    finally:
+        a.close()
+        b.close()
+
+
+def test_loopback_self_send(lib):
+    (a,) = _mesh(1)
+    try:
+        a.send(0, "RDONE", b"x")
+        assert a.recv(timeout_us=1_000_000) == (0, "RDONE", b"x")
+    finally:
+        a.close()
+
+
+def test_batching_many_small_messages(lib):
+    a, b = _mesh(2)
+    try:
+        n = 500
+        for i in range(n):
+            a.send(1, "CL_RSP", i.to_bytes(4, "little"))
+        seen = set()
+        for _ in range(n):
+            got = b.recv(timeout_us=5_000_000)
+            assert got is not None and got[1] == "CL_RSP"
+            seen.add(int.from_bytes(got[2], "little"))
+        assert seen == set(range(n))
+        st = a.stats()
+        # batching must actually batch: far fewer socket writes than msgs
+        assert st["msg_sent"] == n
+        assert 0 < st["batches_sent"] < n / 2
+    finally:
+        a.close()
+        b.close()
+
+
+def test_large_message_grows_recv_buffer(lib):
+    a, b = _mesh(2)
+    try:
+        big = np.arange(1 << 21, dtype=np.uint8).tobytes()  # 2 MiB > 1 MiB buf
+        a.send(1, "EPOCH_BLOB", big)
+        got = b.recv(timeout_us=10_000_000)
+        assert got is not None
+        assert got[1] == "EPOCH_BLOB" and got[2] == big
+    finally:
+        a.close()
+        b.close()
+
+
+def test_large_then_small_preserves_fifo(lib):
+    # a too-large head must stay at the front while the receiver grows its
+    # buffer: the blob is delivered BEFORE the small trailing message
+    a, b = _mesh(2)
+    try:
+        big = bytes(3 << 20)  # 3 MiB > initial 1 MiB recv buffer
+        a.send(1, "EPOCH_BLOB", big)
+        a.send(1, "RDONE", b"tail")
+        first = b.recv(timeout_us=10_000_000)
+        second = b.recv(timeout_us=10_000_000)
+        assert first is not None and first[1] == "EPOCH_BLOB"
+        assert second is not None and second[1] == "RDONE"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_three_node_full_mesh(lib):
+    nodes = _mesh(3)
+    try:
+        for i, t in enumerate(nodes):
+            for j in range(3):
+                if j != i:
+                    t.send(j, "INIT_DONE", bytes([i]))
+        for i, t in enumerate(nodes):
+            srcs = set()
+            for _ in range(2):
+                got = t.recv(timeout_us=5_000_000)
+                assert got is not None
+                srcs.add(got[0])
+            assert srcs == {0, 1, 2} - {i}
+    finally:
+        for t in nodes:
+            t.close()
+
+
+def test_ping_and_delay_injection(lib):
+    a, b = _mesh(2)
+    try:
+        rt0 = a.ping(1, rounds=20)
+        assert rt0 > 0
+        # NETWORK_DELAY_TEST analogue: 20ms injected send delay
+        a.set_delay_us(20_000)
+        rt1 = a.ping(1, rounds=3)
+        assert rt1 > rt0 + 15_000  # µs
+        a.set_delay_us(0)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_qrybatch_codec_roundtrip(lib):
+    rng = np.random.default_rng(0)
+    n, w = 64, 8
+    startts = rng.integers(0, 1 << 60, n, dtype=np.int64)
+    keys = rng.integers(0, 1 << 30, (n, w), dtype=np.int32)
+    types = rng.integers(0, 3, (n, w), dtype=np.int8)
+    scalars = rng.integers(0, 100, (n, 2), dtype=np.int32)
+    buf = encode_qrybatch(startts, keys, types, scalars)
+    s2, k2, t2, sc2 = decode_qrybatch(buf)
+    np.testing.assert_array_equal(s2, startts)
+    np.testing.assert_array_equal(k2, keys)
+    np.testing.assert_array_equal(t2, types)
+    np.testing.assert_array_equal(sc2, scalars)
+
+
+def test_qrybatch_over_wire(lib):
+    a, b = _mesh(2)
+    try:
+        keys = np.arange(32, dtype=np.int32).reshape(4, 8)
+        types = np.ones((4, 8), np.int8)
+        startts = np.arange(4, dtype=np.int64)
+        a.send(1, "CL_QRY_BATCH", np.frombuffer(
+            encode_qrybatch(startts, keys, types), np.uint8))
+        got = b.recv(timeout_us=5_000_000)
+        assert got is not None and got[1] == "CL_QRY_BATCH"
+        s2, k2, _, _ = decode_qrybatch(got[2])
+        np.testing.assert_array_equal(k2, keys)
+        np.testing.assert_array_equal(s2, startts)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_stats_counters(lib):
+    a, b = _mesh(2)
+    try:
+        a.send(1, "INIT_DONE", b"abc")
+        b.recv(timeout_us=2_000_000)
+        sa, sb = a.stats(), b.stats()
+        assert sa["msg_sent"] >= 1 and sa["bytes_sent"] >= 15
+        assert sb["msg_rcvd"] >= 1 and sb["bytes_rcvd"] >= 15
+    finally:
+        a.close()
+        b.close()
