@@ -279,3 +279,60 @@ def test_randomized_serializability(alg):
         v, st, b = run(alg, txns, state=st, ts=ts)
         check_verdict(v, b, txns, chained=be.chained)
         assert np.asarray(v.commit).sum() >= 1
+
+
+# ---- isolation levels (reference config.h:102,337-340) -----------------
+
+def _iso_cfg(level):
+    return CFG.replace(isolation_level=level)
+
+
+def test_isolation_serializable_reader_blocks_writer():
+    # earlier pure reader of key 5 blocks a later writer under long locks
+    v, _, _ = run("NO_WAIT", [[(5, "r")], [(5, "w")]])
+    assert bool(v.commit[0]) and bool(v.abort[1])
+
+
+@pytest.mark.parametrize("level", ["READ_COMMITTED", "READ_UNCOMMITTED"])
+def test_isolation_relaxed_reader_does_not_block_writer(level):
+    v, _, _ = run("NO_WAIT", [[(5, "r")], [(5, "w")]],
+                  cfg=_iso_cfg(level))
+    assert bool(v.commit[0]) and bool(v.commit[1])
+
+
+def test_isolation_read_committed_reader_behind_writer_conflicts():
+    # writer earlier in rank still holds the lock when the reader asks
+    v, _, _ = run("NO_WAIT", [[(5, "w")], [(5, "r")]],
+                  cfg=_iso_cfg("READ_COMMITTED"))
+    assert bool(v.commit[0]) and bool(v.abort[1])
+
+
+def test_isolation_read_uncommitted_only_ww_conflicts():
+    v, _, _ = run("NO_WAIT", [[(5, "w")], [(5, "r")], [(5, "w")]],
+                  cfg=_iso_cfg("READ_UNCOMMITTED"))
+    assert bool(v.commit[0])
+    assert bool(v.commit[1])      # read bypasses the lock table
+    assert bool(v.abort[2])       # WW still conflicts
+
+
+def test_isolation_nolock_commits_everything():
+    v, _, _ = run("NO_WAIT", [[(5, "w")], [(5, "w")], [(5, "rw")]],
+                  cfg=_iso_cfg("NOLOCK"))
+    assert bool(np.asarray(v.commit)[:3].all())
+
+
+def test_isolation_wait_die_relaxed_wait_rule_still_applies():
+    # two writers, older arrives later in rank: waits instead of dying
+    v, _, _ = run("WAIT_DIE", [[(5, "w")], [(5, "w")]],
+                  ts=[2, 1], cfg=_iso_cfg("READ_UNCOMMITTED"))
+    assert bool(v.commit[0]) and bool(v.defer[1])
+
+
+def test_isolation_monotone_commit_counts():
+    # same contended batch; commits must not decrease as isolation relaxes
+    txns = [[(k % 3, "w" if i % 2 else "r")] for i, k in enumerate(range(8))]
+    counts = []
+    for lvl in ["SERIALIZABLE", "READ_COMMITTED", "READ_UNCOMMITTED", "NOLOCK"]:
+        v, _, _ = run("NO_WAIT", txns, cfg=_iso_cfg(lvl))
+        counts.append(int(np.asarray(v.commit).sum()))
+    assert counts == sorted(counts)
