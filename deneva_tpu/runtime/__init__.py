@@ -9,3 +9,9 @@ processes)."""
 
 from deneva_tpu.runtime.native import (NativeTransport, RTYPE,  # noqa: F401
                                        ensure_built)
+
+
+def run_cluster(*a, **kw):
+    """Boot an N-server + M-client cluster (see runtime.launch)."""
+    from deneva_tpu.runtime.launch import run_cluster as _rc
+    return _rc(*a, **kw)

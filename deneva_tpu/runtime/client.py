@@ -1,0 +1,153 @@
+"""Client node (reference `runcl`: `client/` + `system/client_thread.cpp`).
+
+Pre-generates a ring of queries per server (reference
+`client_query_queue`, `client/client_query.cpp:112-121`), then drives an
+open loop: send CL_QRY_BATCH blocks round-robin across servers while the
+per-server inflight count stays under the throttle
+(`client/client_txn.cpp:25-46`, `g_inflight_max`), decrement on CL_RSP and
+record end-to-end latency (`system/io_thread.cpp:85-132`).  Two load modes
+as in the reference (`config.h:21-22`): LOAD_MAX (saturate) and LOAD_RATE
+(fixed txn/s budget per tick).
+
+Latency tags: each txn carries a 40-bit tag = (batch_seq << 16 | lane);
+the client remembers send times per tag in a ring and matches CL_RSP tags
+back to compute client_client_latency percentiles (the reference's
+client-side `StatsArr`, `scripts/latency_stats.py:20`).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.runtime import wire
+from deneva_tpu.runtime.native import NativeTransport
+from deneva_tpu.stats import Stats
+
+TAG_RING = 1 << 20            # outstanding-tag ring per client
+QRY_CHUNK = 64                # txns per CL_QRY_BATCH message
+
+
+class ClientNode:
+    def __init__(self, cfg: Config, endpoints: str, platform: str | None):
+        import jax
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        from deneva_tpu.workloads import get_workload
+
+        self.cfg = cfg
+        self.me = cfg.node_id                   # transport id (>= node_cnt)
+        self.n_srv = cfg.node_cnt
+        self.wl = get_workload(cfg)
+        self.tp = NativeTransport(self.me, endpoints,
+                                  self.n_srv + cfg.client_node_cnt,
+                                  msg_size_max=cfg.msg_size_max)
+        self.tp.start()
+        self.inflight = np.zeros(self.n_srv, np.int64)
+        # reference: inflight cap is per server pair (client_txn.cpp:25)
+        self.cap = max(1, cfg.max_txn_in_flight // max(cfg.client_node_cnt, 1))
+        self.send_us = np.zeros(TAG_RING, np.int64)   # tag -> send time
+        self.next_tag = 0
+        self.stats = Stats()
+        self.stop = False
+        self._init_seen: set[int] = set()
+
+        # pre-generate a query ring (client_query.cpp pre-generation):
+        # enough blocks that wraparound reuse is harmless (fresh zipf draws
+        # per block; the reference wraps the same way)
+        import jax
+        rng = jax.random.PRNGKey(cfg.seed + 7919 * cfg.node_id)
+        n_pregen = 64
+        self.ring: list[wire.QueryBlock] = []
+        for i in range(n_pregen):
+            q = self.wl.generate(jax.random.fold_in(rng, i), QRY_CHUNK)
+            keys, types, scalars = self.wl.to_wire(q)
+            self.ring.append(wire.QueryBlock(
+                keys=keys, types=types, scalars=scalars,
+                tags=np.zeros(QRY_CHUNK, np.int64)))
+        self.ring_pos = 0
+
+    # ------------------------------------------------------------------
+    def _route(self, src: int, rtype: str, payload: bytes,
+               lat_arr) -> None:
+        if rtype == "CL_RSP":
+            tags = wire.decode_cl_rsp(payload)
+            now = time.monotonic_ns() // 1000
+            self.inflight[src - 0] -= len(tags)   # src is a server id
+            sent = self.send_us[tags % TAG_RING]
+            lat_arr.extend((now - sent) / 1e6)    # seconds
+            self.stats.incr("txn_cnt", len(tags))
+        elif rtype == "SHUTDOWN":
+            self.stop = True
+        elif rtype == "INIT_DONE":
+            self._init_seen.add(src)
+
+    def _drain(self, lat_arr, timeout_us: int = 0) -> None:
+        while True:
+            m = self.tp.recv(timeout_us)
+            if m is None:
+                return
+            self._route(*m, lat_arr)
+            timeout_us = 0
+
+    def barrier(self, timeout_s: float = 60.0) -> None:
+        self._init_seen = {self.me}
+        n_all = self.n_srv + self.cfg.client_node_cnt
+        for p in range(n_all):
+            if p != self.me:
+                self.tp.send(p, "INIT_DONE")
+        self.tp.flush()
+        lat = self.stats.arr("client_client_latency")
+        t0 = time.monotonic()
+        while len(self._init_seen) < n_all:
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(f"client {self.me}: barrier timeout "
+                                   f"({sorted(self._init_seen)})")
+            self._drain(lat, timeout_us=10_000)
+
+    # ------------------------------------------------------------------
+    def run(self) -> Stats:
+        cfg = self.cfg
+        self.barrier()
+        lat = self.stats.arr("client_client_latency")
+        srv = 0
+        # LOAD_RATE budget (reference client_thread.cpp:35-41,70-91)
+        rate = cfg.load_rate / max(cfg.client_node_cnt, 1)
+        t_start = time.monotonic()
+        sent_total = 0
+        while not self.stop:
+            progressed = False
+            for _ in range(self.n_srv):
+                srv = (srv + 1) % self.n_srv
+                if self.inflight[srv] + QRY_CHUNK > self.cap:
+                    continue
+                if rate and sent_total >= rate * (time.monotonic() - t_start):
+                    break
+                blk = self.ring[self.ring_pos]
+                self.ring_pos = (self.ring_pos + 1) % len(self.ring)
+                now = time.monotonic_ns() // 1000
+                tags = (np.arange(QRY_CHUNK, dtype=np.int64) + self.next_tag) \
+                    % TAG_RING
+                self.next_tag = int(tags[-1]) + 1
+                self.send_us[tags] = now
+                out = wire.QueryBlock(blk.keys, blk.types, blk.scalars, tags)
+                self.tp.send(srv, "CL_QRY_BATCH", wire.encode_qry_block(out))
+                self.inflight[srv] += QRY_CHUNK
+                sent_total += QRY_CHUNK
+                progressed = True
+            self._drain(lat, timeout_us=0 if progressed else 2_000)
+        # drain trailing responses so server-side commits are counted
+        t_end = time.monotonic() + 0.3
+        while time.monotonic() < t_end:
+            self._drain(lat, timeout_us=20_000)
+        st = self.stats
+        st.set("total_runtime", time.monotonic() - t_start)
+        st.set("sent_cnt", float(sent_total))
+        for k, v in self.tp.stats().items():
+            st.set(f"net_{k}", float(v))
+        return st
+
+    def close(self) -> None:
+        self.tp.close()

@@ -1,0 +1,118 @@
+"""Multi-process cluster launcher (reference `scripts/run_experiments.py`
+local mode: all nodes as processes on one box over IPC sockets,
+`transport/transport.cpp:132-133` — the de-facto integration rig,
+SURVEY §4.4; TCP endpoints for real clusters).
+
+Node ids: servers 0..node_cnt-1, clients node_cnt..node_cnt+client_cnt-1
+(the reference numbers the same way, `system/global.h:298-306`).
+
+Multi-process JAX on this box must run on CPU (the TPU tunnel is
+single-client); pass ``platform="tpu"`` only on real multi-host fleets.
+
+CLI:  python -m deneva_tpu.runtime.launch --node_cnt=2 --client_node_cnt=1 \
+          --cc_alg=CALVIN --done_secs=3
+prints one [summary] line per node (parse with `deneva_tpu.stats`).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+import traceback
+
+from deneva_tpu.config import Config
+
+
+def _server_main(cfg: Config, endpoints: str, platform: str | None, q) -> None:
+    try:
+        if platform:
+            os.environ.setdefault("JAX_PLATFORMS", platform)
+        from deneva_tpu.runtime.server import ServerNode
+        node = ServerNode(cfg, endpoints, platform)
+        st = node.run()
+        q.put((cfg.node_id, "server", st.summary_line()))
+        node.close()
+    except Exception:
+        q.put((cfg.node_id, "error", traceback.format_exc()))
+
+
+def _client_main(cfg: Config, endpoints: str, platform: str | None, q) -> None:
+    try:
+        if platform:
+            os.environ.setdefault("JAX_PLATFORMS", platform)
+        from deneva_tpu.runtime.client import ClientNode
+        node = ClientNode(cfg, endpoints, platform)
+        st = node.run()
+        q.put((cfg.node_id, "client", st.summary_line()))
+        node.close()
+    except Exception:
+        q.put((cfg.node_id, "error", traceback.format_exc()))
+
+
+def run_cluster(cfg: Config, platform: str | None = "cpu",
+                run_id: str | None = None,
+                timeout_s: float | None = None) -> dict[int, tuple[str, str]]:
+    """Spawn node_cnt servers + client_node_cnt clients; returns
+    {node_id: (kind, summary_line)}.  Raises on any node error."""
+    from deneva_tpu.config import WorkloadKind
+    from deneva_tpu.runtime.native import ipc_endpoints
+
+    if cfg.workload != WorkloadKind.YCSB:
+        raise NotImplementedError(
+            "distributed runtime: only YCSB has wire adapters + partitioned "
+            "loaders so far (to_wire/from_wire on the workload); TPCC/PPS "
+            "run on the single-node engine")
+    n_srv, n_cl = cfg.node_cnt, cfg.client_node_cnt
+    run_id = run_id or f"{os.getpid()}_{abs(hash(cfg)) % 99999}"
+    endpoints = ipc_endpoints(n_srv + n_cl, run_id)
+    if timeout_s is None:
+        timeout_s = cfg.warmup_secs + cfg.done_secs + 120
+
+    ctx = mp.get_context("spawn")
+    q: mp.Queue = ctx.Queue()
+    procs = []
+    for s in range(n_srv):
+        procs.append(ctx.Process(
+            target=_server_main,
+            args=(cfg.replace(node_id=s, part_cnt=n_srv), endpoints,
+                  platform, q),
+            daemon=True))
+    for c in range(n_cl):
+        procs.append(ctx.Process(
+            target=_client_main,
+            args=(cfg.replace(node_id=n_srv + c, part_cnt=n_srv), endpoints,
+                  platform, q),
+            daemon=True))
+    for p in procs:
+        p.start()
+    out: dict[int, tuple[str, str]] = {}
+    try:
+        for _ in procs:
+            nid, kind, line = q.get(timeout=timeout_s)
+            if kind == "error":
+                raise RuntimeError(f"node {nid} failed:\n{line}")
+            out[nid] = (kind, line)
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+    return out
+
+
+def main(argv: list[str]) -> None:
+    platform = "cpu"
+    rest = []
+    for a in argv:
+        if a.startswith("--platform="):
+            platform = a.split("=", 1)[1] or None
+        else:
+            rest.append(a)
+    cfg = Config.from_args(rest)
+    for nid, (kind, line) in sorted(run_cluster(cfg, platform).items()):
+        print(f"node {nid} ({kind}): {line}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -28,7 +28,7 @@ _lib: C.CDLL | None = None
 RTYPE = {
     "INIT_DONE": 1, "CL_QRY_BATCH": 2, "CL_RSP": 3, "RDONE": 4,
     "EPOCH_BLOB": 5, "LOG_MSG": 6, "LOG_RSP": 7, "PING": 8, "PONG": 9,
-    "SHUTDOWN": 10,
+    "SHUTDOWN": 10, "MEASURE": 11,
 }
 RTYPE_NAME = {v: k for k, v in RTYPE.items()}
 
@@ -36,12 +36,12 @@ STAT_NAMES = ("msg_sent", "msg_rcvd", "bytes_sent", "bytes_rcvd",
               "batches_sent", "send_queue_depth", "recv_queue_depth")
 
 
-def ensure_built() -> str:
+def ensure_built(force: bool = False) -> str:
     """Build ``libdeneva_host.so`` if missing/stale; return its path."""
     srcs = [os.path.join(_NATIVE, "src", "transport.cc"),
             os.path.join(_NATIVE, "src", "mpmc_queue.h"),
             os.path.join(_NATIVE, "include", "deneva_host.h")]
-    stale = (not os.path.exists(_LIB)
+    stale = (force or not os.path.exists(_LIB)
              or any(os.path.getmtime(s) > os.path.getmtime(_LIB)
                     for s in srcs))
     if stale:
@@ -56,7 +56,11 @@ def _load() -> C.CDLL:
     global _lib
     with _lock:
         if _lib is None:
-            lib = C.CDLL(ensure_built())
+            try:
+                lib = C.CDLL(ensure_built())
+            except OSError:
+                # stale artifact from another arch/toolchain: rebuild
+                lib = C.CDLL(ensure_built(force=True))
             lib.dt_create.restype = C.c_void_p
             lib.dt_create.argtypes = [C.c_uint32, C.c_char_p, C.c_uint32,
                                       C.c_uint32, C.c_uint32]
@@ -70,6 +74,7 @@ def _load() -> C.CDLL:
                                     C.POINTER(C.c_uint32),
                                     C.POINTER(C.c_uint16), C.c_long,
                                     C.POINTER(C.c_uint32)]
+            lib.dt_flush.argtypes = [C.c_void_p]
             lib.dt_set_delay_us.argtypes = [C.c_void_p, C.c_uint64]
             lib.dt_stats.argtypes = [C.c_void_p, C.POINTER(C.c_uint64)]
             lib.dt_ping.restype = C.c_long
@@ -146,7 +151,11 @@ class NativeTransport:
                 self._recv_buf = np.empty(int(need.value) * 2, np.uint8)
                 continue
             return (src.value, RTYPE_NAME.get(rt.value, str(rt.value)),
-                    bytes(self._recv_buf[:n].tobytes()))
+                    self._recv_buf[:n].tobytes())
+
+    def flush(self) -> None:
+        """Block until everything sent so far is on the wire (bounded 1s)."""
+        self._lib.dt_flush(self._h)
 
     def set_delay_us(self, us: int) -> None:
         self._lib.dt_set_delay_us(self._h, us)

@@ -1,0 +1,437 @@
+"""Server node of the distributed runtime (reference `rundb`, SURVEY §3.A-C).
+
+One process per server node.  The reference coordinates multi-partition
+transactions with 2PC (RQRY/RPREPARE/RFIN/ACK round trips,
+`system/txn.cpp:498-606`); here distribution is Calvin-shaped end to end
+(`system/sequencer.cpp`, `system/calvin_thread.cpp`), because determinism
+is what lets a batch engine skip the vote:
+
+* every global epoch, each server contributes an equal, deterministic
+  slice of transactions (its local admission queue — the per-node
+  sequencer batch, `sequencer.cpp:207-220`);
+* contributions are broadcast as EPOCH_BLOBs; exactly one blob per
+  (server, epoch) doubles as the RDONE barrier
+  (`system/work_queue.cpp:126-143`);
+* every server materializes the *identical* merged batch (concat by node
+  id; rank = position, ts = epoch * B + rank) and runs the *identical*
+  pure validation function on it — so all nodes reach the same verdicts
+  with zero further communication.  The conflict matrix is the vote;
+* execution is local: the strided partition index maps remote keys to the
+  trash slot, so each node's gathers/scatters touch only the keyspace it
+  owns (reference `GET_NODE_ID` hash partitioning, `system/global.h:294`).
+  Per-row RMW semantics (all three benchmarks) need no cross-node reads —
+  the reference's RFWD forwarding phase (`system/txn.cpp:957-974`) has no
+  work to do in this execution model;
+* the home server (the one the client sent the txn to) answers CL_RSP
+  after the epoch that commits it, and re-enqueues aborted txns with the
+  exponential backoff of `system/abort_queue.cpp:26-50`.
+
+The engine state (tables, CC watermarks, stats) lives on this process's
+JAX device; the epoch step is one jitted program per node, identical on
+every node modulo the partition index baked into its workload.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from collections import deque
+
+import numpy as np
+
+from deneva_tpu.config import CCAlg, Config
+from deneva_tpu.runtime import wire
+from deneva_tpu.runtime.native import NativeTransport
+from deneva_tpu.stats import Stats
+
+_TAG_MASK = np.int64((1 << 40) - 1)
+
+
+def make_dist_step(cfg: Config, wl, be):
+    """Jitted (state, merged queries, active) -> (state, commit, abort).
+
+    Deterministic: every server runs this exact function on the identical
+    merged batch, so verdicts agree without any vote exchange.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deneva_tpu.cc import AccessBatch, build_incidence
+
+    # merged batch = equal slices per server; epoch_batch is the budget
+    b = max(1, cfg.epoch_batch // cfg.node_cnt) * cfg.node_cnt
+
+    @jax.jit
+    def step(db, cc_state, stats, epoch, active, query):
+        rank = jnp.arange(b, dtype=jnp.int32)
+        ts = epoch * jnp.int32(b) + rank
+        planned = wl.plan(db, query)
+        batch = AccessBatch(
+            table_ids=planned["table_ids"], keys=planned["keys"],
+            is_read=planned["is_read"], is_write=planned["is_write"],
+            valid=planned["valid"], ts=ts, rank=rank, active=active)
+        inc = build_incidence(batch, cfg.conflict_buckets,
+                              cfg.conflict_exact) if be.needs_incidence else None
+        verdict, cc_state = be.validate(cfg, cc_state, batch, inc)
+        if be.chained:
+            for lvl in range(cfg.exec_subrounds):
+                m = verdict.commit & (verdict.level == lvl)
+                db = wl.execute(db, query, m, verdict.order, stats)
+        else:
+            db = wl.execute(db, query, verdict.commit, verdict.order, stats)
+        commit = verdict.commit & active
+        abort = verdict.abort & active
+        defer = verdict.defer & active
+        stats = dict(stats)
+        stats["total_txn_commit_cnt"] += commit.sum(dtype=jnp.uint32)
+        stats["total_txn_abort_cnt"] += abort.sum(dtype=jnp.uint32)
+        stats["defer_cnt"] += defer.sum(dtype=jnp.uint32)
+        return db, cc_state, stats, commit, abort, defer
+
+    return step
+
+
+class _RetryQueue:
+    """Aborted-txn restart queue with exponential backoff
+    (`system/abort_queue.cpp:26-50`); deferred txns re-enter with zero
+    penalty (waiter-list analogue)."""
+
+    def __init__(self, backoff: bool, cap: int = 64):
+        self.items: list[tuple[int, wire.QueryBlock, np.ndarray]] = []
+        self.backoff = backoff
+        self.cap = cap
+
+    def push(self, block: wire.QueryBlock, abort_cnt: np.ndarray,
+             epoch: int) -> None:
+        if not len(block):
+            return
+        # clamp the exponent, not the power: 2**(cnt-1) overflows int32
+        # past cnt=32 and would turn the penalty negative
+        exp = np.minimum(np.maximum(abort_cnt - 1, 0),
+                         int(np.log2(self.cap)))
+        pen = np.minimum(2 ** exp, self.cap) \
+            if self.backoff else np.ones_like(abort_cnt)
+        ready = epoch + 1 + np.where(abort_cnt > 0, pen, 0)
+        for r in np.unique(ready):
+            m = ready == r
+            self.items.append((int(r), block.take(np.where(m)[0]),
+                               abort_cnt[m]))
+
+    def pop_ready(self, epoch: int, limit: int
+                  ) -> tuple[list[wire.QueryBlock], list[np.ndarray]]:
+        take_b, take_c, rest = [], [], []
+        n = 0
+        self.items.sort(key=lambda it: it[0])
+        for r, blk, cnt in self.items:
+            if r <= epoch and n < limit:
+                room = limit - n
+                if len(blk) <= room:
+                    take_b.append(blk)
+                    take_c.append(cnt)
+                    n += len(blk)
+                else:
+                    take_b.append(blk.slice(0, room))
+                    take_c.append(cnt[:room])
+                    rest.append((r, blk.slice(room, len(blk)), cnt[room:]))
+                    n = limit
+            else:
+                rest.append((r, blk, cnt))
+        self.items = rest
+        return take_b, take_c
+
+
+class ServerNode:
+    """One server process: transport + admission + epoch loop + stats."""
+
+    def __init__(self, cfg: Config, endpoints: str, platform: str | None):
+        import jax
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        from deneva_tpu.cc import get_backend
+        from deneva_tpu.engine.step import init_device_stats
+        from deneva_tpu.workloads import get_workload
+
+        self.cfg = cfg
+        self.me = cfg.node_id
+        self.n_srv = cfg.node_cnt
+        self.n_cl = cfg.client_node_cnt
+        self.b_loc = max(1, cfg.epoch_batch // self.n_srv)
+        self.b_merged = self.b_loc * self.n_srv
+        self.wl = get_workload(cfg)
+        self.be = get_backend(cfg.cc_alg)
+        self.step = make_dist_step(cfg, self.wl, self.be)
+        self.db = self.wl.load()
+        self.cc_state = self.be.init_state(cfg)
+        self.dev_stats = init_device_stats()
+
+        self.tp = NativeTransport(self.me, endpoints, self.n_srv + self.n_cl,
+                                  msg_size_max=cfg.msg_size_max)
+        self.tp.start()
+        # new_txn_queue: FIFO of (src client id, query block)
+        self.pending: deque[tuple[int, wire.QueryBlock]] = deque()
+        self.pending_rows = 0
+        self.retry = _RetryQueue(cfg.backoff)
+        self.blob_buf: dict[int, dict[int, wire.QueryBlock]] = {}
+        self.stop_epoch: int | None = None
+        self.measure_epoch: int | None = None
+        self.stats = Stats()
+        # wire shape of one query (width, scalar count) from a sample
+        _k, _t, _s = self.wl.to_wire(self.wl.generate(_key0(), 1))
+        self._width = _k.shape[1]
+        self._n_scalars = _s.shape[1]
+
+    # -- message routing (reference InputThread::server_recv_loop) ------
+    def _route(self, src: int, rtype: str, payload: bytes) -> None:
+        if rtype == "CL_QRY_BATCH":
+            blk = wire.decode_qry_block(payload)
+            # stamp the source client into the tag's high bits? no — tags
+            # are opaque to servers; remember src alongside
+            self.pending.append((src, blk))
+            self.pending_rows += len(blk)
+        elif rtype == "EPOCH_BLOB":
+            epoch, blk = wire.decode_epoch_blob(payload)
+            self.blob_buf.setdefault(epoch, {})[src] = blk
+        elif rtype == "SHUTDOWN":
+            self.stop_epoch = wire.decode_shutdown(payload)
+        elif rtype == "MEASURE":
+            self.measure_epoch = wire.decode_shutdown(payload)
+        elif rtype == "INIT_DONE":
+            self._init_seen.add(src)
+
+    def _drain(self, timeout_us: int = 0) -> None:
+        while True:
+            m = self.tp.recv(timeout_us)
+            if m is None:
+                return
+            self._route(*m)
+            timeout_us = 0
+
+    # -- barrier (reference INIT_DONE, system/sim_manager.cpp:95-100) ----
+    def barrier(self, timeout_s: float = 60.0) -> None:
+        self._init_seen = {self.me}
+        for p in range(self.n_srv + self.n_cl):
+            if p != self.me:
+                self.tp.send(p, "INIT_DONE")
+        self.tp.flush()
+        t0 = time.monotonic()
+        while len(self._init_seen) < self.n_srv + self.n_cl:
+            if time.monotonic() - t0 > timeout_s:
+                raise TimeoutError(
+                    f"server {self.me}: INIT_DONE barrier timed out "
+                    f"({sorted(self._init_seen)})")
+            self._drain(timeout_us=10_000)
+
+    # -- admission (client_thread + new_txn_queue + abort_queue) ---------
+    def _contribution(self, epoch: int
+                      ) -> tuple[wire.QueryBlock, np.ndarray]:
+        """Up to b_loc txns: ready retries first, then fresh arrivals.
+
+        Fresh arrivals get the home client's transport id packed into the
+        tag high bits (client << 40 | tag); retried blocks already carry
+        packed tags from their first admission, so routing survives any
+        number of restarts.  Returns (block, abort_cnt)."""
+        blocks, counts = self.retry.pop_ready(epoch, self.b_loc)
+        n = sum(len(b) for b in blocks)
+        while self.pending and n < self.b_loc:
+            src, blk = self.pending[0]
+            room = self.b_loc - n
+            if len(blk) <= room:
+                self.pending.popleft()
+                use = blk
+            else:
+                self.pending[0] = (src, blk.slice(room, len(blk)))
+                use = blk.slice(0, room)
+            self.pending_rows -= len(use)
+            packed = (np.int64(src) << 40) | (use.tags & _TAG_MASK)
+            blocks.append(wire.QueryBlock(use.keys, use.types, use.scalars,
+                                          packed))
+            counts.append(np.zeros(len(use), np.int32))
+            n += len(use)
+        if not blocks:
+            blocks = [wire.QueryBlock.empty(self._width, self._n_scalars)]
+            counts = [np.zeros(0, np.int32)]
+        block = wire.QueryBlock.concat(blocks)
+        return block, np.concatenate(counts)
+
+    # -- one global epoch ------------------------------------------------
+    def run(self, progress=None) -> Stats:
+        import jax
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        # compile before the barrier so no node's first epoch stalls the
+        # lockstep (reference: setup/warmup barriers, system/thread.cpp:62-84)
+        b = self.b_merged
+        warm_q = self.wl.from_wire(
+            np.zeros((b, self._width), np.int32),
+            np.zeros((b, self._width), np.int8),
+            np.zeros((b, self._n_scalars), np.int32))
+        out = self.step(self.db, self.cc_state, self.dev_stats,
+                        jnp.int32(0), jnp.zeros(b, bool), warm_q)
+        jax.block_until_ready(out[3])
+        self.barrier()
+        t_start = time.monotonic()
+        warm_edge = t_start + cfg.warmup_secs
+        measured = None     # counter snapshot at measure start
+        epoch = 0
+        tl = _Timeline() if cfg.debug_timeline else None
+        while True:
+            if tl:
+                tl.mark("loop")
+            self._drain()
+            # epoch-aligned measurement window: server 0 announces the
+            # start epoch so every node snapshots the *same* prefix of
+            # epochs (wall-clock edges differ per node; epochs do not)
+            now = time.monotonic()
+            if self.me == 0 and self.measure_epoch is None \
+                    and now >= warm_edge:
+                self.measure_epoch = epoch + 2
+                ms = wire.encode_shutdown(self.measure_epoch)
+                for p in range(self.n_srv):
+                    if p != self.me:
+                        self.tp.send(p, "MEASURE", ms)
+            if measured is None and self.measure_epoch is not None \
+                    and epoch >= self.measure_epoch:
+                measured = {k: np.asarray(v) for k, v in
+                            jax.device_get(self.dev_stats).items()}
+                self._t_meas = now
+            block, abort_cnt = self._contribution(epoch)
+            if tl:
+                tl.mark("admit")
+            blob = wire.encode_epoch_blob(epoch, block)
+            for p in range(self.n_srv):
+                if p != self.me:
+                    self.tp.send(p, "EPOCH_BLOB", blob)
+            self.tp.flush()
+            if tl:
+                tl.mark("bcast")
+            # collect the other servers' contributions for this epoch
+            t0 = time.monotonic()
+            while len(self.blob_buf.get(epoch, {})) < self.n_srv - 1:
+                if time.monotonic() - t0 > 60:
+                    raise TimeoutError(
+                        f"server {self.me}: epoch {epoch} blob wait: have "
+                        f"{sorted(self.blob_buf.get(epoch, {}))}")
+                self._drain(timeout_us=5_000)
+            if tl:
+                tl.mark("collect")
+            parts = self.blob_buf.pop(epoch, {})
+            parts[self.me] = block
+            merged = wire.QueryBlock.concat(
+                [_pad_block(parts[s], self.b_loc) for s in range(self.n_srv)])
+            active_np = np.zeros(self.b_merged, bool)
+            for s in range(self.n_srv):
+                active_np[s * self.b_loc: s * self.b_loc
+                          + len(parts[s])] = True
+            query = self.wl.from_wire(merged.keys, merged.types,
+                                      merged.scalars)
+            self.db, self.cc_state, self.dev_stats, commit, abort, defer = \
+                self.step(self.db, self.cc_state, self.dev_stats,
+                          jnp.int32(epoch), jnp.asarray(active_np), query)
+            commit = np.asarray(commit)
+            abort = np.asarray(abort)
+            defer = np.asarray(defer)
+            if tl:
+                tl.mark("step")
+            # respond for my slice; restart my aborted/deferred slice
+            lo = self.me * self.b_loc
+            mine = slice(lo, lo + len(block))
+            my_commit = commit[mine]
+            if my_commit.any():
+                # tag high bits carry the home client's transport id
+                tags = block.tags[my_commit]
+                clients = tags >> 40
+                for c in np.unique(clients):
+                    self.tp.send(int(c), "CL_RSP", wire.encode_cl_rsp(
+                        tags[clients == c] & _TAG_MASK))
+            restart = (abort | defer)[mine]
+            if restart.any():
+                idx = np.where(restart)[0]
+                # aborts bump the backoff counter; defers restart free
+                self.retry.push(block.take(idx),
+                                abort_cnt[idx] + abort[mine][idx], epoch)
+            now = time.monotonic()
+            if progress and epoch % 50 == 0:
+                progress(self, epoch)
+            if self.me == 0 and self.stop_epoch is None \
+                    and self.measure_epoch is not None \
+                    and now >= warm_edge + cfg.done_secs:
+                self.stop_epoch = epoch + 2
+                sd = wire.encode_shutdown(self.stop_epoch)
+                for p in range(self.n_srv):
+                    if p != self.me:
+                        self.tp.send(p, "SHUTDOWN", sd)
+                self.tp.flush()
+            if tl:
+                tl.mark("respond")
+                tl.emit(self.me, epoch)
+            if self.stop_epoch is not None and epoch >= self.stop_epoch:
+                break
+            epoch += 1
+        # final: notify clients, emit summary
+        for c in range(self.n_cl):
+            self.tp.send(self.n_srv + c, "SHUTDOWN",
+                         wire.encode_shutdown(epoch))
+        self.tp.flush()
+        end = time.monotonic()
+        final = {k: np.asarray(v) for k, v in
+                 jax.device_get(self.dev_stats).items()}
+        if measured is None:
+            measured, self._t_meas = final, end
+        st = self.stats
+        st.set("total_runtime", end - self._t_meas)
+        st.set("epoch_cnt", float(epoch + 1))
+        for k in ("total_txn_commit_cnt", "total_txn_abort_cnt",
+                  "defer_cnt", "write_cnt"):
+            st.set(k, float(final[k] - measured[k]))
+        commits = final["total_txn_commit_cnt"] - measured["total_txn_commit_cnt"]
+        aborts = final["total_txn_abort_cnt"] - measured["total_txn_abort_cnt"]
+        st.set("unique_txn_abort_cnt", float(aborts))
+        st.set("abort_rate",
+               float(aborts) / max(float(commits + aborts), 1.0))
+        for k, v in self.tp.stats().items():
+            st.set(f"net_{k}", float(v))
+        return st
+
+    def close(self) -> None:
+        self.tp.close()
+
+
+class _Timeline:
+    """Per-epoch phase timing (reference DEBUG_TIMELINE, SURVEY §5.1)."""
+
+    def __init__(self):
+        self.t = time.monotonic()
+        self.spans: list[tuple[str, float]] = []
+
+    def mark(self, name: str) -> None:
+        now = time.monotonic()
+        self.spans.append((name, now - self.t))
+        self.t = now
+
+    def emit(self, node: int, epoch: int) -> None:
+        body = " ".join(f"{n}={dt * 1e3:.1f}ms" for n, dt in self.spans)
+        print(f"[timeline] node={node} epoch={epoch} {body}", flush=True)
+        self.spans.clear()
+
+
+def _pad_block(b: wire.QueryBlock, to: int) -> wire.QueryBlock:
+    if len(b) == to:
+        return b
+    assert len(b) < to, "contribution exceeds per-node epoch slice"
+    pad = to - len(b)
+    return wire.QueryBlock(
+        keys=np.concatenate([b.keys, np.zeros((pad, b.keys.shape[1]),
+                                              np.int32)]),
+        types=np.concatenate([b.types, np.zeros((pad, b.types.shape[1]),
+                                                np.int8)]),
+        scalars=np.concatenate([b.scalars,
+                                np.zeros((pad, b.scalars.shape[1]),
+                                         np.int32)]),
+        tags=np.concatenate([b.tags, np.zeros(pad, np.int64)]))
+
+
+@functools.lru_cache(maxsize=1)
+def _key0():
+    import jax
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,111 @@
+"""Message bodies for the distributed runtime (reference `transport/message.cpp`).
+
+The reference defines 20+ typed messages with hand-rolled binary
+serialization per type (`Message::create_message` factory,
+`transport/message.cpp:112-194`, `COPY_VAL/COPY_BUF` `:196-270`).  Here the
+wire vocabulary collapses to four columnar bodies — batch thinking removes
+most of the zoo (RQRY/RPREPARE/RFIN/RACK_* all vanish into the
+deterministic epoch exchange, SURVEY §3.B step 4 → matmul):
+
+* CL_QRY_BATCH  client→server: columnar query block + per-txn tag
+  (reference ClientQueryMessage batches, `message.h:243-340`).
+* CL_RSP        server→client: per-txn ack with latency echo
+  (ClientResponseMessage, `message.h`).
+* EPOCH_BLOB    server→server: one node's contribution to a global epoch
+  (the Calvin sequencer batch, `system/sequencer.cpp:283-326`; doubles as
+  the RDONE epoch barrier — exactly one blob per (server, epoch)).
+* SHUTDOWN      coordinator→all: stop-epoch announcement.
+
+All bodies ride the native framed transport; the query columns use the
+C codec (`dt_qrybatch_encode/decode`) so the server can hand them straight
+to the device without Python-level row loops.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+from deneva_tpu.runtime.native import decode_qrybatch, encode_qrybatch
+
+_HDR = struct.Struct("<q")          # epoch (blob) / stop_epoch (shutdown)
+_RSP = struct.Struct("<II")         # n, pad
+
+
+@dataclass
+class QueryBlock:
+    """Columnar query batch + per-txn metadata."""
+
+    keys: np.ndarray      # int32[n, W]
+    types: np.ndarray     # int8[n, W]  1=read 2=write 3=rmw 0=pad
+    scalars: np.ndarray   # int32[n, S] workload-specific params
+    tags: np.ndarray      # int64[n]    client-assigned txn tag / startts
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @classmethod
+    def empty(cls, width: int, n_scalars: int = 0) -> "QueryBlock":
+        return cls(keys=np.zeros((0, width), np.int32),
+                   types=np.zeros((0, width), np.int8),
+                   scalars=np.zeros((0, n_scalars), np.int32),
+                   tags=np.zeros(0, np.int64))
+
+    @classmethod
+    def concat(cls, blocks: list["QueryBlock"]) -> "QueryBlock":
+        return cls(keys=np.concatenate([b.keys for b in blocks]),
+                   types=np.concatenate([b.types for b in blocks]),
+                   scalars=np.concatenate([b.scalars for b in blocks]),
+                   tags=np.concatenate([b.tags for b in blocks]))
+
+    def slice(self, lo: int, hi: int) -> "QueryBlock":
+        return QueryBlock(self.keys[lo:hi], self.types[lo:hi],
+                          self.scalars[lo:hi], self.tags[lo:hi])
+
+    def take(self, idx: np.ndarray) -> "QueryBlock":
+        return QueryBlock(self.keys[idx], self.types[idx],
+                          self.scalars[idx], self.tags[idx])
+
+
+def encode_qry_block(b: QueryBlock) -> bytes:
+    return encode_qrybatch(b.tags, b.keys, b.types, b.scalars)
+
+
+def decode_qry_block(buf: bytes) -> QueryBlock:
+    tags, keys, types, scalars = decode_qrybatch(buf)
+    return QueryBlock(keys=keys, types=types, scalars=scalars, tags=tags)
+
+
+# ---- EPOCH_BLOB: header(epoch) + query block --------------------------
+
+def encode_epoch_blob(epoch: int, b: QueryBlock) -> bytes:
+    return _HDR.pack(epoch) + encode_qry_block(b)
+
+
+def decode_epoch_blob(buf: bytes) -> tuple[int, QueryBlock]:
+    (epoch,) = _HDR.unpack_from(buf)
+    return epoch, decode_qry_block(buf[_HDR.size:])
+
+
+# ---- CL_RSP: tags + commit latency echo --------------------------------
+
+def encode_cl_rsp(tags: np.ndarray) -> bytes:
+    tags = np.ascontiguousarray(tags, np.int64)
+    return _RSP.pack(len(tags), 0) + tags.tobytes()
+
+
+def decode_cl_rsp(buf: bytes) -> np.ndarray:
+    n, _ = _RSP.unpack_from(buf)
+    return np.frombuffer(buf, np.int64, count=n, offset=_RSP.size)
+
+
+# ---- SHUTDOWN ----------------------------------------------------------
+
+def encode_shutdown(stop_epoch: int) -> bytes:
+    return _HDR.pack(stop_epoch)
+
+
+def decode_shutdown(buf: bytes) -> int:
+    return _HDR.unpack_from(buf)[0]

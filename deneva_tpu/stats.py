@@ -138,11 +138,12 @@ class Stats:
     def summary_fields(self) -> dict[str, float]:
         c = self.counters
         runtime = c.get("total_runtime", 0.0) or self.runtime
-        commit = c.get("total_txn_commit_cnt", 0.0)
         out = dict(c)
         out["total_runtime"] = runtime
-        out["txn_cnt"] = commit
-        out["tput"] = commit / runtime if runtime > 0 else 0.0
+        # servers: txn_cnt = committed; clients count their own responses
+        # (the reference's client [summary] does the same, stats.cpp:1558)
+        out.setdefault("txn_cnt", c.get("total_txn_commit_cnt", 0.0))
+        out["tput"] = out["txn_cnt"] / runtime if runtime > 0 else 0.0
         for name, a in self.arrays.items():
             if len(a):
                 for p, v in a.percentiles().items():

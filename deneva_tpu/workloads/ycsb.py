@@ -66,21 +66,37 @@ class YCSBWorkload:
         self.cfg = cfg
         self.catalog = parse_schema(YCSB_SCHEMA)
         self.n_rows = cfg.synth_table_size
-        self.index = DenseIndex(base=0, stride=1, size=self.n_rows,
-                                miss_slot=self.n_rows)
+        # partitioned deployment (reference `key % g_part_cnt` node
+        # ownership, ycsb_wl.cpp:70-74 / global.h:294): this node stores
+        # only keys ≡ node_id (mod part_cnt); the strided index steers
+        # remote keys to the trash slot so execution is local-only.
+        self.n_parts = max(cfg.part_cnt, 1)
+        if self.n_parts > 1:
+            assert self.n_rows % self.n_parts == 0, \
+                "synth_table_size must divide evenly over part_cnt"
+            self.n_local = self.n_rows // self.n_parts
+            self.index = DenseIndex(base=cfg.node_id, stride=self.n_parts,
+                                    size=self.n_local, miss_slot=self.n_local)
+        else:
+            self.n_local = self.n_rows
+            self.index = DenseIndex(base=0, stride=1, size=self.n_rows,
+                                    miss_slot=self.n_rows)
         self.zipf = Zipfian(self.n_rows, cfg.zipf_theta)
         self.n_req = cfg.req_per_query
 
     # -- loader (ycsb_wl.cpp:125-203) ----------------------------------
     def load(self):
-        tab = DeviceTable.create(self.catalog.table(TABLE), self.n_rows,
+        tab = DeviceTable.create(self.catalog.table(TABLE), self.n_local,
                                  full_row=False)
-        keys = np.arange(self.n_rows, dtype=np.int32)
+        # global keys owned by this node, in slot order
+        keys = (self.cfg.node_id if self.n_parts > 1 else 0) \
+            + np.arange(self.n_local, dtype=np.int32) \
+            * (self.n_parts if self.n_parts > 1 else 1)
         cols = {"F0": np.asarray(_field_fingerprint(keys, 0))}
         # remaining fields share the same fingerprint law; only F0 is
         # touched by queries (ycsb_txn.cpp reads/writes one field)
         for name, v in cols.items():
-            tab.columns[name] = tab.columns[name].at[:self.n_rows].set(
+            tab.columns[name] = tab.columns[name].at[:self.n_local].set(
                 jnp.asarray(v))
         return {TABLE: tab}
 
@@ -91,6 +107,19 @@ class YCSBWorkload:
         is_write = jax.random.bernoulli(k2, self.cfg.write_perc,
                                         (n, self.n_req))
         return YCSBQuery(keys=keys, is_write=is_write)
+
+    # -- wire adapters (distributed runtime, CL_QRY/EPOCH_BLOB bodies) --
+    def to_wire(self, q: YCSBQuery):
+        """(keys int32[n,W], types int8[n,W], scalars int32[n,S]) columnar
+        form fed to the native qrybatch codec."""
+        keys = np.asarray(q.keys, np.int32)
+        types = np.where(np.asarray(q.is_write), 2, 1).astype(np.int8)
+        return keys, types, np.zeros((len(keys), 0), np.int32)
+
+    def from_wire(self, keys: np.ndarray, types: np.ndarray,
+                  scalars: np.ndarray) -> YCSBQuery:
+        return YCSBQuery(keys=jnp.asarray(keys, jnp.int32),
+                         is_write=jnp.asarray(types == 2))
 
     # -- RW-set planning ------------------------------------------------
     def plan(self, db, q: YCSBQuery) -> dict:

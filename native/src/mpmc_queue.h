@@ -16,9 +16,19 @@ namespace deneva {
 template <typename T>
 class MpmcQueue {
  public:
+  // cap = 0: unbounded.  A bounded queue blocks producers when full —
+  // the receiver thread blocking here is what turns into TCP backpressure
+  // on the wire (the reference gets the same effect from its bounded
+  // boost::lockfree ring buffers).
+  explicit MpmcQueue(size_t cap = 0) : cap_(cap) {}
+
   void push(T v) {
     {
-      std::lock_guard<std::mutex> lk(mu_);
+      std::unique_lock<std::mutex> lk(mu_);
+      if (cap_) {
+        cv_space_.wait(lk, [&] { return q_.size() < cap_ || stopped_; });
+        if (stopped_) return;  // shutting down: drop, consumers are gone
+      }
       q_.push_back(std::move(v));
     }
     cv_.notify_one();
@@ -40,6 +50,7 @@ class MpmcQueue {
     }
     *out = std::move(q_.front());
     q_.pop_front();
+    if (cap_) cv_space_.notify_one();
     return true;
   }
 
@@ -62,6 +73,7 @@ class MpmcQueue {
     if (!accept(q_.front())) return 0;
     *out = std::move(q_.front());
     q_.pop_front();
+    if (cap_) cv_space_.notify_one();
     return 1;
   }
 
@@ -71,6 +83,7 @@ class MpmcQueue {
       stopped_ = true;
     }
     cv_.notify_all();
+    cv_space_.notify_all();
   }
 
   size_t size() const {
@@ -81,7 +94,9 @@ class MpmcQueue {
  private:
   mutable std::mutex mu_;
   std::condition_variable cv_;
+  std::condition_variable cv_space_;
   std::deque<T> q_;
+  size_t cap_ = 0;
   bool stopped_ = false;
 };
 

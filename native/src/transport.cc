@@ -62,10 +62,12 @@ struct OutFrame {
   std::vector<uint8_t> bytes;  // header + payload
 };
 
+// MSG_NOSIGNAL: a half-closed peer must surface as EPIPE, not SIGPIPE
+// (CPython ignores SIGPIPE; a bare C++ embedder would die).
 ssize_t write_all(int fd, const uint8_t *p, size_t n) {
   size_t done = 0;
   while (done < n) {
-    ssize_t w = ::write(fd, p + done, n - done);
+    ssize_t w = ::send(fd, p + done, n - done, MSG_NOSIGNAL);
     if (w < 0) {
       if (errno == EINTR) continue;
       return -1;
@@ -92,14 +94,16 @@ struct dt_transport {
   std::vector<std::atomic<bool>> peer_dead;
   int listen_fd = -1;
 
-  // flush protocol: dt_flush bumps flush_req; the sender empties every
-  // mbuf whenever flush_done lags flush_req, then catches it up.
+  // flush protocol: dt_flush bumps flush_req; the sender drains send_q
+  // and empties every mbuf before catching flush_done up to flush_req.
   std::atomic<uint64_t> flush_req{0};
   std::atomic<uint64_t> flush_done{0};
-  std::atomic<uint64_t> mbuf_bytes{0};   // bytes sitting in batch buffers
 
-  deneva::MpmcQueue<OutFrame> send_q;
-  deneva::MpmcQueue<RecvMsg> recv_q;
+  // bounded (SURVEY §2.6: the reference's queues are bounded rings);
+  // full send_q blocks dt_send, full recv_q pauses the reader -> TCP
+  // backpressure reaches the remote sender.
+  deneva::MpmcQueue<OutFrame> send_q{1 << 16};
+  deneva::MpmcQueue<RecvMsg> recv_q{1 << 16};
 
   std::thread sender, receiver;
   std::atomic<bool> stop{false};
@@ -256,12 +260,16 @@ struct dt_transport {
     Mbuf &mb = mbufs[dest];
     if (mb.buf.empty()) return;
     int fd = peer_fd[dest];
-    if (fd >= 0 && !peer_dead[dest].load(std::memory_order_relaxed) &&
-        write_all(fd, mb.buf.data(), mb.buf.size()) >= 0) {
-      bump(DT_STAT_BATCHES_SENT);
-      bump(DT_STAT_BYTES_SENT, mb.buf.size());
+    if (fd >= 0 && !peer_dead[dest].load(std::memory_order_relaxed)) {
+      if (write_all(fd, mb.buf.data(), mb.buf.size()) >= 0) {
+        bump(DT_STAT_BATCHES_SENT);
+        bump(DT_STAT_BYTES_SENT, mb.buf.size());
+      } else {
+        // failed write = dead peer; later sends to it drop visibly
+        // (peer_dead readable via stats going flat) instead of silently
+        peer_dead[dest].store(true, std::memory_order_relaxed);
+      }
     }
-    mbuf_bytes.fetch_sub(mb.buf.size(), std::memory_order_relaxed);
     mb.buf.clear();
     mb.first_us = 0;
   }
@@ -278,11 +286,11 @@ struct dt_transport {
       bool got = send_q.pop(&f, wait);
       uint64_t now = now_us();
       if (got) {
-        if (f.ready_us > now) {
-          delayed.push_back(std::move(f));
-        } else {
-          append(std::move(f), now);
-        }
+        accept(std::move(f), now, delayed);
+        // drain the whole queue per wake: one blocking pop then
+        // non-blocking pops until empty (batching amortizes syscalls)
+        OutFrame g;
+        while (send_q.pop(&g, 0)) accept(std::move(g), now, delayed);
       }
       // release matured delayed frames
       for (size_t i = 0; i < delayed.size();) {
@@ -296,6 +304,13 @@ struct dt_transport {
       // flush full/timed-out buffers; when idle (or told to) flush all
       uint64_t freq = flush_req.load(std::memory_order_acquire);
       bool force = freq != flush_done.load(std::memory_order_relaxed);
+      if (force) {
+        // flush contract: everything enqueued before dt_flush must hit
+        // the wire before the ticket is acked — drain the queue again in
+        // case frames raced in after the drain above
+        OutFrame g;
+        while (send_q.pop(&g, 0)) accept(std::move(g), now, delayed);
+      }
       for (uint32_t d = 0; d < n_nodes; ++d) {
         Mbuf &mb = mbufs[d];
         if (mb.buf.empty()) continue;
@@ -314,11 +329,18 @@ struct dt_transport {
     for (uint32_t d = 0; d < n_nodes; ++d) flush_dest(d);
   }
 
+  void accept(OutFrame f, uint64_t now, std::vector<OutFrame> &delayed) {
+    if (f.ready_us > now) {
+      delayed.push_back(std::move(f));
+    } else {
+      append(std::move(f), now);
+    }
+  }
+
   void append(OutFrame f, uint64_t now) {
     Mbuf &mb = mbufs[f.dest];
     if (mb.buf.empty()) mb.first_us = now;
     mb.buf.insert(mb.buf.end(), f.bytes.begin(), f.bytes.end());
-    mbuf_bytes.fetch_add(f.bytes.size(), std::memory_order_relaxed);
     bump(DT_STAT_MSG_SENT);
     if (mb.buf.size() >= msg_size_max) flush_dest(f.dest);
   }
@@ -551,14 +573,19 @@ long dt_ping(dt_transport *t, uint32_t peer, uint32_t rounds,
   if (!t || peer >= t->n_nodes || rounds == 0) return -1;
   (void)payload_len;  // round-trip carries the 8-byte timestamp
   uint64_t total_ns = 0;
+  uint64_t stale;
+  while (t->pong_q.pop(&stale, 0)) {  // drop pongs from timed-out rounds
+  }
   for (uint32_t i = 0; i < rounds; ++i) {
     uint64_t t0 = now_us();
     if (t->enqueue(peer, DT_PING, reinterpret_cast<uint8_t *>(&t0),
                    sizeof(t0)) != 0)
       return -1;
-    uint64_t echoed;
-    if (!t->pong_q.pop(&echoed, 2'000'000)) return -1;  // 2s timeout
-    total_ns += (now_us() - echoed) * 1000;
+    uint64_t echoed = 0;
+    do {  // skip any pong that is not the echo of this round's t0
+      if (!t->pong_q.pop(&echoed, 2'000'000)) return -1;  // 2s timeout
+    } while (echoed != t0);
+    total_ns += (now_us() - t0) * 1000;
   }
   return static_cast<long>(total_ns / rounds);
 }

@@ -3,6 +3,7 @@ Unix-domain sockets — the reference's IPC single-box integration rig
 (`transport/transport.cpp:132-133`, SURVEY §4.4)."""
 
 import threading
+import time
 import uuid
 
 import numpy as np
@@ -181,7 +182,13 @@ def test_stats_counters(lib):
     try:
         a.send(1, "INIT_DONE", b"abc")
         b.recv(timeout_us=2_000_000)
-        sa, sb = a.stats(), b.stats()
+        # sender-side counters are bumped by the IO thread after the socket
+        # write; the receiver can see the message first — poll briefly
+        for _ in range(200):
+            sa, sb = a.stats(), b.stats()
+            if sa["bytes_sent"] >= 15:
+                break
+            time.sleep(0.005)
         assert sa["msg_sent"] >= 1 and sa["bytes_sent"] >= 15
         assert sb["msg_rcvd"] >= 1 and sb["bytes_rcvd"] >= 15
     finally:
