@@ -149,6 +149,7 @@ class Config:
     # ---- logging (reference config.h:145-149) ----
     logging: bool = False
     log_buf_timeout_us: float = 10.0
+    log_dir: str = "/tmp/deneva_logs"
 
     # ---- epoch engine (TPU-shaped; replaces thread/latch knobs) ----
     epoch_batch: int = 2048        # txns validated per epoch (Calvin SEQ_BATCH analogue)

@@ -40,19 +40,21 @@ class ClientNode:
         self.cfg = cfg
         self.me = cfg.node_id                   # transport id (>= node_cnt)
         self.n_srv = cfg.node_cnt
+        self.n_all = (self.n_srv + cfg.client_node_cnt
+                      + cfg.replica_cnt * cfg.node_cnt)
         self.wl = get_workload(cfg)
-        self.tp = NativeTransport(self.me, endpoints,
-                                  self.n_srv + cfg.client_node_cnt,
+        self.tp = NativeTransport(self.me, endpoints, self.n_all,
                                   msg_size_max=cfg.msg_size_max)
         self.tp.start()
         self.inflight = np.zeros(self.n_srv, np.int64)
-        # reference: inflight cap is per server pair (client_txn.cpp:25)
-        self.cap = max(1, cfg.max_txn_in_flight // max(cfg.client_node_cnt, 1))
+        # reference: inflight cap is per server pair (client_txn.cpp:25);
+        # floored at one send chunk or the client could never send at all
+        self.cap = max(QRY_CHUNK,
+                       cfg.max_txn_in_flight // max(cfg.client_node_cnt, 1))
         self.send_us = np.zeros(TAG_RING, np.int64)   # tag -> send time
         self.next_tag = 0
         self.stats = Stats()
         self.stop = False
-        self._init_seen: set[int] = set()
 
         # pre-generate a query ring (client_query.cpp pre-generation):
         # enough blocks that wraparound reuse is harmless (fresh zipf draws
@@ -75,14 +77,12 @@ class ClientNode:
         if rtype == "CL_RSP":
             tags = wire.decode_cl_rsp(payload)
             now = time.monotonic_ns() // 1000
-            self.inflight[src - 0] -= len(tags)   # src is a server id
+            self.inflight[src] -= len(tags)       # src is a server id
             sent = self.send_us[tags % TAG_RING]
             lat_arr.extend((now - sent) / 1e6)    # seconds
             self.stats.incr("txn_cnt", len(tags))
         elif rtype == "SHUTDOWN":
             self.stop = True
-        elif rtype == "INIT_DONE":
-            self._init_seen.add(src)
 
     def _drain(self, lat_arr, timeout_us: int = 0) -> None:
         while True:
@@ -93,19 +93,10 @@ class ClientNode:
             timeout_us = 0
 
     def barrier(self, timeout_s: float = 60.0) -> None:
-        self._init_seen = {self.me}
-        n_all = self.n_srv + self.cfg.client_node_cnt
-        for p in range(n_all):
-            if p != self.me:
-                self.tp.send(p, "INIT_DONE")
-        self.tp.flush()
         lat = self.stats.arr("client_client_latency")
-        t0 = time.monotonic()
-        while len(self._init_seen) < n_all:
-            if time.monotonic() - t0 > timeout_s:
-                raise TimeoutError(f"client {self.me}: barrier timeout "
-                                   f"({sorted(self._init_seen)})")
-            self._drain(lat, timeout_us=10_000)
+        wire.run_barrier(self.tp, self.me, self.n_all,
+                         lambda s, r, p: self._route(s, r, p, lat),
+                         f"client {self.me}", timeout_s)
 
     # ------------------------------------------------------------------
     def run(self) -> Stats:

@@ -37,6 +37,17 @@ def _server_main(cfg: Config, endpoints: str, platform: str | None, q) -> None:
         q.put((cfg.node_id, "error", traceback.format_exc()))
 
 
+def _replica_main(cfg: Config, endpoints: str, q) -> None:
+    try:
+        from deneva_tpu.runtime.replica import ReplicaNode
+        node = ReplicaNode(cfg, endpoints)
+        st = node.run()
+        q.put((cfg.node_id, "replica", st.summary_line()))
+        node.close()
+    except Exception:
+        q.put((cfg.node_id, "error", traceback.format_exc()))
+
+
 def _client_main(cfg: Config, endpoints: str, platform: str | None, q) -> None:
     try:
         if platform:
@@ -64,8 +75,9 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
             "loaders so far (to_wire/from_wire on the workload); TPCC/PPS "
             "run on the single-node engine")
     n_srv, n_cl = cfg.node_cnt, cfg.client_node_cnt
+    n_repl = cfg.replica_cnt * n_srv
     run_id = run_id or f"{os.getpid()}_{abs(hash(cfg)) % 99999}"
-    endpoints = ipc_endpoints(n_srv + n_cl, run_id)
+    endpoints = ipc_endpoints(n_srv + n_cl + n_repl, run_id)
     if timeout_s is None:
         timeout_s = cfg.warmup_secs + cfg.done_secs + 120
 
@@ -84,12 +96,27 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
             args=(cfg.replace(node_id=n_srv + c, part_cnt=n_srv), endpoints,
                   platform, q),
             daemon=True))
+    for r in range(n_repl):
+        procs.append(ctx.Process(
+            target=_replica_main,
+            args=(cfg.replace(node_id=n_srv + n_cl + r, part_cnt=n_srv),
+                  endpoints, q),
+            daemon=True))
     for p in procs:
         p.start()
     out: dict[int, tuple[str, str]] = {}
     try:
+        import queue as _queue
         for _ in procs:
-            nid, kind, line = q.get(timeout=timeout_s)
+            try:
+                nid, kind, line = q.get(timeout=timeout_s)
+            except _queue.Empty:
+                dead = [i for i, p in enumerate(procs)
+                        if not p.is_alive() and p.exitcode not in (0, None)]
+                raise RuntimeError(
+                    f"cluster timed out after {timeout_s:.0f}s; reported="
+                    f"{sorted(out)}, crashed procs (index, exitcode)="
+                    f"{[(i, procs[i].exitcode) for i in dead]}") from None
             if kind == "error":
                 raise RuntimeError(f"node {nid} failed:\n{line}")
             out[nid] = (kind, line)

@@ -1,0 +1,152 @@
+"""Logging + active-passive replication (reference `system/logger.{h,cpp}`,
+`system/log_thread.cpp`, REPLICA flow in SURVEY §5.4).
+
+The reference writes per-write command records `LogRecord{lsn,iud,txn_id,
+table_id,key}` (`logger.cpp:8-60`); a commit enqueues L_NOTIFY and parks
+until the LogThread flushes (`txn.cpp:434-441`,
+`worker_thread.cpp:543-554`), and with replication also ships records as
+LOG_MSG to a replica and waits for the ack (`worker_thread.cpp:527-541`).
+It has **no replay path** — recovery is unimplemented there.
+
+Here the unit of durability is the *epoch*: one length-framed record holds
+the merged epoch block (the full command stream) + the active mask.
+Because epoch validation/execution is a deterministic pure function,
+replay is literal re-execution — command logging finally pays for itself.
+Group commit falls out naturally: CL_RSPs for epoch e are held until the
+log record of e is on disk (and acked by the replica when configured),
+which is exactly the reference's commit-parks-until-flush semantics
+amortized over a batch.
+
+Wire/disk framing (little-endian):
+    magic u32 | epoch i64 | blob_len u32 | active_len u32
+    | blob bytes (wire.encode_epoch_blob payload) | active bitmask bytes
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import queue as _queue
+
+import numpy as np
+
+_FRAME = struct.Struct("<IqII")
+_MAGIC = 0xDE7E7A10
+
+
+def pack_record(epoch: int, blob: bytes, active: np.ndarray) -> bytes:
+    bits = np.packbits(active.astype(np.uint8))
+    return _FRAME.pack(_MAGIC, epoch, len(blob), len(bits)) + blob \
+        + bits.tobytes()
+
+
+def unpack_records(buf: bytes):
+    """Yield (epoch, blob_bytes, active_bits) from a log byte stream;
+    stops cleanly at a torn tail (crash mid-write)."""
+    off = 0
+    while off + _FRAME.size <= len(buf):
+        magic, epoch, blen, alen = _FRAME.unpack_from(buf, off)
+        if magic != _MAGIC or off + _FRAME.size + blen + alen > len(buf):
+            return
+        blob = buf[off + _FRAME.size: off + _FRAME.size + blen]
+        bits = np.frombuffer(buf, np.uint8, count=alen,
+                             offset=off + _FRAME.size + blen)
+        yield epoch, blob, bits
+        off += _FRAME.size + blen + alen
+
+
+class EpochLogger:
+    """Background log writer (the reference's LogThread).
+
+    ``append`` enqueues; the writer thread writes + flushes and advances
+    ``flushed_epoch``.  ``wait_flushed`` is the L_NOTIFY/park analogue —
+    but callers poll it per epoch instead of parking per txn.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._q: _queue.Queue = _queue.Queue()
+        self._flushed = -1
+        self._cv = threading.Condition()
+        self._stop = False
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "wb")
+        self._thr = threading.Thread(target=self._run, daemon=True)
+        self._thr.start()
+        self.records = 0
+        self.bytes = 0
+
+    def append(self, epoch: int, blob: bytes, active: np.ndarray) -> None:
+        self._q.put((epoch, pack_record(epoch, blob, active)))
+
+    @property
+    def flushed_epoch(self) -> int:
+        with self._cv:
+            return self._flushed
+
+    def wait_flushed(self, epoch: int, timeout: float = 10.0) -> bool:
+        with self._cv:
+            return self._cv.wait_for(lambda: self._flushed >= epoch,
+                                     timeout)
+
+    def _run(self) -> None:
+        while True:
+            try:
+                item = self._q.get(timeout=0.05)
+            except _queue.Empty:
+                if self._stop:
+                    return
+                continue
+            if item is None:
+                return
+            epoch, rec = item
+            self._f.write(rec)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            self.records += 1
+            self.bytes += len(rec)
+            with self._cv:
+                self._flushed = max(self._flushed, epoch)
+                self._cv.notify_all()
+
+    def close(self) -> None:
+        self._stop = True
+        self._q.put(None)
+        self._thr.join(timeout=5)
+        self._f.close()
+
+
+def replay_log(path: str, cfg) -> dict:
+    """Rebuild table state by re-executing the logged command stream
+    (deterministic replay; the reference has no equivalent —
+    `system/logger.cpp` writes records it never reads back).
+
+    Returns the reconstructed ``db`` dict for this node's partition.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deneva_tpu.cc import get_backend
+    from deneva_tpu.engine.step import init_device_stats
+    from deneva_tpu.runtime import wire
+    from deneva_tpu.runtime.server import make_dist_step
+    from deneva_tpu.workloads import get_workload
+
+    wl = get_workload(cfg)
+    be = get_backend(cfg.cc_alg)
+    step = make_dist_step(cfg, wl, be)
+    db = wl.load()
+    cc_state = be.init_state(cfg)
+    stats = init_device_stats()
+    with open(path, "rb") as f:
+        buf = f.read()
+    for epoch, blob, bits in unpack_records(buf):
+        _, block = wire.decode_epoch_blob(blob)
+        active = np.unpackbits(bits)[: len(block.keys)].astype(bool)
+        query = wl.from_wire(block.keys, block.types, block.scalars)
+        db, cc_state, stats, *_ = step(db, cc_state, stats,
+                                       jnp.int32(epoch),
+                                       jnp.asarray(active), query)
+    jax.block_until_ready(stats["total_txn_commit_cnt"])
+    return db

@@ -1,0 +1,77 @@
+"""Replica node (reference active-passive replication, SURVEY §5.4:
+`REPLICA_CNT`/`REPL_TYPE` `config.h:24-27`, replica id range ISREPLICA
+`system/global.h:301`, LOG_MSG/LOG_MSG_RSP flow
+`system/worker_thread.cpp:527-541`).
+
+A replica is a log sink: it receives its primary's framed epoch records
+(LOG_MSG payload = the exact bytes the primary fsyncs), appends them to
+its own log file, and acks the epoch (LOG_RSP).  The primary's group
+commit waits for both its local flush and this ack.  Unlike the reference
+(which never reads records back), a replica's log replays with
+`runtime.logger.replay_log` to rebuild the primary's partition state —
+that is the failover story: promote by replay.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+from deneva_tpu.config import Config
+from deneva_tpu.runtime import wire
+from deneva_tpu.runtime.native import NativeTransport
+from deneva_tpu.stats import Stats
+
+_EPOCH_HDR = struct.Struct("<Iq")   # magic, epoch (prefix of logger._FRAME)
+
+
+class ReplicaNode:
+    def __init__(self, cfg: Config, endpoints: str):
+        self.cfg = cfg
+        self.me = cfg.node_id
+        self.n_srv = cfg.node_cnt
+        self.n_cl = cfg.client_node_cnt
+        n_repl = cfg.replica_cnt * cfg.node_cnt
+        self.n_all = self.n_srv + self.n_cl + n_repl
+        # replica r backs primary r (id layout: servers, clients, replicas)
+        self.primary = (self.me - self.n_srv - self.n_cl) % self.n_srv
+        self.tp = NativeTransport(self.me, endpoints, self.n_all,
+                                  msg_size_max=cfg.msg_size_max)
+        self.tp.start()
+        self.log_path = os.path.join(cfg.log_dir,
+                                     f"replica{self.me}.log.bin")
+        os.makedirs(cfg.log_dir, exist_ok=True)
+        self._f = open(self.log_path, "wb")
+        self.stats = Stats()
+        self.stop = False
+
+    def barrier(self, timeout_s: float = 60.0) -> None:
+        wire.run_barrier(self.tp, self.me, self.n_all, self._handle,
+                         f"replica {self.me}", timeout_s)
+
+    def _handle(self, src: int, rtype: str, payload: bytes) -> None:
+        if rtype == "LOG_MSG":
+            self._f.write(payload)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            _, epoch = _EPOCH_HDR.unpack_from(payload)
+            self.tp.send(src, "LOG_RSP", wire.encode_shutdown(epoch))
+            self.stats.incr("log_records")
+            self.stats.incr("log_bytes", len(payload))
+        elif rtype == "SHUTDOWN":
+            self.stop = True
+
+    def run(self) -> Stats:
+        self.barrier()
+        t0 = time.monotonic()
+        while not self.stop:
+            m = self.tp.recv(20_000)
+            if m:
+                self._handle(*m)
+        self._f.close()
+        self.stats.set("total_runtime", time.monotonic() - t0)
+        return self.stats
+
+    def close(self) -> None:
+        self.tp.close()

@@ -34,6 +34,7 @@ every node modulo the partition index baked into its workload.
 from __future__ import annotations
 
 import functools
+import os
 import time
 from collections import deque
 
@@ -155,6 +156,7 @@ class ServerNode:
         self.me = cfg.node_id
         self.n_srv = cfg.node_cnt
         self.n_cl = cfg.client_node_cnt
+        self.n_repl = cfg.replica_cnt * cfg.node_cnt
         self.b_loc = max(1, cfg.epoch_batch // self.n_srv)
         self.b_merged = self.b_loc * self.n_srv
         self.wl = get_workload(cfg)
@@ -164,12 +166,27 @@ class ServerNode:
         self.cc_state = self.be.init_state(cfg)
         self.dev_stats = init_device_stats()
 
-        self.tp = NativeTransport(self.me, endpoints, self.n_srv + self.n_cl,
+        self.tp = NativeTransport(self.me, endpoints,
+                                  self.n_srv + self.n_cl + self.n_repl,
                                   msg_size_max=cfg.msg_size_max)
         self.tp.start()
+        # durability (reference LOGGING + replication, SURVEY §5.4):
+        # per-epoch command-log records; CL_RSPs gate on flush + replica ack
+        self.logger = None
+        self.log_path = None
+        # my replicas: layout [servers | clients | replicas], replica r
+        # backs primary r % n_srv — so mine sit every n_srv slots
+        self.repl_ids = [self.n_srv + self.n_cl + self.me + k * self.n_srv
+                         for k in range(cfg.replica_cnt)]
+        self.repl_acked = {r: -1 for r in self.repl_ids}
+        self._held_rsp: deque[tuple[int, int, np.ndarray]] = deque()
+        if cfg.logging:
+            from deneva_tpu.runtime.logger import EpochLogger
+            self.log_path = os.path.join(cfg.log_dir,
+                                         f"node{self.me}.log.bin")
+            self.logger = EpochLogger(self.log_path)
         # new_txn_queue: FIFO of (src client id, query block)
         self.pending: deque[tuple[int, wire.QueryBlock]] = deque()
-        self.pending_rows = 0
         self.retry = _RetryQueue(cfg.backoff)
         self.blob_buf: dict[int, dict[int, wire.QueryBlock]] = {}
         self.stop_epoch: int | None = None
@@ -187,7 +204,6 @@ class ServerNode:
             # stamp the source client into the tag's high bits? no — tags
             # are opaque to servers; remember src alongside
             self.pending.append((src, blk))
-            self.pending_rows += len(blk)
         elif rtype == "EPOCH_BLOB":
             epoch, blk = wire.decode_epoch_blob(payload)
             self.blob_buf.setdefault(epoch, {})[src] = blk
@@ -195,8 +211,12 @@ class ServerNode:
             self.stop_epoch = wire.decode_shutdown(payload)
         elif rtype == "MEASURE":
             self.measure_epoch = wire.decode_shutdown(payload)
+        elif rtype == "LOG_RSP":
+            # this replica acked everything up to this epoch (FIFO link)
+            e = wire.decode_shutdown(payload)
+            self.repl_acked[src] = max(self.repl_acked.get(src, -1), e)
         elif rtype == "INIT_DONE":
-            self._init_seen.add(src)
+            pass  # late barrier duplicate; the barrier itself already ran
 
     def _drain(self, timeout_us: int = 0) -> None:
         while True:
@@ -208,18 +228,9 @@ class ServerNode:
 
     # -- barrier (reference INIT_DONE, system/sim_manager.cpp:95-100) ----
     def barrier(self, timeout_s: float = 60.0) -> None:
-        self._init_seen = {self.me}
-        for p in range(self.n_srv + self.n_cl):
-            if p != self.me:
-                self.tp.send(p, "INIT_DONE")
-        self.tp.flush()
-        t0 = time.monotonic()
-        while len(self._init_seen) < self.n_srv + self.n_cl:
-            if time.monotonic() - t0 > timeout_s:
-                raise TimeoutError(
-                    f"server {self.me}: INIT_DONE barrier timed out "
-                    f"({sorted(self._init_seen)})")
-            self._drain(timeout_us=10_000)
+        wire.run_barrier(self.tp, self.me,
+                         self.n_srv + self.n_cl + self.n_repl,
+                         self._route, f"server {self.me}", timeout_s)
 
     # -- admission (client_thread + new_txn_queue + abort_queue) ---------
     def _contribution(self, epoch: int
@@ -241,7 +252,6 @@ class ServerNode:
             else:
                 self.pending[0] = (src, blk.slice(room, len(blk)))
                 use = blk.slice(0, room)
-            self.pending_rows -= len(use)
             packed = (np.int64(src) << 40) | (use.tags & _TAG_MASK)
             blocks.append(wire.QueryBlock(use.keys, use.types, use.scalars,
                                           packed))
@@ -252,6 +262,33 @@ class ServerNode:
             counts = [np.zeros(0, np.int32)]
         block = wire.QueryBlock.concat(blocks)
         return block, np.concatenate(counts)
+
+    def _durable_through(self) -> int:
+        """Highest epoch that is on disk locally AND acked by every one of
+        my replicas (the reference's `log_flushed && repl_finished` commit
+        gate, `system/txn.cpp:436`)."""
+        e = self.logger.flushed_epoch
+        for r in self.repl_ids:
+            e = min(e, self.repl_acked[r])
+        return e
+
+    def _flush_held_rsp(self, wait_epoch: int | None = None) -> None:
+        """Release group-committed responses whose epoch is durable.
+        With ``wait_epoch`` set, block (bounded) until that epoch is
+        durable — used at shutdown so no committed txn loses its ack."""
+        if self.logger is None:
+            return
+        if wait_epoch is not None:
+            t0 = time.monotonic()
+            while self._durable_through() < wait_epoch \
+                    and time.monotonic() - t0 < 10.0:
+                self.logger.wait_flushed(wait_epoch, timeout=0.05)
+                if self.n_repl:
+                    self._drain(timeout_us=10_000)
+        durable = self._durable_through()
+        while self._held_rsp and self._held_rsp[0][1] <= durable:
+            c, _, tags = self._held_rsp.popleft()
+            self.tp.send(c, "CL_RSP", wire.encode_cl_rsp(tags))
 
     # -- one global epoch ------------------------------------------------
     def run(self, progress=None) -> Stats:
@@ -336,14 +373,34 @@ class ServerNode:
             # respond for my slice; restart my aborted/deferred slice
             lo = self.me * self.b_loc
             mine = slice(lo, lo + len(block))
+            if self.logger is not None:
+                # command log: the MERGED epoch block + active mask is the
+                # log record — deterministic replay = re-execution of the
+                # full command stream; ship the same record to my replica
+                # (LOG_MSG, SURVEY §5.4)
+                from deneva_tpu.runtime.logger import pack_record
+                rec = wire.encode_epoch_blob(epoch, merged)
+                self.logger.append(epoch, rec, active_np)
+                # LOG_MSG payload = the framed record verbatim, so each
+                # replica's log file is byte-identical to the primary's
+                framed = pack_record(epoch, rec, active_np) \
+                    if self.repl_ids else None
+                for r in self.repl_ids:
+                    self.tp.send(r, "LOG_MSG", framed)
             my_commit = commit[mine]
             if my_commit.any():
                 # tag high bits carry the home client's transport id
                 tags = block.tags[my_commit]
                 clients = tags >> 40
                 for c in np.unique(clients):
-                    self.tp.send(int(c), "CL_RSP", wire.encode_cl_rsp(
-                        tags[clients == c] & _TAG_MASK))
+                    rsp = (int(c), epoch, tags[clients == c] & _TAG_MASK)
+                    if self.logger is None:
+                        self.tp.send(rsp[0], "CL_RSP",
+                                     wire.encode_cl_rsp(rsp[2]))
+                    else:
+                        # group commit: hold until epoch is durable
+                        self._held_rsp.append(rsp)
+            self._flush_held_rsp()
             restart = (abort | defer)[mine]
             if restart.any():
                 idx = np.where(restart)[0]
@@ -368,11 +425,19 @@ class ServerNode:
             if self.stop_epoch is not None and epoch >= self.stop_epoch:
                 break
             epoch += 1
-        # final: notify clients, emit summary
+        # final: release remaining group-committed acks, notify clients
+        # and my replica, emit summary
+        self._flush_held_rsp(wait_epoch=epoch)
         for c in range(self.n_cl):
             self.tp.send(self.n_srv + c, "SHUTDOWN",
                          wire.encode_shutdown(epoch))
+        for r in self.repl_ids:
+            self.tp.send(r, "SHUTDOWN", wire.encode_shutdown(epoch))
         self.tp.flush()
+        if self.logger is not None:
+            self.stats.set("log_records", float(self.logger.records))
+            self.stats.set("log_bytes", float(self.logger.bytes))
+            self.logger.close()
         end = time.monotonic()
         final = {k: np.asarray(v) for k, v in
                  jax.device_get(self.dev_stats).items()}

@@ -109,3 +109,32 @@ def encode_shutdown(stop_epoch: int) -> bytes:
 
 def decode_shutdown(buf: bytes) -> int:
     return _HDR.unpack_from(buf)[0]
+
+
+# ---- INIT_DONE barrier (reference sim_manager setup counting,
+# `system/sim_manager.cpp:95-100`) ---------------------------------------
+
+def run_barrier(tp, me: int, n_all: int, on_other, who: str,
+                timeout_s: float = 60.0) -> None:
+    """Send INIT_DONE to every peer, then drain until all peers' INIT_DONEs
+    arrive.  Non-barrier messages that race in early are handed to
+    ``on_other(src, rtype, payload)`` so no protocol traffic is lost."""
+    import time as _time
+
+    seen = {me}
+    for p in range(n_all):
+        if p != me:
+            tp.send(p, "INIT_DONE")
+    tp.flush()
+    t0 = _time.monotonic()
+    while len(seen) < n_all:
+        if _time.monotonic() - t0 > timeout_s:
+            raise TimeoutError(
+                f"{who}: INIT_DONE barrier timed out ({sorted(seen)})")
+        m = tp.recv(10_000)
+        if m is None:
+            continue
+        if m[1] == "INIT_DONE":
+            seen.add(m[0])
+        else:
+            on_other(*m)
