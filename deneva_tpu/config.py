@@ -176,6 +176,12 @@ class Config:
     msg_size_max: int = 4096
     msg_time_limit_us: float = 0.0
 
+    # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
+    # the reference cannot recover; we can) ----
+    checkpoint_path: str = ""      # "" = checkpointing off
+    checkpoint_every_epochs: int = 0   # 0 = only at end of run
+    resume: bool = False           # load checkpoint_path before running
+
     # ---- misc ----
     seed: int = 0
     debug_timeline: bool = False

@@ -1,0 +1,65 @@
+"""Checkpoint / resume of engine state.
+
+The reference has **no checkpointing** (SURVEY §5.4: logging+replication
+are the closest thing; recovery is unimplemented).  Here the whole
+`EngineState` is one pytree — tables, CC watermarks, txn pool, RNG, epoch
+counter, stats — so a checkpoint is a flat dump of its leaves and resume
+is bit-exact: a resumed run continues the *identical* epoch stream the
+uninterrupted run would have produced (the RNG key is state, not ambient).
+
+Format: one ``.npz`` with leaves in flatten order plus their key-paths for
+a structure sanity check.  The config is not serialized — the caller
+recreates the engine from the same `Config` (the reference pins config at
+compile time; we pin it at restore time and verify leaf shapes agree).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+
+import jax
+import numpy as np
+
+
+def save_state(path: str, state) -> None:
+    """Dump a state pytree (EngineState or any pytree of arrays)."""
+    leaves_p = jax.tree_util.tree_flatten_with_path(state)[0]
+    payload = {f"leaf_{i:04d}": np.asarray(jax.device_get(v))
+               for i, (_, v) in enumerate(leaves_p)}
+    payload["__paths__"] = np.array(
+        [jax.tree_util.keystr(p) for p, _ in leaves_p])
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)          # atomic: no torn checkpoints
+
+
+def load_state(path: str, template):
+    """Rebuild a state pytree from ``path`` using ``template`` (a freshly
+    initialized state of the same config) for structure and placement."""
+    with np.load(path, allow_pickle=False) as z:
+        paths = list(z["__paths__"])
+        leaves_t, treedef = jax.tree_util.tree_flatten_with_path(template)
+        if len(paths) != len(leaves_t):
+            raise ValueError(
+                f"checkpoint has {len(paths)} leaves, template has "
+                f"{len(leaves_t)} — config mismatch?")
+        leaves = []
+        for i, ((p, t), saved_path) in enumerate(zip(leaves_t, paths)):
+            if jax.tree_util.keystr(p) != str(saved_path):
+                raise ValueError(
+                    f"leaf {i} path mismatch: checkpoint "
+                    f"{saved_path!r} vs template {jax.tree_util.keystr(p)!r}")
+            v = z[f"leaf_{i:04d}"]
+            if hasattr(t, "shape") and tuple(t.shape) != v.shape:
+                raise ValueError(
+                    f"leaf {jax.tree_util.keystr(p)}: shape {v.shape} != "
+                    f"template {tuple(t.shape)} — config mismatch?")
+            leaves.append(jax.numpy.asarray(v, dtype=getattr(t, "dtype",
+                                                             None)))
+    return jax.tree_util.tree_unflatten(
+        jax.tree_util.tree_structure(template), leaves)

@@ -36,11 +36,16 @@ def run_simulation(cfg: Config, chunk: int = 50,
     wl = get_workload(cfg)
     eng = Engine(cfg, wl)
     state = eng.init_state()
+    if cfg.resume and cfg.checkpoint_path:
+        from deneva_tpu.engine.checkpoint import load_state
+        state = load_state(cfg.checkpoint_path, state)
 
     # compile once (excluded from both windows, like the reference's setup
     # barrier, system/thread.cpp:62-84)
     state = eng.jit_run(state, chunk)
     jax.block_until_ready(state.stats["total_txn_commit_cnt"])
+
+    ckpt_due = [cfg.checkpoint_every_epochs]
 
     def run_window(state, secs):
         t0 = time.monotonic()
@@ -49,6 +54,12 @@ def run_simulation(cfg: Config, chunk: int = 50,
             state = eng.jit_run(state, chunk)
             jax.block_until_ready(state.stats["total_txn_commit_cnt"])
             epochs += chunk
+            if cfg.checkpoint_path and cfg.checkpoint_every_epochs:
+                ckpt_due[0] -= chunk
+                if ckpt_due[0] <= 0:
+                    from deneva_tpu.engine.checkpoint import save_state
+                    save_state(cfg.checkpoint_path, state)
+                    ckpt_due[0] = cfg.checkpoint_every_epochs
         return state, epochs, time.monotonic() - t0
 
     state, _, _ = run_window(state, cfg.warmup_secs)
@@ -78,6 +89,9 @@ def run_simulation(cfg: Config, chunk: int = 50,
         samples = np.repeat(centers, np.minimum(hist, 100000).astype(np.int64))
         st.arr("client_client_latency").extend(samples)
     st.set("abort_rate", float(aborts) / max(float(commits + aborts), 1.0))
+    if cfg.checkpoint_path:
+        from deneva_tpu.engine.checkpoint import save_state
+        save_state(cfg.checkpoint_path, state)
     if not quiet:
         print(st.summary_line())
     return st

@@ -78,6 +78,10 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
     n_repl = cfg.replica_cnt * n_srv
     run_id = run_id or f"{os.getpid()}_{abs(hash(cfg)) % 99999}"
     endpoints = ipc_endpoints(n_srv + n_cl + n_repl, run_id)
+    if cfg.logging:
+        # namespace log files per run like the IPC endpoints, or two
+        # concurrent clusters would truncate each other's logs
+        cfg = cfg.replace(log_dir=os.path.join(cfg.log_dir, run_id))
     if timeout_s is None:
         timeout_s = cfg.warmup_secs + cfg.done_secs + 120
 

@@ -70,6 +70,7 @@ class EpochLogger:
         self._flushed = -1
         self._cv = threading.Condition()
         self._stop = False
+        self._error: BaseException | None = None
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._f = open(path, "wb")
         self._thr = threading.Thread(target=self._run, daemon=True)
@@ -77,18 +78,33 @@ class EpochLogger:
         self.records = 0
         self.bytes = 0
 
-    def append(self, epoch: int, blob: bytes, active: np.ndarray) -> None:
-        self._q.put((epoch, pack_record(epoch, blob, active)))
+    def _raise_if_failed(self) -> None:
+        # a dead writer thread means durability is gone: surface it loudly
+        # instead of holding client acks forever
+        if self._error is not None:
+            raise RuntimeError(
+                f"log writer failed for {self.path}") from self._error
+
+    def append(self, epoch: int, blob: bytes, active: np.ndarray,
+               framed: bytes | None = None) -> None:
+        """Queue one epoch record; ``framed`` lets callers that already
+        built the packed record (replica shipping) avoid packing twice."""
+        self._raise_if_failed()
+        self._q.put((epoch, framed if framed is not None
+                     else pack_record(epoch, blob, active)))
 
     @property
     def flushed_epoch(self) -> int:
+        self._raise_if_failed()
         with self._cv:
             return self._flushed
 
     def wait_flushed(self, epoch: int, timeout: float = 10.0) -> bool:
+        self._raise_if_failed()
         with self._cv:
-            return self._cv.wait_for(lambda: self._flushed >= epoch,
-                                     timeout)
+            return self._cv.wait_for(
+                lambda: self._flushed >= epoch or self._error is not None,
+                timeout)
 
     def _run(self) -> None:
         while True:
@@ -101,9 +117,15 @@ class EpochLogger:
             if item is None:
                 return
             epoch, rec = item
-            self._f.write(rec)
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            try:
+                self._f.write(rec)
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except OSError as e:
+                with self._cv:
+                    self._error = e
+                    self._cv.notify_all()
+                return
             self.records += 1
             self.bytes += len(rec)
             with self._cv:

@@ -34,8 +34,6 @@ class ReplicaNode:
         self.n_cl = cfg.client_node_cnt
         n_repl = cfg.replica_cnt * cfg.node_cnt
         self.n_all = self.n_srv + self.n_cl + n_repl
-        # replica r backs primary r (id layout: servers, clients, replicas)
-        self.primary = (self.me - self.n_srv - self.n_cl) % self.n_srv
         self.tp = NativeTransport(self.me, endpoints, self.n_all,
                                   msg_size_max=cfg.msg_size_max)
         self.tp.start()

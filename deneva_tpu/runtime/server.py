@@ -380,11 +380,11 @@ class ServerNode:
                 # (LOG_MSG, SURVEY §5.4)
                 from deneva_tpu.runtime.logger import pack_record
                 rec = wire.encode_epoch_blob(epoch, merged)
-                self.logger.append(epoch, rec, active_np)
                 # LOG_MSG payload = the framed record verbatim, so each
                 # replica's log file is byte-identical to the primary's
-                framed = pack_record(epoch, rec, active_np) \
-                    if self.repl_ids else None
+                # by construction (one packing, two destinations)
+                framed = pack_record(epoch, rec, active_np)
+                self.logger.append(epoch, rec, active_np, framed=framed)
                 for r in self.repl_ids:
                     self.tp.send(r, "LOG_MSG", framed)
             my_commit = commit[mine]

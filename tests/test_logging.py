@@ -83,7 +83,8 @@ def test_cluster_with_replicas_logs_identical(tmp_path):
 
     cfg = _cfg(tmp_path, node_cnt=2, client_node_cnt=1, replica_cnt=1,
                epoch_batch=128, synth_table_size=4096)
-    out = run_cluster(cfg, platform="cpu")
+    out = run_cluster(cfg, platform="cpu", run_id="replitest")
+    log_dir = os.path.join(tmp_path, "replitest")  # per-run namespacing
     # servers 0,1; client 2; replicas 3,4
     assert set(out) == {0, 1, 2, 3, 4}
     s0 = parse_summary(out[0][1])
@@ -92,10 +93,10 @@ def test_cluster_with_replicas_logs_identical(tmp_path):
     # client got acks only for durable txns; it must have seen some
     assert parse_summary(out[2][1])["txn_cnt"] > 0
     for primary, replica in ((0, 3), (1, 4)):
-        with open(os.path.join(tmp_path, f"node{primary}.log.bin"),
+        with open(os.path.join(log_dir, f"node{primary}.log.bin"),
                   "rb") as f:
             p = f.read()
-        with open(os.path.join(tmp_path, f"replica{replica}.log.bin"),
+        with open(os.path.join(log_dir, f"replica{replica}.log.bin"),
                   "rb") as f:
             r = f.read()
         assert len(p) > 0
