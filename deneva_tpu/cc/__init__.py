@@ -33,6 +33,10 @@ class CCBackend:
     needs_incidence: bool = True
     chained: bool = False      # engine executes commit levels as sub-rounds
     fresh_ts_on_restart: bool = True   # WAIT_DIE keeps its birth ts
+    # single-pass forwarding executor (ops/forward): on blind-write
+    # workloads the whole batch commits with reads forwarded in-batch —
+    # no conflict matrix at all; chained path is the fallback otherwise
+    forward: bool = False
 
 
 _NO_STATE = lambda cfg: ()  # noqa: E731
@@ -51,7 +55,7 @@ _REGISTRY: dict[CCAlg, CCBackend] = {
     CCAlg.CALVIN: CCBackend(CCAlg.CALVIN, validate_calvin, _NO_STATE,
                             chained=True),
     CCAlg.TPU_BATCH: CCBackend(CCAlg.TPU_BATCH, validate_tpu_batch, _NO_STATE,
-                               chained=True),
+                               chained=True, forward=True),
 }
 
 

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from deneva_tpu.cc import AccessBatch, build_incidence, get_backend
 from deneva_tpu.config import Config, Mode
 from deneva_tpu.engine.pool import PoolState, TxnPool
+from deneva_tpu.ops import forward_verdict, forwarding_applies
 
 LAT_BUCKETS = 64
 
@@ -113,9 +114,16 @@ class Engine:
             active=active)
 
         # 4. validate
+        forwarding = forwarding_applies(be, wl) and cfg.mode == Mode.NORMAL
+        fwd = None
         if cfg.mode == Mode.NOCC:
             nocc = get_backend("NOCC")
             verdict, cc_state = nocc.validate(cfg, state.cc_state, batch, None)
+        elif forwarding:
+            # single-pass forwarding executor (ops/forward): everything
+            # commits in rank order; the sort IS the validation
+            verdict, fwd = forward_verdict(batch)
+            cc_state = state.cc_state
         else:
             inc = build_incidence(batch, cfg.conflict_buckets,
                                   cfg.conflict_exact) if be.needs_incidence else None
@@ -124,7 +132,10 @@ class Engine:
         # 5. execute committed txns
         db = state.db
         if cfg.mode in (Mode.NORMAL, Mode.NOCC):
-            if be.chained and cfg.mode == Mode.NORMAL:
+            if forwarding:
+                db = wl.execute(db, queries, verdict.commit, verdict.order,
+                                stats, fwd_rank=fwd)
+            elif be.chained and cfg.mode == Mode.NORMAL:
                 for lvl in range(cfg.exec_subrounds):
                     m = verdict.commit & (verdict.level == lvl)
                     db = wl.execute(db, queries, m, verdict.order, stats)

@@ -1,0 +1,102 @@
+"""Sort-based in-batch read forwarding — Calvin's RFWD as one segmented scan.
+
+The reference forwards dirty reads between Calvin participants with RFWD
+messages (`system/txn.cpp:957-974`): a reader parked on a row waits for
+the earlier-sequenced writer's value to arrive.  The chained-subround
+executor reproduces that by executing conflict-wavefront levels against
+table state — but its level budget caps the commit rate at (levels/epoch)
+per hot key, which collapses under zipf-0.9 contention.
+
+``last_earlier_writer`` removes the level budget for **blind-write**
+workloads (every write's value is independent of what the txn read — YCSB
+exactly, `ycsb_txn.cpp:177-209` overwrites a field): when write values
+are a pure function of (key, writer order), a reader does not need the
+writer to have *executed* — it needs only the writer's identity.  One
+lexicographic sort of the epoch's accesses by (key, rank) and a segmented
+max-scan give every read the rank of the latest earlier writer of its
+key.  Reads with an in-batch predecessor take the forwarded value
+(recomputed from (key, rank)); the rest read the epoch-start snapshot.
+Execution equals serial execution in rank order, so the whole batch
+commits in ONE pass: no conflict matrix, no levels, no aborts.
+
+Contract: ``rank`` must be unique per txn and >= 0; accesses must be
+read-xor-write (an RMW access would be handed its own rank).  Collisions
+are exact — real keys, not hash buckets.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def forwarding_applies(backend, workload) -> bool:
+    """Eligibility: backend opts in AND every write in the workload is
+    blind (value independent of the txn's reads)."""
+    return bool(getattr(backend, "forward", False)
+                and getattr(workload, "blind_writes", False))
+
+
+def forward_verdict(batch):
+    """Commit-everything Verdict + per-access forwarded writer ranks for
+    the single-pass executor.  Shared by the single-node engine and the
+    distributed server step so their semantics cannot diverge."""
+    from deneva_tpu.cc.base import Verdict
+
+    z = jnp.zeros_like(batch.active)
+    verdict = Verdict(commit=batch.active, abort=z, defer=z,
+                      order=batch.rank, level=jnp.zeros_like(batch.rank))
+    fwd = last_earlier_writer(batch.keys, batch.rank, batch.is_write,
+                              batch.valid & batch.active[:, None])
+    return verdict, fwd
+
+
+def _seg_scan(flags: jax.Array, vals: jax.Array, combine) -> jax.Array:
+    """Inclusive segmented scan; ``flags`` marks segment heads."""
+
+    def op(a, b):
+        f1, v1 = a
+        f2, v2 = b
+        return f1 | f2, jnp.where(f2, v2, combine(v1, v2))
+
+    return jax.lax.associative_scan(op, (flags, vals))[1]
+
+
+def _shift1(x: jax.Array, fill) -> jax.Array:
+    return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
+
+
+def last_earlier_writer(keys: jax.Array, rank: jax.Array,
+                        is_write: jax.Array, valid: jax.Array) -> jax.Array:
+    """int32[B, A]: rank of the latest STRICTLY-earlier-ranked in-batch
+    writer of each access's key, or -1 if none.  A txn never sees its own
+    writes (serial semantics: a txn's reads execute before its writes),
+    including duplicate write lanes.
+
+    keys: int32[B, A]; rank: int32[B] unique, >= 0; is_write/valid: bool[B, A].
+    """
+    b, a = keys.shape
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
+    k = jnp.where(valid, keys, big).reshape(-1)     # invalid sorts last
+    r = jnp.broadcast_to(rank[:, None], (b, a)).reshape(-1)
+    w = (is_write & valid).reshape(-1)
+
+    order_idx = jnp.lexsort((r, k))                 # (key, rank)
+    sk = jnp.take(k, order_idx)
+    sr = jnp.take(r, order_idx)
+    cand = jnp.where(jnp.take(w, order_idx), sr, jnp.int32(-1))
+
+    key_head = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    # inclusive max over the key segment, shifted: max over entries sorted
+    # strictly before me (-1 at key heads)
+    excl = _shift1(_seg_scan(key_head, cand, jnp.maximum), jnp.int32(-1))
+    excl = jnp.where(key_head, jnp.int32(-1), excl)
+    # entries of one (key, rank) group — one txn's accesses to one key —
+    # must all see the value at their group head (no self-visibility):
+    # propagate the head's exclusive max through the group
+    grp_head = key_head | (sr != _shift1(sr, jnp.int32(-1)))
+    head_val = jnp.where(grp_head, excl, jnp.int32(-1))
+    fwd_sorted = _seg_scan(grp_head, head_val, lambda v1, v2: v1)
+
+    out = jnp.zeros_like(k).at[order_idx].set(fwd_sorted)
+    return out.reshape(b, a)

@@ -58,9 +58,11 @@ def make_dist_step(cfg: Config, wl, be):
     import jax.numpy as jnp
 
     from deneva_tpu.cc import AccessBatch, build_incidence
+    from deneva_tpu.ops import forward_verdict, forwarding_applies
 
     # merged batch = equal slices per server; epoch_batch is the budget
     b = max(1, cfg.epoch_batch // cfg.node_cnt) * cfg.node_cnt
+    forwarding = forwarding_applies(be, wl)
 
     @jax.jit
     def step(db, cc_state, stats, epoch, active, query):
@@ -71,15 +73,22 @@ def make_dist_step(cfg: Config, wl, be):
             table_ids=planned["table_ids"], keys=planned["keys"],
             is_read=planned["is_read"], is_write=planned["is_write"],
             valid=planned["valid"], ts=ts, rank=rank, active=active)
-        inc = build_incidence(batch, cfg.conflict_buckets,
-                              cfg.conflict_exact) if be.needs_incidence else None
-        verdict, cc_state = be.validate(cfg, cc_state, batch, inc)
-        if be.chained:
-            for lvl in range(cfg.exec_subrounds):
-                m = verdict.commit & (verdict.level == lvl)
-                db = wl.execute(db, query, m, verdict.order, stats)
+        if forwarding:
+            verdict, fwd = forward_verdict(batch)
+            db = wl.execute(db, query, verdict.commit, verdict.order, stats,
+                            fwd_rank=fwd)
         else:
-            db = wl.execute(db, query, verdict.commit, verdict.order, stats)
+            inc = build_incidence(
+                batch, cfg.conflict_buckets,
+                cfg.conflict_exact) if be.needs_incidence else None
+            verdict, cc_state = be.validate(cfg, cc_state, batch, inc)
+            if be.chained:
+                for lvl in range(cfg.exec_subrounds):
+                    m = verdict.commit & (verdict.level == lvl)
+                    db = wl.execute(db, query, m, verdict.order, stats)
+            else:
+                db = wl.execute(db, query, verdict.commit, verdict.order,
+                                stats)
         commit = verdict.commit & active
         abort = verdict.abort & active
         defer = verdict.defer & active

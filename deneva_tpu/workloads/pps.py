@@ -205,8 +205,12 @@ class PPSWorkload:
                     is_write=is_write, valid=valid)
 
     # -- execution ------------------------------------------------------
+    # UPDATE* txns rewrite mapping fields read in the same txn (recon),
+    # so the single-pass forwarding executor does not apply
+    blind_writes = False
+
     def execute(self, db, q: PPSQuery, mask: jax.Array, order: jax.Array,
-                stats: dict):
+                stats: dict, fwd_rank=None):
         db = dict(db)
         t = q.txn_type
         per = self.per

@@ -143,6 +143,15 @@ class TPCCWorkload:
         self.cust_per_dist = cfg.cust_per_dist
         self.max_items = cfg.max_items
         self.ipt = cfg.max_items_per_txn     # MAX_ITEMS_PER_TXN=15 (config.h:189)
+        # partitioned deployment: warehouse -> node (reference wh_to_part,
+        # `benchmarks/tpcc_helper.cpp`); this node stores warehouses
+        # ≡ node_id (mod part_cnt).  ITEM is read-only and replicated
+        # everywhere, exactly like the reference.
+        self.n_parts = max(cfg.part_cnt, 1)
+        self.me = cfg.node_id if self.n_parts > 1 else 0
+        if self.n_wh % self.n_parts != 0:
+            raise ValueError("num_wh must divide evenly over part_cnt")
+        self.n_wh_loc = self.n_wh // self.n_parts
         # effective lastname population: every district must contain at
         # least one customer per lastname for the closed-form lookup
         self.lastnames = min(_LASTNAMES, self.cust_per_dist)
@@ -153,6 +162,10 @@ class TPCCWorkload:
         self.n_districts = self.n_wh * self.n_dist
         self.n_cust = self.n_districts * self.cust_per_dist
         self.n_stock = self.n_wh * self.max_items
+        # local (stored) row counts — global counts / n_parts
+        self.n_districts_loc = self.n_wh_loc * self.n_dist
+        self.n_cust_loc = self.n_districts_loc * self.cust_per_dist
+        self.n_stock_loc = self.n_wh_loc * self.max_items
         # flattened composite keys and the per-district sort key must fit
         # int32 (storage/table.py's stated key contract)
         lim = 2**31 - 1
@@ -163,6 +176,8 @@ class TPCCWorkload:
             raise ValueError("num_wh*10*2*epoch_batch must fit int32")
 
     # -- composite keys (tpcc_helper.h:24-30, flattened dense) ----------
+    # global keys: CC identity (plan / conflict detection) — same on
+    # every node so the merged-epoch validation agrees cluster-wide
     def dist_key(self, w, d):
         return w * self.n_dist + d
 
@@ -171,6 +186,37 @@ class TPCCWorkload:
 
     def stock_key(self, w, i):
         return w * self.max_items + i
+
+    # local slots: storage addressing on THIS node — warehouses not owned
+    # here resolve to each table's trash slot so remote-row gathers read
+    # zeros and scatters drop (partitioned execution, SURVEY §2.10)
+    def wh_owned(self, w):
+        if self.n_parts == 1:
+            return jnp.ones(jnp.shape(w), bool)
+        return w % self.n_parts == self.me
+
+    def _wloc(self, w):
+        return w // self.n_parts if self.n_parts > 1 else w
+
+    def wh_slot(self, w):
+        return jnp.where(self.wh_owned(w), self._wloc(w),
+                         jnp.int32(self.n_wh_loc))
+
+    def dist_slot(self, w, d):
+        return jnp.where(self.wh_owned(w),
+                         self._wloc(w) * self.n_dist + d,
+                         jnp.int32(self.n_districts_loc))
+
+    def cust_slot(self, w, d, c):
+        return jnp.where(self.wh_owned(w),
+                         (self._wloc(w) * self.n_dist + d)
+                         * self.cust_per_dist + c,
+                         jnp.int32(self.n_cust_loc))
+
+    def stock_slot(self, w, i):
+        return jnp.where(self.wh_owned(w),
+                         self._wloc(w) * self.max_items + i,
+                         jnp.int32(self.n_stock_loc))
 
     # -- loader (tpcc_wl.cpp:89-152 parallel loaders) -------------------
     def load(self):
@@ -182,34 +228,45 @@ class TPCCWorkload:
             db[name] = t
             return t
 
-        wh = tab("WAREHOUSE", self.n_wh)
-        w_ids = np.arange(self.n_wh, dtype=np.int32)
-        db["WAREHOUSE"] = fill_columns(wh, self.n_wh, {
-            "W_ID": w_ids,
-            "W_TAX": _rand01(w_ids, 7) * 0.2,       # URand(0,.2) (init_wh)
-            "W_YTD": np.full(self.n_wh, 300000.0, np.float32)})
+        # local slot ℓ stores global warehouse me + n_parts * (ℓ // ...):
+        # loader values derive from GLOBAL ids so any node's copy of a row
+        # matches what a single-node load would have produced
+        p, me = self.n_parts, self.me
 
-        dist = tab("DISTRICT", self.n_districts)
-        d_ids = np.arange(self.n_districts, dtype=np.int32)
-        db["DISTRICT"] = fill_columns(dist, self.n_districts, {
-            "D_ID": d_ids % self.n_dist,
-            "D_W_ID": d_ids // self.n_dist,
-            "D_TAX": _rand01(d_ids, 11) * 0.2,
-            "D_YTD": np.full(self.n_districts, 30000.0, np.float32),
-            "D_NEXT_O_ID": np.full(self.n_districts, 3001, np.int32)})
+        wh = tab("WAREHOUSE", self.n_wh_loc)
+        w_glob = me + p * np.arange(self.n_wh_loc, dtype=np.int32)
+        db["WAREHOUSE"] = fill_columns(wh, self.n_wh_loc, {
+            "W_ID": w_glob,
+            "W_TAX": _rand01(w_glob, 7) * 0.2,      # URand(0,.2) (init_wh)
+            "W_YTD": np.full(self.n_wh_loc, 300000.0, np.float32)})
 
-        cust = tab("CUSTOMER", self.n_cust)
-        c_ids = np.arange(self.n_cust, dtype=np.int32)
-        c_local = c_ids % self.cust_per_dist
-        db["CUSTOMER"] = fill_columns(cust, self.n_cust, {
+        dist = tab("DISTRICT", self.n_districts_loc)
+        dl = np.arange(self.n_districts_loc, dtype=np.int32)
+        d_w = me + p * (dl // self.n_dist)
+        d_id = dl % self.n_dist
+        d_glob = d_w * self.n_dist + d_id
+        db["DISTRICT"] = fill_columns(dist, self.n_districts_loc, {
+            "D_ID": d_id,
+            "D_W_ID": d_w,
+            "D_TAX": _rand01(d_glob, 11) * 0.2,
+            "D_YTD": np.full(self.n_districts_loc, 30000.0, np.float32),
+            "D_NEXT_O_ID": np.full(self.n_districts_loc, 3001, np.int32)})
+
+        cust = tab("CUSTOMER", self.n_cust_loc)
+        cl = np.arange(self.n_cust_loc, dtype=np.int32)
+        c_local = cl % self.cust_per_dist
+        c_d = (cl // self.cust_per_dist) % self.n_dist
+        c_w = me + p * (cl // (self.cust_per_dist * self.n_dist))
+        c_glob = (c_w * self.n_dist + c_d) * self.cust_per_dist + c_local
+        db["CUSTOMER"] = fill_columns(cust, self.n_cust_loc, {
             "C_ID": c_local,
-            "C_D_ID": (c_ids // self.cust_per_dist) % self.n_dist,
-            "C_W_ID": c_ids // (self.cust_per_dist * self.n_dist),
+            "C_D_ID": c_d,
+            "C_W_ID": c_w,
             "C_LAST": c_local % self.lastnames,
-            "C_DISCOUNT": _rand01(c_ids, 13) * 0.5,
-            "C_BALANCE": np.full(self.n_cust, -10.0, np.float32),
-            "C_YTD_PAYMENT": np.full(self.n_cust, 10.0, np.float32),
-            "C_PAYMENT_CNT": np.ones(self.n_cust, np.int32)})
+            "C_DISCOUNT": _rand01(c_glob, 13) * 0.5,
+            "C_BALANCE": np.full(self.n_cust_loc, -10.0, np.float32),
+            "C_YTD_PAYMENT": np.full(self.n_cust_loc, 10.0, np.float32),
+            "C_PAYMENT_CNT": np.ones(self.n_cust_loc, np.int32)})
 
         item = tab("ITEM", self.max_items)
         i_ids = np.arange(self.max_items, dtype=np.int32)
@@ -220,13 +277,16 @@ class TPCCWorkload:
             "I_PRICE": (1 + i_ids.astype(np.int64) * 48271 % 100
                         ).astype(np.int32)})
 
-        stock = tab("STOCK", self.n_stock)
-        s_ids = np.arange(self.n_stock, dtype=np.int32)
-        db["STOCK"] = fill_columns(stock, self.n_stock, {
-            "S_I_ID": s_ids % self.max_items,
-            "S_W_ID": s_ids // self.max_items,
-            "S_QUANTITY": (10 + s_ids * 69621 % 91).astype(np.int32),
-            "S_REMOTE_CNT": np.zeros(self.n_stock, np.int32)})
+        stock = tab("STOCK", self.n_stock_loc)
+        sl = np.arange(self.n_stock_loc, dtype=np.int32)
+        s_i = sl % self.max_items
+        s_w = me + p * (sl // self.max_items)
+        s_glob = (s_w.astype(np.int64) * self.max_items + s_i)
+        db["STOCK"] = fill_columns(stock, self.n_stock_loc, {
+            "S_I_ID": s_i,
+            "S_W_ID": s_w,
+            "S_QUANTITY": (10 + s_glob * 69621 % 91).astype(np.int32),
+            "S_REMOTE_CNT": np.zeros(self.n_stock_loc, np.int32)})
 
         cap = cfg.insert_table_cap
         tab("HISTORY", cap, ring=True)
@@ -294,6 +354,43 @@ class TPCCWorkload:
             items=items, item_valid=item_valid, supply_w=supply_w,
             quantity=quantity)
 
+    # -- wire adapters (distributed runtime: CL_QRY / EPOCH_BLOB bodies) --
+    # keys[n, 3I] = [items | supply_w | quantity]; types[n, 3I] marks item
+    # validity in the first I lanes; scalars[n, 8] carries the per-txn
+    # fields (h_amount as raw float32 bits).
+    def to_wire(self, q: TPCCQuery):
+        k = np.concatenate([np.asarray(q.items, np.int32),
+                            np.asarray(q.supply_w, np.int32),
+                            np.asarray(q.quantity, np.int32)], axis=1)
+        t = np.zeros_like(k, np.int8)
+        t[:, : self.ipt] = np.asarray(q.item_valid, np.int8)
+        s = np.stack([
+            np.asarray(q.txn_type, np.int32), np.asarray(q.w_id, np.int32),
+            np.asarray(q.d_id, np.int32), np.asarray(q.c_id, np.int32),
+            np.asarray(q.c_w_id, np.int32), np.asarray(q.c_d_id, np.int32),
+            np.asarray(q.h_amount, np.float32).view(np.int32),
+            np.asarray(q.ol_cnt, np.int32)], axis=1)
+        return k, t, s
+
+    def from_wire(self, keys: np.ndarray, types: np.ndarray,
+                  scalars: np.ndarray) -> TPCCQuery:
+        I = self.ipt
+        keys = np.asarray(keys, np.int32)
+        scalars = np.ascontiguousarray(scalars, np.int32)
+        return TPCCQuery(
+            txn_type=jnp.asarray(scalars[:, 0]),
+            w_id=jnp.asarray(scalars[:, 1]), d_id=jnp.asarray(scalars[:, 2]),
+            c_id=jnp.asarray(scalars[:, 3]),
+            c_w_id=jnp.asarray(scalars[:, 4]),
+            c_d_id=jnp.asarray(scalars[:, 5]),
+            h_amount=jnp.asarray(
+                np.ascontiguousarray(scalars[:, 6]).view(np.float32)),
+            ol_cnt=jnp.asarray(scalars[:, 7]),
+            items=jnp.asarray(keys[:, :I]),
+            item_valid=jnp.asarray(types[:, :I] != 0),
+            supply_w=jnp.asarray(keys[:, I:2 * I]),
+            quantity=jnp.asarray(keys[:, 2 * I:3 * I]))
+
     # -- RW-set planning (tpcc_txn.cpp state machines, declared up front)
     def plan(self, db, q: TPCCQuery) -> dict:
         cfg = self.cfg
@@ -337,8 +434,12 @@ class TPCCWorkload:
                     is_write=is_write, valid=valid)
 
     # -- execution ------------------------------------------------------
+    # NewOrder's stock update is a true RMW (the new quantity depends on
+    # the read), so the single-pass forwarding executor does not apply
+    blind_writes = False
+
     def execute(self, db, q: TPCCQuery, mask: jax.Array, order: jax.Array,
-                stats: dict):
+                stats: dict, fwd_rank=None):
         db = dict(db)
         is_pay = q.txn_type == TPCC_PAYMENT
         pay = mask & is_pay
@@ -349,20 +450,23 @@ class TPCCWorkload:
 
     def _exec_payment(self, db, q, m, stats):
         """run_payment_0..5 (`tpcc_txn.cpp:472-`): YTD/balance updates are
-        commutative -> exact batched scatter_add."""
+        commutative -> exact batched scatter_add.  Partitioned: each row
+        component lands only on its owner (remote slots resolve to trash),
+        so a cross-warehouse payment splits naturally across nodes."""
         amt = jnp.where(m, q.h_amount, 0.0)
         if self.cfg.wh_update:
             db["WAREHOUSE"] = db["WAREHOUSE"].scatter_add(
-                q.w_id, {"W_YTD": amt}, mask=m)
+                self.wh_slot(q.w_id), {"W_YTD": amt}, mask=m)
         db["DISTRICT"] = db["DISTRICT"].scatter_add(
-            self.dist_key(q.w_id, q.d_id), {"D_YTD": amt}, mask=m)
-        ck = self.cust_key(q.c_w_id, q.c_d_id, q.c_id)
+            self.dist_slot(q.w_id, q.d_id), {"D_YTD": amt}, mask=m)
+        ck = self.cust_slot(q.c_w_id, q.c_d_id, q.c_id)
         db["CUSTOMER"] = db["CUSTOMER"].scatter_add(
             ck, {"C_BALANCE": -amt, "C_YTD_PAYMENT": amt,
                  "C_PAYMENT_CNT": m.astype(jnp.int32)}, mask=m)
         hist, _ = db["HISTORY"].append(
             {"H_C_ID": q.c_id, "H_C_D_ID": q.c_d_id, "H_C_W_ID": q.c_w_id,
-             "H_D_ID": q.d_id, "H_W_ID": q.w_id, "H_AMOUNT": q.h_amount}, m)
+             "H_D_ID": q.d_id, "H_W_ID": q.w_id, "H_AMOUNT": q.h_amount},
+            m & self.wh_owned(q.w_id))
         db["HISTORY"] = hist
         # W_YTD + D_YTD + 3 customer cols + HISTORY row per payment
         stats["write_cnt"] = stats["write_cnt"] + \
@@ -375,13 +479,17 @@ class TPCCWorkload:
         serialization order — D_NEXT_O_ID++ under the row latch, batched."""
         n = q.w_id.shape[0]
         dist = db["DISTRICT"]
-        dk = self.dist_key(q.w_id, q.d_id)
+        dk = self.dist_key(q.w_id, q.d_id)          # global (segment id)
+        dslot = self.dist_slot(q.w_id, q.d_id)      # local (storage)
+        owned = self.wh_owned(q.w_id)
 
         # taxes / discount reads feed the checksum (keeps gathers alive)
-        w_tax = db["WAREHOUSE"].gather(q.w_id, ("W_TAX",))["W_TAX"]
-        d = dist.gather(dk, ("D_TAX", "D_NEXT_O_ID"))
+        w_tax = db["WAREHOUSE"].gather(self.wh_slot(q.w_id),
+                                       ("W_TAX",))["W_TAX"]
+        d = dist.gather(dslot, ("D_TAX", "D_NEXT_O_ID"))
         c_disc = db["CUSTOMER"].gather(
-            self.cust_key(q.w_id, q.d_id, q.c_id), ("C_DISCOUNT",))["C_DISCOUNT"]
+            self.cust_slot(q.w_id, q.d_id, q.c_id),
+            ("C_DISCOUNT",))["C_DISCOUNT"]
         stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
             jnp.where(m, (w_tax + d["D_TAX"] + c_disc) * 1000, 0)
         ).astype(jnp.uint32)
@@ -404,13 +512,13 @@ class TPCCWorkload:
         o_id = d["D_NEXT_O_ID"] + rank
 
         db["DISTRICT"] = dist.scatter_add(
-            dk, {"D_NEXT_O_ID": m.astype(jnp.int32)}, mask=m)
+            dslot, {"D_NEXT_O_ID": m.astype(jnp.int32)}, mask=m)
 
         # stock update (new_order_8): non-commutative quantity rule ->
         # gather/modify/last-writer scatter; S_REMOTE_CNT is scatter_add
         I = self.ipt
         iv = (q.item_valid & m[:, None]).reshape(-1)
-        sk = self.stock_key(q.supply_w, q.items).reshape(-1)
+        sk = self.stock_slot(q.supply_w, q.items).reshape(-1)
         qty = q.quantity.reshape(-1)
         stock = db["STOCK"]
         s_q = stock.gather(sk, ("S_QUANTITY",))["S_QUANTITY"]
@@ -425,17 +533,19 @@ class TPCCWorkload:
             sk, {"S_REMOTE_CNT": (iv & remote).astype(jnp.int32)},
             mask=iv & remote)
 
-        # inserts: ORDER, NEW-ORDER, ORDER-LINE (new_order_1 / _3 / _9)
+        # inserts: ORDER, NEW-ORDER, ORDER-LINE (new_order_1 / _3 / _9) —
+        # at the home warehouse's owner node only
+        m_ins = m & owned
         all_local = jnp.all(~q.item_valid | (q.supply_w == q.w_id[:, None]),
                             axis=1)
         db["ORDER"], _ = db["ORDER"].append(
             {"O_ID": o_id, "O_C_ID": q.c_id, "O_D_ID": q.d_id,
              "O_W_ID": q.w_id, "O_ENTRY_D": jnp.full((n,), 2013),
              "O_OL_CNT": q.ol_cnt,
-             "O_ALL_LOCAL": all_local.astype(jnp.int32)}, m)
+             "O_ALL_LOCAL": all_local.astype(jnp.int32)}, m_ins)
         db["NEW-ORDER"], _ = db["NEW-ORDER"].append(
-            {"NO_O_ID": o_id, "NO_D_ID": q.d_id, "NO_W_ID": q.w_id}, m)
-        ol_m = (q.item_valid & m[:, None]).reshape(-1)
+            {"NO_O_ID": o_id, "NO_D_ID": q.d_id, "NO_W_ID": q.w_id}, m_ins)
+        ol_m = (q.item_valid & m_ins[:, None]).reshape(-1)
         bcast = lambda x: jnp.broadcast_to(x[:, None], (n, I)).reshape(-1)  # noqa: E731
         db["ORDER-LINE"], _ = db["ORDER-LINE"].append(
             {"OL_O_ID": bcast(o_id), "OL_D_ID": bcast(q.d_id),

@@ -62,6 +62,10 @@ def _field_fingerprint(key: jax.Array | np.ndarray, version):
 
 
 class YCSBWorkload:
+    # writes overwrite a field with f(key, order) — independent of any
+    # read — so the single-pass forwarding executor applies (ops/forward)
+    blind_writes = True
+
     def __init__(self, cfg: Config):
         self.cfg = cfg
         self.catalog = parse_schema(YCSB_SCHEMA)
@@ -134,7 +138,7 @@ class YCSBWorkload:
 
     # -- execution (ycsb_txn.cpp:177-209 collapsed to one batch) -------
     def execute(self, db, q: YCSBQuery, mask: jax.Array, order: jax.Array,
-                stats: dict):
+                stats: dict, fwd_rank: jax.Array | None = None):
         tab: DeviceTable = db[TABLE]
         slots = self.index.lookup(q.keys)                      # [n, R]
         act = mask[:, None] & jnp.ones_like(q.is_write)
@@ -142,6 +146,13 @@ class YCSBWorkload:
         rmask = act & ~q.is_write
         vals = jnp.take(tab.columns["F0"], jnp.where(rmask, slots, tab.capacity),
                         axis=0)
+        if fwd_rank is not None:
+            # single-pass forwarding executor: a read whose key has an
+            # earlier in-batch writer takes that writer's value — which is
+            # f(key, writer rank), computable without the writer having
+            # executed (blind writes).  RFWD as arithmetic.
+            vals = jnp.where(fwd_rank >= 0,
+                             _field_fingerprint(q.keys, fwd_rank), vals)
         stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
             jnp.where(rmask, vals, 0), dtype=jnp.uint32)
         # writes: new fingerprint versioned by serialization order

@@ -75,3 +75,48 @@ def test_nocc_mode_oracle_beats_cc():
                                    synth_table_size=256))
     assert int(nocc["total_txn_commit_cnt"]) >= int(occ["total_txn_commit_cnt"])
     assert int(nocc["total_txn_abort_cnt"]) == 0
+
+
+def test_forwarding_executor_equals_serial_execution():
+    """TPU_BATCH's single-pass forwarding executor must produce exactly
+    the read values and final table state of serial execution in rank
+    order (the property that makes commit-everything serializable)."""
+    import jax.numpy as jnp
+    from deneva_tpu.ops import last_earlier_writer
+    from deneva_tpu.workloads.ycsb import (YCSBQuery, YCSBWorkload,
+                                           _field_fingerprint)
+
+    cfg = small_cfg(cc_alg="TPU_BATCH", synth_table_size=32,
+                    req_per_query=4, max_accesses=4, epoch_batch=16)
+    wl = YCSBWorkload(cfg)
+    db = wl.load()
+    rng = np.random.default_rng(5)
+    B, R = 16, 4
+    keys = rng.integers(0, 8, (B, R)).astype(np.int32)  # heavy contention
+    is_w = rng.random((B, R)) < 0.5
+    q = YCSBQuery(keys=jnp.asarray(keys), is_write=jnp.asarray(is_w))
+    rank = np.arange(B, dtype=np.int32)
+    order = jnp.asarray(rank)
+    mask = jnp.ones(B, bool)
+    fwd = last_earlier_writer(q.keys, order, q.is_write,
+                              jnp.ones((B, R), bool))
+    stats = {"read_checksum": jnp.zeros((), jnp.uint32),
+             "write_cnt": jnp.zeros((), jnp.uint32)}
+    db2 = wl.execute(dict(db), q, mask, order, stats, fwd_rank=fwd)
+    got_sum = int(stats["read_checksum"])
+    got_f0 = np.asarray(db2["MAIN_TABLE"].columns["F0"])[:32]
+
+    # serial reference in rank order (checksum mod 2^32, accumulated in
+    # a Python int to avoid numpy overflow warnings)
+    f0 = np.asarray(db["MAIN_TABLE"].columns["F0"])[:32].copy()
+    sum_ref = 0
+    for i in range(B):
+        for r in range(R):       # reads first (serial txn semantics)
+            if not is_w[i, r]:
+                sum_ref = (sum_ref + int(f0[keys[i, r]])) & 0xFFFFFFFF
+        for r in range(R):
+            if is_w[i, r]:
+                f0[keys[i, r]] = np.asarray(
+                    _field_fingerprint(keys[i, r], rank[i]))
+    assert got_sum == sum_ref
+    assert (got_f0 == f0).all()

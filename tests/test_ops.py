@@ -172,3 +172,59 @@ def test_precedence_levels_cycle_detection():
     assert lv[0] == 0 and lv[1] == 1 and lv[2] == 2
     assert not unstable[[0, 1, 2, 6, 7]].any()
     assert unstable[3] and unstable[4] and unstable[5]
+
+
+# ---- in-batch read forwarding (ops/forward.py) -------------------------
+
+def test_last_earlier_writer_basic():
+    from deneva_tpu.ops import last_earlier_writer
+    # txn0 writes k5; txn1 reads k5; txn2 writes k5; txn3 reads k5, k9
+    keys = jnp.array([[5], [5], [5], [5]], jnp.int32)
+    keys = jnp.concatenate([keys, jnp.array([[1], [2], [3], [9]], jnp.int32)], 1)
+    is_w = jnp.array([[True, False], [False, False],
+                      [True, False], [False, False]])
+    valid = jnp.ones((4, 2), bool)
+    rank = jnp.array([0, 1, 2, 3], jnp.int32)
+    fwd = np.asarray(last_earlier_writer(keys, rank, is_w, valid))
+    assert fwd[1, 0] == 0     # txn1 reads txn0's write of k5
+    assert fwd[3, 0] == 2     # txn3 reads txn2's (later) write of k5
+    assert fwd[0, 0] == -1    # first writer has no predecessor
+    assert fwd[3, 1] == -1    # k9 never written
+
+
+def test_last_earlier_writer_same_rank_not_own_write():
+    from deneva_tpu.ops import last_earlier_writer
+    # one txn reads k7 in lane 0 and writes k7 in lane 1: the read must
+    # NOT see its own write (serial semantics: reads before writes)
+    keys = jnp.full((1, 2), 7, jnp.int32)
+    is_w = jnp.array([[False, True]])
+    valid = jnp.ones((1, 2), bool)
+    fwd = np.asarray(last_earlier_writer(keys, jnp.array([4], jnp.int32),
+                                         is_w, valid))
+    assert fwd[0, 0] == -1
+
+
+def test_last_earlier_writer_matches_serial_reference():
+    from deneva_tpu.ops import last_earlier_writer
+    rng = np.random.default_rng(11)
+    B, A, K = 64, 6, 13
+    keys = rng.integers(0, K, (B, A)).astype(np.int32)
+    is_w = rng.random((B, A)) < 0.5
+    valid = rng.random((B, A)) < 0.9
+    rank = np.argsort(rng.random(B)).astype(np.int32)  # unique, shuffled
+    got = np.asarray(last_earlier_writer(
+        jnp.asarray(keys), jnp.asarray(rank), jnp.asarray(is_w),
+        jnp.asarray(valid)))
+    # serial reference: walk txns in rank order
+    last_w = {}
+    exp = np.full((B, A), -1, np.int32)
+    for i in np.argsort(rank):
+        for a in range(A):
+            if valid[i, a]:
+                exp[i, a] = last_w.get(keys[i, a], -1)
+        for a in range(A):
+            if valid[i, a] and is_w[i, a]:
+                k = keys[i, a]
+                last_w[k] = max(last_w.get(k, -1), int(rank[i]))
+    # compare only on valid lanes (invalid lanes are unspecified)
+    assert (got[valid] == exp[valid]).all()

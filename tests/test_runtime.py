@@ -72,3 +72,22 @@ def test_cluster_3s2c_tpu_batch():
     # both clients served
     assert parse_summary(out[3][1])["txn_cnt"] > 0
     assert parse_summary(out[4][1])["txn_cnt"] > 0
+
+
+@pytest.mark.slow
+def test_cluster_2s1c_tpcc_partitioned():
+    """TPC-C over 2 partitioned server nodes (warehouse -> node, reference
+    wh_to_part): commits agree, cross-warehouse payments/orders split
+    across owners without 2PC."""
+    cfg = Config(
+        workload=WorkloadKind.TPCC, cc_alg=CCAlg.CALVIN,
+        node_cnt=2, client_node_cnt=1,
+        num_wh=4, cust_per_dist=64, max_items=128, max_items_per_txn=5,
+        insert_table_cap=1 << 12,
+        epoch_batch=64, conflict_buckets=512, max_accesses=8,
+        max_txn_in_flight=512, warmup_secs=0.5, done_secs=1.5)
+    out = boot(cfg)
+    s0 = parse_summary(out[0][1])
+    s1 = parse_summary(out[1][1])
+    assert s0["total_txn_commit_cnt"] == s1["total_txn_commit_cnt"] > 0
+    assert parse_summary(out[2][1])["txn_cnt"] > 0
