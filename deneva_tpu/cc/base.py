@@ -44,6 +44,16 @@ import jax.numpy as jnp
 from deneva_tpu.ops import access_incidence, bucket_hash, combine_key
 
 
+def get_overlap(cfg):
+    """Per-config overlap op: the fused Pallas kernel when enabled, else
+    the XLA path.  Single dispatch point so no backend can miss the flag
+    (all overlap() call sites in cc/ go through this)."""
+    from deneva_tpu.ops import overlap
+    from deneva_tpu.ops.pallas_kernels import overlap_fused
+
+    return overlap_fused if cfg.use_pallas else overlap
+
+
 @dataclass
 class AccessBatch:
     """One epoch's planned accesses.  Pytree of static shape [B, A] / [B]."""

@@ -34,12 +34,13 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict
-from deneva_tpu.ops import earlier_edges, overlap, wavefront_levels
+from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, get_overlap
+from deneva_tpu.ops import earlier_edges, wavefront_levels
 
 
 def validate_calvin(cfg, state, batch: AccessBatch, inc: Incidence):
-    uw = overlap(inc.u1, inc.w1, inc.u2, inc.w2)
+    ov = get_overlap(cfg)
+    uw = ov(inc.u1, inc.w1, inc.u2, inc.w2)
     c = uw | uw.T
     e = earlier_edges(c, batch.rank, batch.active)
     lv, overflow = wavefront_levels(e, max_level=cfg.exec_subrounds - 1)

@@ -35,8 +35,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict
-from deneva_tpu.ops import overlap, precedence_levels
+from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, get_overlap
+from deneva_tpu.ops import precedence_levels
 
 
 _PEEL_ITERS = 4
@@ -45,7 +45,8 @@ _PEEL_ITERS = 4
 def validate_maat(cfg, state, batch: AccessBatch, inc: Incidence):
     b = batch.active.shape[0]
     # P[i, j] = i must precede j  (i read a key j writes; snapshot read)
-    p = overlap(inc.r1, inc.w1, inc.r2, inc.w2)
+    ov = get_overlap(cfg)
+    p = ov(inc.r1, inc.w1, inc.r2, inc.w2)
     p = p & ~jnp.eye(b, dtype=bool)          # RMW self-overlap is not an edge
     lane = jnp.arange(b, dtype=jnp.int32)
 

@@ -25,13 +25,14 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict
-from deneva_tpu.ops import earlier_edges, greedy_first_fit, overlap
+from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, get_overlap
+from deneva_tpu.ops import earlier_edges, greedy_first_fit
 
 
 def validate_occ(cfg, state, batch: AccessBatch, inc: Incidence):
     # directed: my accesses vs their writes (their reads never invalidate me)
-    uw = overlap(inc.u1, inc.w1, inc.u2, inc.w2)
+    ov = get_overlap(cfg)
+    uw = ov(inc.u1, inc.w1, inc.u2, inc.w2)
     e = earlier_edges(uw, batch.rank, batch.active)
     win, lose, und = greedy_first_fit(e, batch.active, rounds=cfg.sweep_rounds)
     v = Verdict(commit=win, abort=lose, defer=und,

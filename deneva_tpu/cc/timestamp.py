@@ -46,8 +46,8 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict
-from deneva_tpu.ops import earlier_edges, greedy_first_fit, overlap
+from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, get_overlap
+from deneva_tpu.ops import earlier_edges, greedy_first_fit
 
 
 @dataclass
@@ -84,9 +84,9 @@ def _watermark_aborts(state: TOState, batch: AccessBatch, inc: Incidence,
     return bad
 
 
-def _rw_later_reader_edges(batch: AccessBatch, inc: Incidence):
+def _rw_later_reader_edges(cfg, batch: AccessBatch, inc: Incidence):
     """E[i,j]: reader i (by ts) ordered after writer j on a common key."""
-    rw = overlap(inc.r1, inc.w1, inc.r2, inc.w2)       # i reads ∩ j writes
+    rw = get_overlap(cfg)(inc.r1, inc.w1, inc.r2, inc.w2)       # i reads ∩ j writes
     return earlier_edges(rw, batch.ts, batch.active)   # j earlier by ts
 
 
@@ -111,7 +111,7 @@ def _validate_to(cfg, state, batch, inc, mvcc: bool):
         ro = jnp.zeros(batch.active.shape, bool)
     # read-only MVCC txns leave the conflict graph entirely
     swept = live & ~ro
-    e = _rw_later_reader_edges(batch, inc)
+    e = _rw_later_reader_edges(cfg, batch, inc)
     e = e & swept[:, None] & swept[None, :]
     win, lose, und = greedy_first_fit(e, swept, rounds=cfg.sweep_rounds)
     commit = win | (live & ro)

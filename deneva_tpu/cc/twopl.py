@@ -46,27 +46,28 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict
+from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, get_overlap
 from deneva_tpu.cc.nocc import validate_nocc
-from deneva_tpu.ops import earlier_edges, greedy_first_fit, overlap
+from deneva_tpu.ops import earlier_edges, greedy_first_fit
 
 
 def _lock_edges(cfg, batch: AccessBatch, inc: Incidence):
     """Directed blocked-by edges E[i,j] ("earlier j blocks i") under the
     configured isolation level; None means no locking at all (NOLOCK)."""
     iso = cfg.isolation_level
+    ov = get_overlap(cfg)
     if iso == "NOLOCK":
         return None
     if iso == "SERIALIZABLE":
-        uw = overlap(inc.u1, inc.w1, inc.u2, inc.w2)
+        uw = ov(inc.u1, inc.w1, inc.u2, inc.w2)
         return earlier_edges(uw | uw.T, batch.rank, batch.active)
-    ww = overlap(inc.w1, inc.w1, inc.w2, inc.w2)
+    ww = ov(inc.w1, inc.w1, inc.w2, inc.w2)
     e = earlier_edges(ww | ww.T, batch.rank, batch.active)
     if iso == "READ_COMMITTED":
         # i's pure read contends with an earlier writer j of the same key;
         # the reverse direction (writer behind reader) is gone — the read
         # lock is already released by the time the writer asks.
-        prw = overlap(inc.pr1, inc.w1, inc.pr2, inc.w2)
+        prw = ov(inc.pr1, inc.w1, inc.pr2, inc.w2)
         e = e | earlier_edges(prw, batch.rank, batch.active)
     return e
 

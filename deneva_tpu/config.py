@@ -152,6 +152,10 @@ class Config:
     log_dir: str = "/tmp/deneva_logs"
 
     # ---- epoch engine (TPU-shaped; replaces thread/latch knobs) ----
+    use_pallas: bool = False       # fused Pallas conflict kernel on TPU
+    #                                (auto-falls back off-TPU / odd shapes;
+    #                                 measured ~par with XLA's own fusion on
+    #                                 v5e — kept as the tuning surface)
     epoch_batch: int = 2048        # txns validated per epoch (Calvin SEQ_BATCH analogue)
     conflict_buckets: int = 8192   # hashed key-bucket width of incidence matrices
     conflict_exact: bool = True    # dual-hash AND to squeeze out false conflicts

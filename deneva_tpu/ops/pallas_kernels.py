@@ -1,0 +1,126 @@
+"""Pallas TPU kernels for the conflict-matrix hot path.
+
+The conflict matmul (`ops.conflict.overlap`) is the FLOPs center of every
+incidence-based CC backend: two B×K @ K×B bf16 matmuls whose f32 results
+are only ever compared against zero and ANDed.  XLA materializes both
+B×B f32 intermediates in HBM before the elementwise ops; this kernel
+fuses the compare+AND into the matmul epilogue so only the final B×B
+int8 mask ever leaves VMEM — 8x less HBM write traffic on the epilogue
+(2 f32 planes -> 1 int8 plane), with both matmuls sharing one K-tile
+sweep on the MXU.
+
+Tiling: grid (B/Tm, B/Tn, K/Tk); f32 accumulators live in VMEM scratch
+across the K sweep (revolving output block, standard Pallas matmul
+pattern per the TPU guide); the epilogue fires on the last K step.
+
+Shapes must divide by the tile sizes (the engine's epoch_batch is a
+power of two >= 128 and conflict_buckets a multiple of 512 whenever
+``use_pallas`` is on — enforced in `overlap_fused`'s fallback check, not
+assumed).  Fallback: plain XLA einsum path (`ops.conflict.overlap`).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+TM = TN = 128      # output tile (MXU native 128x128)
+TK = 512           # contraction tile per grid step
+
+# CI escape hatch: run the kernel BODY through the Pallas interpreter on
+# CPU so tile indexing / epilogue bugs are caught before TPU time
+_INTERPRET = os.environ.get("DENEVA_PALLAS_INTERPRET", "") == "1"
+
+
+def _can_use(a: jax.Array) -> bool:
+    b, k = a.shape
+    return b % TM == 0 and k % TK == 0 and b >= TM and k >= TK
+
+
+@functools.partial(jax.jit, static_argnames=("dual", "interpret"))
+def _overlap_pallas(a1, b1t, a2, b2t, dual: bool, interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, k = a1.shape
+    nm, nn, nk = b // TM, b // TN, k // TK
+
+    def kernel(*refs):
+        if dual:
+            a1r, b1r, a2r, b2r, out, acc1, acc2 = refs
+        else:
+            a1r, b1r, out, acc1 = refs
+        kk = pl.program_id(2)
+
+        @pl.when(kk == 0)
+        def _init():
+            acc1[:] = jnp.zeros_like(acc1)
+            if dual:
+                acc2[:] = jnp.zeros_like(acc2)
+
+        acc1[:] += jnp.dot(a1r[:], b1r[:],
+                           preferred_element_type=jnp.float32)
+        if dual:
+            acc2[:] += jnp.dot(a2r[:], b2r[:],
+                               preferred_element_type=jnp.float32)
+
+        @pl.when(kk == nk - 1)
+        def _epilogue():
+            hit = acc1[:] > 0
+            if dual:
+                hit &= acc2[:] > 0
+            out[:] = hit.astype(jnp.int8)
+
+    a_spec = pl.BlockSpec((TM, TK), lambda i, j, kk: (i, kk))
+    bt_spec = pl.BlockSpec((TK, TN), lambda i, j, kk: (kk, j))
+    out_spec = pl.BlockSpec((TM, TN), lambda i, j, kk: (i, j))
+    scratch = [pltpu.VMEM((TM, TN), jnp.float32)]
+    ins = [a1, b1t]
+    in_specs = [a_spec, bt_spec]
+    if dual:
+        ins += [a2, b2t]
+        in_specs += [a_spec, bt_spec]
+        scratch += [pltpu.VMEM((TM, TN), jnp.float32)]
+
+    kw = {}
+    if interpret:
+        kw["interpret"] = True
+    else:
+        kw["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    return pl.pallas_call(
+        kernel,
+        grid=(nm, nn, nk),
+        in_specs=in_specs,
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((b, b), jnp.int8),
+        scratch_shapes=scratch,
+        **kw,
+    )(*ins)
+
+
+def overlap_fused(inc_a, inc_b, inc_a2=None, inc_b2=None) -> jax.Array:
+    """Drop-in for `ops.conflict.overlap` with the fused Pallas epilogue.
+
+    Falls back to the XLA path when shapes don't tile or the platform is
+    not TPU; setting DENEVA_PALLAS_INTERPRET=1 forces the kernel body
+    through the Pallas interpreter off-TPU (CI coverage of the kernel)."""
+    from deneva_tpu.ops.conflict import overlap
+    from deneva_tpu.parallel.mesh import _current
+
+    on_tpu = jax.default_backend() == "tpu"
+    if _current["mesh"] is not None:
+        # sharded bucket dim: the XLA path contracts over partitions with
+        # a compiler-inserted reduction; pallas_call has no GSPMD rule and
+        # would force an all-gather of both incidence planes
+        return overlap(inc_a, inc_b, inc_a2, inc_b2)
+    if not _can_use(inc_a) or not (on_tpu or _INTERPRET):
+        return overlap(inc_a, inc_b, inc_a2, inc_b2)
+    dual = inc_a2 is not None
+    out = _overlap_pallas(inc_a, inc_b.T, inc_a2 if dual else inc_a,
+                          inc_b2.T if dual else inc_b.T, dual,
+                          interpret=not on_tpu)
+    return out.astype(bool)

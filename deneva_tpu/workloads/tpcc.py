@@ -188,8 +188,12 @@ class TPCCWorkload:
         return w * self.max_items + i
 
     # local slots: storage addressing on THIS node — warehouses not owned
-    # here resolve to each table's trash slot so remote-row gathers read
-    # zeros and scatters drop (partitioned execution, SURVEY §2.10)
+    # here resolve to each table's trash slot.  NOTE: the trash row is a
+    # spill target, not guaranteed zeros — masked scatters land IN it, so
+    # trash-row gathers of scatter-written columns return garbage; every
+    # consumer of a remote-lane gather below must stay masked by
+    # ownership (they do: o_id/inserts use m & owned, stock writes
+    # resolve back into trash)
     def wh_owned(self, w):
         if self.n_parts == 1:
             return jnp.ones(jnp.shape(w), bool)
