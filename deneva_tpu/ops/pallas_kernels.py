@@ -40,6 +40,19 @@ def _can_use(a: jax.Array) -> bool:
     return b % TM == 0 and k % TK == 0 and b >= TM and k >= TK
 
 
+_warned: set = set()
+
+
+def _warn_fallback(why: str) -> None:
+    """Warn once per reason: a use_pallas=True run that silently takes
+    the XLA path would make Pallas-vs-XLA sweeps measure XLA vs itself."""
+    if why not in _warned:
+        _warned.add(why)
+        import warnings
+        warnings.warn(f"use_pallas requested but falling back to XLA "
+                      f"overlap: {why}", stacklevel=3)
+
+
 @functools.partial(jax.jit, static_argnames=("dual", "interpret"))
 def _overlap_pallas(a1, b1t, a2, b2t, dual: bool, interpret: bool = False):
     from jax.experimental import pallas as pl
@@ -116,8 +129,11 @@ def overlap_fused(inc_a, inc_b, inc_a2=None, inc_b2=None) -> jax.Array:
         # sharded bucket dim: the XLA path contracts over partitions with
         # a compiler-inserted reduction; pallas_call has no GSPMD rule and
         # would force an all-gather of both incidence planes
+        _warn_fallback("mesh-sharded buckets")
         return overlap(inc_a, inc_b, inc_a2, inc_b2)
     if not _can_use(inc_a) or not (on_tpu or _INTERPRET):
+        _warn_fallback(f"shape {tuple(inc_a.shape)} untileable"
+                       if not _can_use(inc_a) else "not on TPU")
         return overlap(inc_a, inc_b, inc_a2, inc_b2)
     dual = inc_a2 is not None
     out = _overlap_pallas(inc_a, inc_b.T, inc_a2 if dual else inc_a,

@@ -69,11 +69,11 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
     from deneva_tpu.config import WorkloadKind
     from deneva_tpu.runtime.native import ipc_endpoints
 
-    if cfg.workload not in (WorkloadKind.YCSB, WorkloadKind.TPCC):
+    if cfg.workload not in (WorkloadKind.YCSB, WorkloadKind.TPCC,
+                            WorkloadKind.PPS):
         raise NotImplementedError(
-            "distributed runtime: only YCSB/TPCC have wire adapters + "
-            "partitioned loaders (to_wire/from_wire on the workload); PPS "
-            "runs on the single-node engine")
+            f"distributed runtime: workload {cfg.workload} has no wire "
+            "adapters (to_wire/from_wire) or partitioned loader")
     n_srv, n_cl = cfg.node_cnt, cfg.client_node_cnt
     n_repl = cfg.replica_cnt * n_srv
     run_id = run_id or f"{os.getpid()}_{abs(hash(cfg)) % 99999}"

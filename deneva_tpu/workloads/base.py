@@ -31,8 +31,29 @@ from __future__ import annotations
 from typing import Any, Protocol
 
 import jax
+import jax.numpy as jnp
 
 DB = dict  # table name -> DeviceTable; a pytree
+
+
+def partition_owned(key: jax.Array, n_parts: int, me: int) -> jax.Array:
+    """bool mask: does this node own ``key`` under modulo striping
+    (reference GET_NODE_ID, `system/global.h:294`)?"""
+    if n_parts == 1:
+        return jnp.ones(jnp.shape(key), bool)
+    return key % n_parts == me
+
+
+def partition_slot(key: jax.Array, n_parts: int, me: int,
+                   n_local: int) -> jax.Array:
+    """Local storage slot for a striped global key; keys this node does
+    not own resolve to ``n_local`` — the table's TRASH slot.  NOTE the
+    trash-row contract (see `storage/table.py`): masked scatters land IN
+    the trash row, so gathers of scatter-written columns through it
+    return garbage — consumers must stay masked by `partition_owned`."""
+    loc = key // n_parts if n_parts > 1 else key
+    return jnp.where(partition_owned(key, n_parts, me), loc,
+                     jnp.int32(n_local))
 
 
 class Workload(Protocol):

@@ -45,6 +45,7 @@ import numpy as np
 from deneva_tpu.config import Config
 from deneva_tpu.ops import last_writer
 from deneva_tpu.storage.catalog import parse_schema
+from deneva_tpu.workloads.base import partition_owned, partition_slot
 from deneva_tpu.storage.table import DeviceTable, fill_columns
 
 _FIELDS = "".join(f"\t10,string,FIELD{i}\n" for i in range(1, 11))
@@ -88,6 +89,22 @@ class PPSWorkload:
         self.n_products = cfg.pps_products_cnt
         self.n_suppliers = cfg.pps_suppliers_cnt
         self.per = cfg.pps_parts_per        # MAX_PPS_PART_PER_PRODUCT (config.h:230)
+        # partitioned deployment: PARTS/PRODUCTS/SUPPLIERS stripe by
+        # key % part_cnt; the immutable USES/SUPPLIES mapping tables are
+        # replicated on every node (like TPCC's read-only ITEM), which is
+        # what lets on-device recon (`plan`) stay local — the reference
+        # instead ships recon results through the sequencer
+        # (`system/sequencer.cpp:88-115`)
+        self.n_pt = max(cfg.part_cnt, 1)
+        self.me = cfg.node_id if self.n_pt > 1 else 0
+        for nm, n in (("pps_parts_cnt", self.n_parts),
+                      ("pps_products_cnt", self.n_products),
+                      ("pps_suppliers_cnt", self.n_suppliers)):
+            if n % self.n_pt != 0:
+                raise ValueError(f"{nm} must divide evenly over part_cnt")
+        self.n_parts_loc = self.n_parts // self.n_pt
+        self.n_products_loc = self.n_products // self.n_pt
+        self.n_suppliers_loc = self.n_suppliers // self.n_pt
         need = 1 + 2 * self.per
         if cfg.max_accesses < need:
             raise ValueError(f"PPS needs max_accesses >= {need}")
@@ -99,24 +116,38 @@ class PPSWorkload:
             cfg.perc_updatepart], np.float64)
         assert abs(self.mix.sum() - 1.0) < 1e-6
 
+    # -- local slots (partitioned storage addressing) --------------------
+    def _owned(self, key):
+        return partition_owned(key, self.n_pt, self.me)
+
+    def _slot(self, key, n_local):
+        return partition_slot(key, self.n_pt, self.me, n_local)
+
+    def part_slot(self, key):
+        return self._slot(key, self.n_parts_loc)
+
+    def product_slot(self, key):
+        return self._slot(key, self.n_products_loc)
+
     # -- loader (pps_wl.cpp:71-111 threadInit*) -------------------------
     def load(self):
         db = {}
+        p, me = self.n_pt, self.me
 
         def fill(name, cap, cols):
             t = DeviceTable.create(self.catalog.table(name), cap)
             db[name] = fill_columns(t, cap, cols)
 
-        p_ids = np.arange(self.n_parts, dtype=np.int32)
-        fill("PARTS", self.n_parts,
+        p_ids = me + p * np.arange(self.n_parts_loc, dtype=np.int32)
+        fill("PARTS", self.n_parts_loc,
              {"PART_KEY": p_ids,
-              "PART_AMOUNT": np.full(self.n_parts, 10000, np.int32)})
-        pr_ids = np.arange(self.n_products, dtype=np.int32)
-        fill("PRODUCTS", self.n_products,
+              "PART_AMOUNT": np.full(self.n_parts_loc, 10000, np.int32)})
+        pr_ids = me + p * np.arange(self.n_products_loc, dtype=np.int32)
+        fill("PRODUCTS", self.n_products_loc,
              {"PRODUCT_KEY": pr_ids,
               "PRODUCT_PART": _map_part(pr_ids, 0, 0, self.n_parts)})
-        s_ids = np.arange(self.n_suppliers, dtype=np.int32)
-        fill("SUPPLIERS", self.n_suppliers, {"SUPPLIER_KEY": s_ids})
+        s_ids = me + p * np.arange(self.n_suppliers_loc, dtype=np.int32)
+        fill("SUPPLIERS", self.n_suppliers_loc, {"SUPPLIER_KEY": s_ids})
 
         # mapping tables: row (anchor*per + j) -> part (pps_wl.cpp uses
         # URand parts per anchor; here a deterministic hash map)
@@ -144,6 +175,24 @@ class PPSWorkload:
             part_key=jax.random.randint(k1, (n,), 0, self.n_parts),
             product_key=jax.random.randint(k2, (n,), 0, self.n_products),
             supplier_key=jax.random.randint(k3, (n,), 0, self.n_suppliers))
+
+    # -- wire adapters (distributed runtime) -----------------------------
+    # all four query fields are per-txn scalars; no per-access columns
+    def to_wire(self, q: PPSQuery):
+        n = int(q.txn_type.shape[0])
+        s = np.stack([np.asarray(q.txn_type, np.int32),
+                      np.asarray(q.part_key, np.int32),
+                      np.asarray(q.product_key, np.int32),
+                      np.asarray(q.supplier_key, np.int32)], axis=1)
+        return (np.zeros((n, 1), np.int32), np.zeros((n, 1), np.int8), s)
+
+    def from_wire(self, keys: np.ndarray, types: np.ndarray,
+                  scalars: np.ndarray) -> PPSQuery:
+        scalars = np.ascontiguousarray(scalars, np.int32)
+        return PPSQuery(txn_type=jnp.asarray(scalars[:, 0]),
+                        part_key=jnp.asarray(scalars[:, 1]),
+                        product_key=jnp.asarray(scalars[:, 2]),
+                        supplier_key=jnp.asarray(scalars[:, 3]))
 
     # -- RW-set planning with on-device recon ---------------------------
     def plan(self, db, q: PPSQuery) -> dict:
@@ -216,37 +265,42 @@ class PPSWorkload:
         per = self.per
         n = t.shape[0]
 
-        # reads feed the checksum (anchor row field)
-        anchor_amt = db["PARTS"].gather(q.part_key, ("PART_AMOUNT",))[
-            "PART_AMOUNT"]
+        # reads feed the checksum (anchor row field); remote anchors read
+        # the trash row and stay masked out of this node's stat
+        anchor_amt = db["PARTS"].gather(self.part_slot(q.part_key),
+                                        ("PART_AMOUNT",))["PART_AMOUNT"]
         stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
-            jnp.where(mask & (t == GETPART), anchor_amt, 0)
+            jnp.where(mask & (t == GETPART) & self._owned(q.part_key),
+                      anchor_amt, 0)
         ).astype(jnp.uint32)
 
         # ORDERPRODUCT: PART_AMOUNT -= 1 on each part of the product
+        # (parts resolve via the replicated USES map; each node applies
+        # the decrements for the part rows it owns)
         om = mask & (t == ORDERPRODUCT)
         lane = jnp.arange(per)
         ukey = q.product_key[:, None] * per + lane[None, :]
         parts = jnp.take(db["USES"].columns["PART_KEY"], ukey, axis=0)
         m2 = om[:, None] & jnp.ones((n, per), bool)
         db["PARTS"] = db["PARTS"].scatter_add(
-            parts.reshape(-1),
+            self.part_slot(parts).reshape(-1),
             {"PART_AMOUNT": jnp.where(m2, -1, 0).reshape(-1)},
             mask=m2.reshape(-1))
 
         # UPDATEPART: PART_AMOUNT += 100 (run_updatepart_1)
         um = mask & (t == UPDATEPART)
         db["PARTS"] = db["PARTS"].scatter_add(
-            q.part_key, {"PART_AMOUNT": jnp.where(um, 100, 0)}, mask=um)
+            self.part_slot(q.part_key),
+            {"PART_AMOUNT": jnp.where(um, 100, 0)}, mask=um)
 
         # UPDATEPRODUCTPART: product's part field = part_key
         # (run_updateproductpart_1 set_value(1, part_key))
         pm = mask & (t == UPDATEPRODUCTPART)
-        win = last_writer(jnp.where(pm, q.product_key,
-                                    db["PRODUCTS"].capacity),
+        pslot = self.product_slot(q.product_key)
+        win = last_writer(jnp.where(pm, pslot, db["PRODUCTS"].capacity),
                           order, pm, db["PRODUCTS"].capacity)
         db["PRODUCTS"] = db["PRODUCTS"].scatter(
-            q.product_key, {"PRODUCT_PART": q.part_key}, mask=win)
+            pslot, {"PRODUCT_PART": q.part_key}, mask=win)
 
         stats["write_cnt"] = stats["write_cnt"] + (
             (om.sum() * per) + um.sum() + pm.sum()).astype(jnp.uint32)

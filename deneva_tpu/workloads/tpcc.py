@@ -47,6 +47,7 @@ import numpy as np
 from deneva_tpu.config import Config
 from deneva_tpu.ops import last_writer
 from deneva_tpu.storage.catalog import parse_schema
+from deneva_tpu.workloads.base import partition_owned, partition_slot
 from deneva_tpu.storage.table import DeviceTable, fill_columns
 
 # ---------------------------------------------------------------------------
@@ -195,16 +196,13 @@ class TPCCWorkload:
     # ownership (they do: o_id/inserts use m & owned, stock writes
     # resolve back into trash)
     def wh_owned(self, w):
-        if self.n_parts == 1:
-            return jnp.ones(jnp.shape(w), bool)
-        return w % self.n_parts == self.me
+        return partition_owned(w, self.n_parts, self.me)
 
     def _wloc(self, w):
         return w // self.n_parts if self.n_parts > 1 else w
 
     def wh_slot(self, w):
-        return jnp.where(self.wh_owned(w), self._wloc(w),
-                         jnp.int32(self.n_wh_loc))
+        return partition_slot(w, self.n_parts, self.me, self.n_wh_loc)
 
     def dist_slot(self, w, d):
         return jnp.where(self.wh_owned(w),

@@ -91,3 +91,21 @@ def test_cluster_2s1c_tpcc_partitioned():
     s1 = parse_summary(out[1][1])
     assert s0["total_txn_commit_cnt"] == s1["total_txn_commit_cnt"] > 0
     assert parse_summary(out[2][1])["txn_cnt"] > 0
+
+
+@pytest.mark.slow
+def test_cluster_2s1c_pps_partitioned():
+    """PPS over 2 partitioned nodes: recon against the replicated
+    USES/SUPPLIES maps stays local, commits agree across servers."""
+    cfg = Config(
+        workload=WorkloadKind.PPS, cc_alg=CCAlg.CALVIN,
+        node_cnt=2, client_node_cnt=1,
+        pps_parts_cnt=500, pps_products_cnt=100, pps_suppliers_cnt=100,
+        pps_parts_per=4,
+        epoch_batch=64, conflict_buckets=512, max_accesses=16,
+        max_txn_in_flight=512, warmup_secs=0.5, done_secs=1.5)
+    out = boot(cfg)
+    s0 = parse_summary(out[0][1])
+    s1 = parse_summary(out[1][1])
+    assert s0["total_txn_commit_cnt"] == s1["total_txn_commit_cnt"] > 0
+    assert parse_summary(out[2][1])["txn_cnt"] > 0
