@@ -179,6 +179,10 @@ class Config:
     tport_port: int = 17000
     msg_size_max: int = 4096
     msg_time_limit_us: float = 0.0
+    net_delay_us: float = 0.0      # NETWORK_DELAY_TEST (msg_queue.cpp:104-125)
+
+    # ---- deployment (harness): in-process engine vs multi-process cluster
+    deploy: str = "inproc"         # inproc | cluster
 
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
@@ -217,6 +221,8 @@ class Config:
                f"bad index_struct {self.index_struct!r}")
         _check(self.tport_type in ("ipc", "tcp"),
                f"bad tport_type {self.tport_type!r}")
+        _check(self.deploy in ("inproc", "cluster"),
+               f"bad deploy {self.deploy!r}")
         _check(self.repl_type in ("AP", "AA"),
                f"bad repl_type {self.repl_type!r}")
         if self.workload == WorkloadKind.PPS:

@@ -116,6 +116,37 @@ def pps_scaling(quick: bool) -> list[Config]:
     return _alg_sweep(base)
 
 
+def cluster_scaling(quick: bool) -> list[Config]:
+    """Multi-process server scaling over IPC (the reference's local
+    N-node runs, `scripts/run_experiments.py:67`): real transport, real
+    epoch exchange, partitioned execution."""
+    base = Config(
+        deploy="cluster", client_node_cnt=1,
+        synth_table_size=1 << 14 if quick else 1 << 18,
+        req_per_query=4, max_accesses=4, epoch_batch=256,
+        conflict_buckets=1024, max_txn_in_flight=2048,
+        warmup_secs=0.5, done_secs=1.5 if quick else 5.0, zipf_theta=0.6)
+    nodes = (1, 2) if quick else (1, 2, 4)
+    algs = ("CALVIN", "TPU_BATCH") if quick else ("NO_WAIT", "CALVIN",
+                                                  "TPU_BATCH")
+    return [base.replace(node_cnt=n, part_cnt=n, cc_alg=CCAlg(a))
+            for n in nodes for a in algs]
+
+
+def network_sweep(quick: bool) -> list[Config]:
+    """NETWORK_DELAY_TEST (`system/msg_queue.cpp:104-125`,
+    `scripts/experiments.py:281` network_sweep): artificial send delay
+    injected in the native transport of a 2-server cluster."""
+    base = Config(
+        deploy="cluster", node_cnt=2, part_cnt=2, client_node_cnt=1,
+        cc_alg=CCAlg.CALVIN, synth_table_size=1 << 14,
+        req_per_query=4, max_accesses=4, epoch_batch=256,
+        conflict_buckets=1024, max_txn_in_flight=2048,
+        warmup_secs=0.5, done_secs=1.5 if quick else 5.0)
+    delays = (0, 1000) if quick else (0, 100, 1000, 10000)
+    return [base.replace(net_delay_us=float(d)) for d in delays]
+
+
 def modes(quick: bool) -> list[Config]:
     """Degraded-mode oracles (SURVEY §4.2): layer-isolation bounds."""
     base = paper_base(quick).replace(zipf_theta=0.6, cc_alg=CCAlg.TPU_BATCH)
@@ -132,6 +163,8 @@ experiment_map: dict[str, Callable[[bool], list[Config]]] = {
     "isolation_levels": isolation_levels,
     "tpcc_scaling": tpcc_scaling,
     "pps_scaling": pps_scaling,
+    "cluster_scaling": cluster_scaling,
+    "network_sweep": network_sweep,
     "modes": modes,
 }
 

@@ -23,14 +23,27 @@ from deneva_tpu.harness.parse import cfg_header, load_results, outfile_name
 
 
 def run_point(cfg: Config, out_dir: str, quiet: bool = True) -> str:
-    """Run one config, write its output file, return the path."""
-    from deneva_tpu.engine.driver import run_simulation
+    """Run one config, write its output file, return the path.
+
+    ``deploy=inproc`` runs the single-process engine; ``deploy=cluster``
+    boots real server/client processes over IPC (the reference's local
+    multi-node mode, `scripts/run_experiments.py:67`) and reports server
+    0's summary, with every other node's line as a comment."""
     os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, outfile_name(cfg))
     t0 = time.monotonic()
     try:
-        stats = run_simulation(cfg, quiet=True)
-        body = stats.summary_line() + "\n"
+        if cfg.deploy == "cluster":
+            from deneva_tpu.runtime.launch import run_cluster
+            out = run_cluster(cfg, platform="cpu")
+            body = "".join(f"# node {nid} ({kind}): {line}\n"
+                           for nid, (kind, line) in sorted(out.items())
+                           if nid != 0)
+            body += out[0][1] + "\n"
+        else:
+            from deneva_tpu.engine.driver import run_simulation
+            stats = run_simulation(cfg, quiet=True)
+            body = stats.summary_line() + "\n"
         ok = True
     except Exception:
         body = "# run failed\n" + "".join(

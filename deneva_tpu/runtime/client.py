@@ -46,6 +46,8 @@ class ClientNode:
         self.tp = NativeTransport(self.me, endpoints, self.n_all,
                                   msg_size_max=cfg.msg_size_max)
         self.tp.start()
+        if cfg.net_delay_us:
+            self.tp.set_delay_us(int(cfg.net_delay_us))
         self.inflight = np.zeros(self.n_srv, np.int64)
         # reference: inflight cap is per server pair (client_txn.cpp:25);
         # floored at one send chunk or the client could never send at all

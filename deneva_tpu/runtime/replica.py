@@ -37,6 +37,8 @@ class ReplicaNode:
         self.tp = NativeTransport(self.me, endpoints, self.n_all,
                                   msg_size_max=cfg.msg_size_max)
         self.tp.start()
+        if cfg.net_delay_us:
+            self.tp.set_delay_us(int(cfg.net_delay_us))
         self.log_path = os.path.join(cfg.log_dir,
                                      f"replica{self.me}.log.bin")
         os.makedirs(cfg.log_dir, exist_ok=True)

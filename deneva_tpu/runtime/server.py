@@ -179,6 +179,8 @@ class ServerNode:
                                   self.n_srv + self.n_cl + self.n_repl,
                                   msg_size_max=cfg.msg_size_max)
         self.tp.start()
+        if cfg.net_delay_us:
+            self.tp.set_delay_us(int(cfg.net_delay_us))
         # durability (reference LOGGING + replication, SURVEY §5.4):
         # per-epoch command-log records; CL_RSPs gate on flush + replica ack
         self.logger = None

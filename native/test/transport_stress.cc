@@ -1,0 +1,131 @@
+// Concurrency stress for the native transport, built under TSAN/ASAN
+// (SURVEY §5.2: the reference has a dead DEBUG_RACE flag and a
+// commented-out ASan line, Makefile:3 — sanitizer builds are the modern
+// equivalent, and this binary is their workload).
+//
+// Exercises: full-mesh setup, concurrent dt_send from several threads,
+// loopback delivery, concurrent dt_recv, dt_flush tickets racing the
+// sender, delay injection, stats reads, ping-pong, and teardown racing
+// in-flight traffic.  Exits 0 iff every message is accounted for; any
+// data race / leak is the sanitizer's to report (nonzero exit).
+
+#include "../include/deneva_host.h"
+
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kNodes = 3;
+constexpr int kSendersPerNode = 3;
+constexpr int kMsgsPerSender = 2000;
+
+std::string endpoints(const char* dir) {
+  // pid-unique socket paths: concurrent tsan/asan runs must not steal
+  // each other's listeners (dt_start unlinks before bind)
+  std::string pid = std::to_string(::getpid());
+  std::string s;
+  for (uint32_t i = 0; i < kNodes; ++i)
+    s += std::to_string(i) + " ipc " + dir + "/stress_" + pid + "_n" +
+         std::to_string(i) + ".sock\n";
+  return s;
+}
+
+}  // namespace
+
+int main() {
+  const char* dir = "/tmp";
+  std::string eps = endpoints(dir);
+
+  dt_transport* t[kNodes];
+  for (uint32_t i = 0; i < kNodes; ++i) {
+    t[i] = dt_create(i, eps.c_str(), kNodes, 4096, 100);
+    if (!t[i]) {
+      std::fprintf(stderr, "dt_create %u failed\n", i);
+      return 1;
+    }
+  }
+  std::vector<std::thread> starters;
+  std::atomic<int> start_fail{0};
+  for (uint32_t i = 0; i < kNodes; ++i)
+    starters.emplace_back([&, i] {
+      if (dt_start(t[i], 10000) != 0) start_fail.fetch_add(1);
+    });
+  for (auto& th : starters) th.join();
+  if (start_fail.load()) {
+    std::fprintf(stderr, "mesh setup failed\n");
+    return 1;
+  }
+
+  // receivers count everything that arrives
+  std::atomic<uint64_t> rcvd{0};
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> rxs;
+  for (uint32_t i = 0; i < kNodes; ++i)
+    rxs.emplace_back([&, i] {
+      std::vector<uint8_t> buf(1 << 16);
+      uint32_t src;
+      uint16_t rt;
+      uint32_t need;
+      while (!stop.load(std::memory_order_relaxed)) {
+        long n = dt_recv(t[i], buf.data(), buf.size(), &src, &rt, 2000,
+                         &need);
+        if (n >= 0) rcvd.fetch_add(1, std::memory_order_relaxed);
+      }
+    });
+
+  // senders hammer every destination (including loopback), racing flushes
+  std::vector<std::thread> txs;
+  std::atomic<uint64_t> sent{0};
+  for (uint32_t i = 0; i < kNodes; ++i) {
+    for (int s = 0; s < kSendersPerNode; ++s) {
+      txs.emplace_back([&, i, s] {
+        uint8_t payload[64];
+        std::memset(payload, 0x5A, sizeof(payload));
+        for (int m = 0; m < kMsgsPerSender; ++m) {
+          uint32_t dest = static_cast<uint32_t>((i + 1 + m) % kNodes);
+          if (dt_send(t[i], dest, DT_EPOCH_BLOB, payload,
+                      sizeof(payload)) == 0)
+            sent.fetch_add(1, std::memory_order_relaxed);
+          if ((m & 255) == 0) dt_flush(t[i]);
+          if (s == 0 && (m & 511) == 0)
+            dt_set_delay_us(t[i], (m & 1024) ? 50 : 0);
+        }
+        dt_flush(t[i]);
+      });
+    }
+  }
+  for (auto& th : txs) th.join();
+
+  // ping-pong while receivers still run
+  long rtt = dt_ping(t[0], 1, 5, 8);
+  if (rtt < 0) std::fprintf(stderr, "warn: ping failed\n");
+
+  // drain until everything sent has been received (bounded)
+  uint64_t stat[DT_STAT_COUNT];
+  for (int spins = 0; spins < 4000; ++spins) {
+    if (rcvd.load() >= sent.load()) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(5));
+  }
+  stop.store(true);
+  for (auto& th : rxs) th.join();
+  dt_stats(t[0], stat);
+
+  uint64_t s_total = sent.load(), r_total = rcvd.load();
+  for (uint32_t i = 0; i < kNodes; ++i) dt_destroy(t[i]);
+  if (r_total < s_total) {
+    std::fprintf(stderr, "lost messages: sent=%llu rcvd=%llu\n",
+                 (unsigned long long)s_total, (unsigned long long)r_total);
+    return 1;
+  }
+  std::printf("stress ok: sent=%llu rcvd=%llu rtt=%ldns\n",
+              (unsigned long long)s_total, (unsigned long long)r_total, rtt);
+  return 0;
+}

@@ -2,6 +2,7 @@
 Unix-domain sockets — the reference's IPC single-box integration rig
 (`transport/transport.cpp:132-133`, SURVEY §4.4)."""
 
+import os
 import threading
 import time
 import uuid
@@ -194,3 +195,19 @@ def test_stats_counters(lib):
     finally:
         a.close()
         b.close()
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("target", ["tsan", "asan"])
+def test_sanitizer_stress(target):
+    """SURVEY §5.2: race/memory sanitizer gates for the native runtime
+    (the reference's DEBUG_RACE flag is dead and its ASan line commented
+    out; these are the modern equivalent). Builds and runs the stress
+    binary; the sanitizer makes any data race or leak a nonzero exit."""
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(["make", "-C", os.path.join(root, "native"),
+                           target], capture_output=True, text=True,
+                          timeout=600)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    assert "stress ok" in proc.stdout
