@@ -1,0 +1,59 @@
+"""Result rendering (reference `scripts/plot.py` / `paper_plots.py`).
+
+The reference produces matplotlib figures from parsed summary rows; a
+terminal testbed wants tables first.  This CLI pivots a results directory
+into an aligned text table (series = CC algorithm by default), which is
+also trivially machine-readable (TSV with --tsv).
+
+    python -m deneva_tpu.harness.plot results/ycsb_skew \
+        --x zipf_theta --y tput [--series cc_alg] [--tsv]
+"""
+
+from __future__ import annotations
+
+import sys
+
+from deneva_tpu.harness.parse import results_table
+
+
+def render(out_dir: str, x: str, y: str, series: str,
+           tsv: bool = False) -> str:
+    table = results_table(out_dir, x=x, y=y, series=series)
+    if not table:
+        return f"(no rows with {x!r} and {y!r} in {out_dir})"
+    xs = sorted({pt[0] for pts in table.values() for pt in pts})
+    header = [f"{series}\\{x}"] + [str(v) for v in xs]
+    rows = [header]
+    for s in sorted(table, key=str):
+        by_x = dict(table[s])
+        rows.append([str(s)] + [
+            f"{by_x[v]:.1f}" if isinstance(by_x.get(v), float)
+            else str(by_x.get(v, "-")) for v in xs])
+    if tsv:
+        return "\n".join("\t".join(r) for r in rows)
+    widths = [max(len(r[i]) for r in rows) for i in range(len(header))]
+    return "\n".join(
+        "  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows)
+
+
+def main(argv: list[str]) -> int:
+    if not argv or argv[0].startswith("-"):
+        print("usage: python -m deneva_tpu.harness.plot <results_dir> "
+              "[--x FIELD] [--y FIELD] [--series FIELD] [--tsv]")
+        return 2
+
+    def opt(name: str, default: str) -> str:
+        if name in argv:
+            i = argv.index(name)
+            if i + 1 >= len(argv) or argv[i + 1].startswith("--"):
+                raise SystemExit(f"error: {name} needs a field name")
+            return argv[i + 1]
+        return default
+
+    print(render(argv[0], x=opt("--x", "zipf_theta"), y=opt("--y", "tput"),
+                 series=opt("--series", "cc_alg"), tsv="--tsv" in argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

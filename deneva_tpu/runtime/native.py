@@ -77,6 +77,8 @@ def _load() -> C.CDLL:
             lib.dt_flush.argtypes = [C.c_void_p]
             lib.dt_set_delay_us.argtypes = [C.c_void_p, C.c_uint64]
             lib.dt_stats.argtypes = [C.c_void_p, C.POINTER(C.c_uint64)]
+            lib.dt_peer_alive.restype = C.c_int
+            lib.dt_peer_alive.argtypes = [C.c_void_p, C.c_uint32]
             lib.dt_ping.restype = C.c_long
             lib.dt_ping.argtypes = [C.c_void_p, C.c_uint32, C.c_uint32,
                                     C.c_uint32]
@@ -159,6 +161,11 @@ class NativeTransport:
 
     def set_delay_us(self, us: int) -> None:
         self._lib.dt_set_delay_us(self._h, us)
+
+    def peer_alive(self, peer: int) -> bool:
+        """Link-level failure detection (the reference has none: its
+        heartbeat body is commented out, `system/thread.cpp:28-41`)."""
+        return bool(self._lib.dt_peer_alive(self._h, peer))
 
     def stats(self) -> dict[str, int]:
         out = (C.c_uint64 * len(STAT_NAMES))()

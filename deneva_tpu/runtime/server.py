@@ -323,6 +323,9 @@ class ServerNode:
         measured = None     # counter snapshot at measure start
         epoch = 0
         tl = _Timeline() if cfg.debug_timeline else None
+        # phase-time ledger (reference Stats_thd worker time breakdowns,
+        # `statistics/stats.h:116` worker_idle_time etc.)
+        self._ph = {"idle": 0.0, "process": 0.0}
         while True:
             if tl:
                 tl.mark("loop")
@@ -356,11 +359,27 @@ class ServerNode:
             # collect the other servers' contributions for this epoch
             t0 = time.monotonic()
             while len(self.blob_buf.get(epoch, {})) < self.n_srv - 1:
+                self._drain(timeout_us=5_000)
+                have = self.blob_buf.get(epoch, {})
+                if len(have) >= self.n_srv - 1:
+                    break
+                # check liveness only AFTER draining: a peer may have
+                # flushed this epoch's blob (now in our recv queue) and
+                # then exited — that epoch is completable, not failed
+                dead = [p for p in range(self.n_srv)
+                        if p != self.me and p not in have
+                        and not self.tp.peer_alive(p)]
+                if dead:
+                    # failure detection (SURVEY §5.3: the reference has
+                    # none — it would hang on its 1s recv timeouts forever)
+                    raise RuntimeError(
+                        f"server {self.me}: peer server(s) {dead} died "
+                        f"waiting for epoch {epoch} blobs")
                 if time.monotonic() - t0 > 60:
                     raise TimeoutError(
                         f"server {self.me}: epoch {epoch} blob wait: have "
-                        f"{sorted(self.blob_buf.get(epoch, {}))}")
-                self._drain(timeout_us=5_000)
+                        f"{sorted(have)}")
+            self._ph["idle"] += time.monotonic() - t0
             if tl:
                 tl.mark("collect")
             parts = self.blob_buf.pop(epoch, {})
@@ -373,10 +392,12 @@ class ServerNode:
                           + len(parts[s])] = True
             query = self.wl.from_wire(merged.keys, merged.types,
                                       merged.scalars)
+            t_step = time.monotonic()
             self.db, self.cc_state, self.dev_stats, commit, abort, defer = \
                 self.step(self.db, self.cc_state, self.dev_stats,
                           jnp.int32(epoch), jnp.asarray(active_np), query)
             commit = np.asarray(commit)
+            self._ph["process"] += time.monotonic() - t_step
             abort = np.asarray(abort)
             defer = np.asarray(defer)
             if tl:
@@ -465,6 +486,8 @@ class ServerNode:
         st.set("unique_txn_abort_cnt", float(aborts))
         st.set("abort_rate",
                float(aborts) / max(float(commits + aborts), 1.0))
+        st.set("worker_idle_time", self._ph["idle"])
+        st.set("worker_process_time", self._ph["process"])
         for k, v in self.tp.stats().items():
             st.set(f"net_{k}", float(v))
         return st

@@ -99,6 +99,10 @@ void dt_set_delay_us(dt_transport *t, uint64_t delay_us);
 /* Copy DT_STAT_COUNT counters into out. */
 void dt_stats(const dt_transport *t, uint64_t *out);
 
+/* 1 while the link to peer is up, 0 after a read/write on it failed
+ * (failure detection — the reference has none, SURVEY §5.3). */
+int dt_peer_alive(const dt_transport *t, uint32_t peer);
+
 /* Ping-pong round trips against peer; returns mean round-trip ns, or -1.
  * (reference NETWORK_TEST, system/main.cpp:346-387) */
 long dt_ping(dt_transport *t, uint32_t peer, uint32_t rounds,

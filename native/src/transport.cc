@@ -560,6 +560,15 @@ void dt_set_delay_us(dt_transport *t, uint64_t delay_us) {
   if (t) t->delay_us.store(delay_us, std::memory_order_relaxed);
 }
 
+int dt_peer_alive(const dt_transport *t, uint32_t peer) {
+  if (!t || peer >= t->n_nodes) return 0;
+  if (peer == t->node_id) return 1;
+  return (t->peer_fd[peer] >= 0 &&
+          !t->peer_dead[peer].load(std::memory_order_relaxed))
+             ? 1
+             : 0;
+}
+
 void dt_stats(const dt_transport *t, uint64_t *out) {
   if (!t || !out) return;
   for (int i = 0; i < DT_STAT_COUNT; ++i)

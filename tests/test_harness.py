@@ -71,3 +71,20 @@ def test_parse_file_none_when_no_summary(tmp_path):
     assert parse_file(str(p)) is None
     rows = load_results(str(tmp_path))
     assert rows[0]["cc_alg"] == "OCC" and "tput" not in rows[0]
+
+
+def test_plot_renders_pivot(tmp_path):
+    from deneva_tpu.harness.plot import render
+    from deneva_tpu.harness.run import run_point
+    from deneva_tpu.config import Config
+    for theta in (0.0, 0.9):
+        run_point(Config(cc_alg="OCC", epoch_batch=64, conflict_buckets=256,
+                         max_accesses=4, req_per_query=4,
+                         synth_table_size=1024, max_txn_in_flight=128,
+                         zipf_theta=theta, warmup_secs=0.0, done_secs=0.2),
+                  str(tmp_path))
+    out = render(str(tmp_path), x="zipf_theta", y="tput", series="cc_alg")
+    assert "OCC" in out and "0.9" in out
+    tsv = render(str(tmp_path), x="zipf_theta", y="tput", series="cc_alg",
+                 tsv=True)
+    assert "\t" in tsv

@@ -109,3 +109,60 @@ def test_cluster_2s1c_pps_partitioned():
     s1 = parse_summary(out[1][1])
     assert s0["total_txn_commit_cnt"] == s1["total_txn_commit_cnt"] > 0
     assert parse_summary(out[2][1])["txn_cnt"] > 0
+
+
+@pytest.mark.slow
+def test_dead_peer_detected_fast():
+    """Failure detection (SURVEY §5.3 — the reference has none and would
+    hang): a server whose peer dies mid-run must raise naming the peer,
+    long before the 60s blob timeout."""
+    import threading
+    import time as _time
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deneva_tpu.runtime.native import ipc_endpoints
+    from deneva_tpu.runtime.server import ServerNode
+
+    cfg = small_cfg(node_cnt=2, client_node_cnt=0, done_secs=30.0,
+                    synth_table_size=4096)
+    eps = ipc_endpoints(2, "deadpeer")
+    err: dict = {}
+
+    def run_a():
+        node = ServerNode(cfg.replace(node_id=0, part_cnt=2), eps, "cpu")
+        t0 = _time.monotonic()
+        try:
+            node.run()
+        except RuntimeError as e:
+            err["msg"] = str(e)
+            err["secs"] = _time.monotonic() - t0
+        finally:
+            node.close()
+
+    def run_b():
+        node = ServerNode(cfg.replace(node_id=1, part_cnt=2), eps, "cpu")
+        node.barrier()          # join the mesh, then die without a word
+        node.close()
+
+    ta = threading.Thread(target=run_a)
+    tb = threading.Thread(target=run_b)
+    ta.start(); tb.start()
+    tb.join(timeout=60)
+    ta.join(timeout=60)
+    assert "msg" in err, "server 0 never noticed the dead peer"
+    assert "died" in err["msg"] and "[1]" in err["msg"]
+    assert err["secs"] < 30, f"detection took {err['secs']:.1f}s"
+
+
+@pytest.mark.slow
+def test_client_load_rate_throttles():
+    """LOAD_RATE mode (reference `config.h:21-22`, client_thread.cpp:35-41):
+    a fixed txn/s budget must cap the send rate well below saturation."""
+    cfg = small_cfg(node_cnt=1, client_node_cnt=1, load_rate=2000,
+                    warmup_secs=0.3, done_secs=2.0)
+    out = boot(cfg)
+    from deneva_tpu.runtime.client import QRY_CHUNK
+    cl = parse_summary(out[1][1])
+    # ~2000 txn/s over the ~3s client lifetime, chunked sends => bound
+    # generously above budget but far below the >30k/s saturated rate
+    assert cl["sent_cnt"] <= 2000 * cl["total_runtime"] + 2 * QRY_CHUNK
