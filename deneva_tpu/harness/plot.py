@@ -25,7 +25,13 @@ def render(out_dir: str, x: str, y: str, series: str,
     header = [f"{series}\\{x}"] + [str(v) for v in xs]
     rows = [header]
     for s in sorted(table, key=str):
-        by_x = dict(table[s])
+        # duplicate x values (repeated trials in one dir) average rather
+        # than silently keeping an arbitrary one
+        acc: dict = {}
+        for xv, yv in table[s]:
+            acc.setdefault(xv, []).append(yv)
+        by_x = {xv: (sum(ys) / len(ys) if isinstance(ys[0], (int, float))
+                     else ys[-1]) for xv, ys in acc.items()}
         rows.append([str(s)] + [
             f"{by_x[v]:.1f}" if isinstance(by_x.get(v), float)
             else str(by_x.get(v, "-")) for v in xs])

@@ -83,7 +83,10 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
         # concurrent clusters would truncate each other's logs
         cfg = cfg.replace(log_dir=os.path.join(cfg.log_dir, run_id))
     if timeout_s is None:
-        timeout_s = cfg.warmup_secs + cfg.done_secs + 120
+        # generous: every node jit-compiles its epoch step before the
+        # barrier, and on a loaded box (parallel test runs) a TPCC
+        # compile alone can take minutes
+        timeout_s = cfg.warmup_secs + cfg.done_secs + 420
 
     ctx = mp.get_context("spawn")
     q: mp.Queue = ctx.Queue()

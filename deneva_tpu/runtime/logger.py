@@ -164,11 +164,19 @@ def replay_log(path: str, cfg) -> dict:
     with open(path, "rb") as f:
         buf = f.read()
     for epoch, blob, bits in unpack_records(buf):
-        _, block = wire.decode_epoch_blob(blob)
+        _, block, ts = wire.decode_epoch_blob(blob)
         active = np.unpackbits(bits)[: len(block.keys)].astype(bool)
+        # logged ts length always equals the merged block length (the
+        # server logs ts_np of exactly b_merged entries)
+        if len(ts) != len(block.keys):
+            raise ValueError(
+                f"corrupt log record at epoch {epoch}: {len(ts)} ts for "
+                f"{len(block.keys)} txns")
         query = wl.from_wire(block.keys, block.types, block.scalars)
         db, cc_state, stats, *_ = step(db, cc_state, stats,
                                        jnp.int32(epoch),
-                                       jnp.asarray(active), query)
+                                       jnp.asarray(active),
+                                       jnp.asarray(ts.astype(np.int32)),
+                                       query)
     jax.block_until_ready(stats["total_txn_commit_cnt"])
     return db

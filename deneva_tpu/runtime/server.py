@@ -65,9 +65,8 @@ def make_dist_step(cfg: Config, wl, be):
     forwarding = forwarding_applies(be, wl)
 
     @jax.jit
-    def step(db, cc_state, stats, epoch, active, query):
+    def step(db, cc_state, stats, epoch, active, ts, query):
         rank = jnp.arange(b, dtype=jnp.int32)
-        ts = epoch * jnp.int32(b) + rank
         planned = wl.plan(db, query)
         batch = AccessBatch(
             table_ids=planned["table_ids"], keys=planned["keys"],
@@ -107,12 +106,13 @@ class _RetryQueue:
     penalty (waiter-list analogue)."""
 
     def __init__(self, backoff: bool, cap: int = 64):
-        self.items: list[tuple[int, wire.QueryBlock, np.ndarray]] = []
+        self.items: list[tuple[int, wire.QueryBlock, np.ndarray,
+                               np.ndarray]] = []
         self.backoff = backoff
         self.cap = cap
 
     def push(self, block: wire.QueryBlock, abort_cnt: np.ndarray,
-             epoch: int) -> None:
+             ts: np.ndarray, epoch: int) -> None:
         if not len(block):
             return
         # clamp the exponent, not the power: 2**(cnt-1) overflows int32
@@ -124,30 +124,33 @@ class _RetryQueue:
         ready = epoch + 1 + np.where(abort_cnt > 0, pen, 0)
         for r in np.unique(ready):
             m = ready == r
-            self.items.append((int(r), block.take(np.where(m)[0]),
-                               abort_cnt[m]))
+            idx = np.where(m)[0]
+            self.items.append((int(r), block.take(idx), abort_cnt[m],
+                               ts[idx]))
 
-    def pop_ready(self, epoch: int, limit: int
-                  ) -> tuple[list[wire.QueryBlock], list[np.ndarray]]:
-        take_b, take_c, rest = [], [], []
+    def pop_ready(self, epoch: int, limit: int):
+        take_b, take_c, take_t, rest = [], [], [], []
         n = 0
         self.items.sort(key=lambda it: it[0])
-        for r, blk, cnt in self.items:
+        for r, blk, cnt, ts in self.items:
             if r <= epoch and n < limit:
                 room = limit - n
                 if len(blk) <= room:
                     take_b.append(blk)
                     take_c.append(cnt)
+                    take_t.append(ts)
                     n += len(blk)
                 else:
                     take_b.append(blk.slice(0, room))
                     take_c.append(cnt[:room])
-                    rest.append((r, blk.slice(room, len(blk)), cnt[room:]))
+                    take_t.append(ts[:room])
+                    rest.append((r, blk.slice(room, len(blk)), cnt[room:],
+                                 ts[room:]))
                     n = limit
             else:
-                rest.append((r, blk, cnt))
+                rest.append((r, blk, cnt, ts))
         self.items = rest
-        return take_b, take_c
+        return take_b, take_c, take_t
 
 
 class ServerNode:
@@ -199,7 +202,7 @@ class ServerNode:
         # new_txn_queue: FIFO of (src client id, query block)
         self.pending: deque[tuple[int, wire.QueryBlock]] = deque()
         self.retry = _RetryQueue(cfg.backoff)
-        self.blob_buf: dict[int, dict[int, wire.QueryBlock]] = {}
+        self.blob_buf: dict[int, dict] = {}
         self.stop_epoch: int | None = None
         self.measure_epoch: int | None = None
         self.stats = Stats()
@@ -216,8 +219,8 @@ class ServerNode:
             # are opaque to servers; remember src alongside
             self.pending.append((src, blk))
         elif rtype == "EPOCH_BLOB":
-            epoch, blk = wire.decode_epoch_blob(payload)
-            self.blob_buf.setdefault(epoch, {})[src] = blk
+            epoch, blk, ts = wire.decode_epoch_blob(payload)
+            self.blob_buf.setdefault(epoch, {})[src] = (blk, ts)
         elif rtype == "SHUTDOWN":
             self.stop_epoch = wire.decode_shutdown(payload)
         elif rtype == "MEASURE":
@@ -245,14 +248,20 @@ class ServerNode:
 
     # -- admission (client_thread + new_txn_queue + abort_queue) ---------
     def _contribution(self, epoch: int
-                      ) -> tuple[wire.QueryBlock, np.ndarray]:
+                      ) -> tuple[wire.QueryBlock, np.ndarray, np.ndarray]:
         """Up to b_loc txns: ready retries first, then fresh arrivals.
 
         Fresh arrivals get the home client's transport id packed into the
-        tag high bits (client << 40 | tag); retried blocks already carry
-        packed tags from their first admission, so routing survives any
-        number of restarts.  Returns (block, abort_cnt)."""
-        blocks, counts = self.retry.pop_ready(epoch, self.b_loc)
+        tag high bits (client << 40 | tag) and an epoch-anchored birth
+        timestamp ``(epoch+1)*b_merged + me*b_loc + position``: unique
+        across nodes AND monotone with epochs, so a (re)stamped txn always
+        exceeds every watermark the T/O family persisted in earlier epochs
+        — per-node counters would let a slow node starve behind a fast
+        node's watermarks.  Retried blocks keep their packed tags, and
+        keep their birth ts unless the backend wants restarts re-stamped
+        (CCBackend.fresh_ts_on_restart — WAIT_DIE preserves age, which is
+        its starvation-freedom).  Returns (block, abort_cnt, ts)."""
+        blocks, counts, tss = self.retry.pop_ready(epoch, self.b_loc)
         n = sum(len(b) for b in blocks)
         while self.pending and n < self.b_loc:
             src, blk = self.pending[0]
@@ -267,12 +276,26 @@ class ServerNode:
             blocks.append(wire.QueryBlock(use.keys, use.types, use.scalars,
                                           packed))
             counts.append(np.zeros(len(use), np.int32))
+            tss.append(np.full(len(use), -1, np.int64))   # -1 = stamp me
             n += len(use)
         if not blocks:
             blocks = [wire.QueryBlock.empty(self._width, self._n_scalars)]
             counts = [np.zeros(0, np.int32)]
+            tss = [np.zeros(0, np.int64)]
         block = wire.QueryBlock.concat(blocks)
-        return block, np.concatenate(counts)
+        ts = np.concatenate(tss)
+        base = np.int64(epoch + 1) * self.b_merged + self.me * self.b_loc
+        stamped = base + np.arange(len(ts), dtype=np.int64)
+        if len(ts) and stamped[-1] >= 2**31:
+            raise RuntimeError(
+                "birth-timestamp horizon exceeded (2^31; ~2^31/epoch_batch "
+                "epochs); restart the run — the reference's 64-bit ts has "
+                "the same finite-horizon caveat at larger scale")
+        if self.be.fresh_ts_on_restart:
+            ts = stamped                       # everyone re-stamped
+        else:
+            ts = np.where(ts < 0, stamped, ts)  # fresh stamped, retries keep
+        return block, np.concatenate(counts), ts
 
     def _durable_through(self) -> int:
         """Highest epoch that is on disk locally AND acked by every one of
@@ -315,7 +338,8 @@ class ServerNode:
             np.zeros((b, self._width), np.int8),
             np.zeros((b, self._n_scalars), np.int32))
         out = self.step(self.db, self.cc_state, self.dev_stats,
-                        jnp.int32(0), jnp.zeros(b, bool), warm_q)
+                        jnp.int32(0), jnp.zeros(b, bool),
+                        jnp.zeros(b, jnp.int32), warm_q)
         jax.block_until_ready(out[3])
         self.barrier()
         t_start = time.monotonic()
@@ -346,10 +370,10 @@ class ServerNode:
                 measured = {k: np.asarray(v) for k, v in
                             jax.device_get(self.dev_stats).items()}
                 self._t_meas = now
-            block, abort_cnt = self._contribution(epoch)
+            block, abort_cnt, birth_ts = self._contribution(epoch)
             if tl:
                 tl.mark("admit")
-            blob = wire.encode_epoch_blob(epoch, block)
+            blob = wire.encode_epoch_blob(epoch, block, birth_ts)
             for p in range(self.n_srv):
                 if p != self.me:
                     self.tp.send(p, "EPOCH_BLOB", blob)
@@ -370,6 +394,14 @@ class ServerNode:
                         if p != self.me and p not in have
                         and not self.tp.peer_alive(p)]
                 if dead:
+                    # the dead flag is set by the receiver thread, which
+                    # may have delivered the final blob between our drain
+                    # and this check — drain once more and re-verify
+                    # before declaring failure
+                    self._drain(timeout_us=50_000)
+                    have = self.blob_buf.get(epoch, {})
+                    dead = [p for p in dead if p not in have]
+                if dead and len(have) < self.n_srv - 1:
                     # failure detection (SURVEY §5.3: the reference has
                     # none — it would hang on its 1s recv timeouts forever)
                     raise RuntimeError(
@@ -383,19 +415,23 @@ class ServerNode:
             if tl:
                 tl.mark("collect")
             parts = self.blob_buf.pop(epoch, {})
-            parts[self.me] = block
+            parts[self.me] = (block, birth_ts)
             merged = wire.QueryBlock.concat(
-                [_pad_block(parts[s], self.b_loc) for s in range(self.n_srv)])
+                [_pad_block(parts[s][0], self.b_loc)
+                 for s in range(self.n_srv)])
+            ts_np = np.zeros(self.b_merged, np.int64)
             active_np = np.zeros(self.b_merged, bool)
             for s in range(self.n_srv):
-                active_np[s * self.b_loc: s * self.b_loc
-                          + len(parts[s])] = True
+                blk_s, ts_s = parts[s]
+                active_np[s * self.b_loc: s * self.b_loc + len(blk_s)] = True
+                ts_np[s * self.b_loc: s * self.b_loc + len(ts_s)] = ts_s
             query = self.wl.from_wire(merged.keys, merged.types,
                                       merged.scalars)
             t_step = time.monotonic()
             self.db, self.cc_state, self.dev_stats, commit, abort, defer = \
                 self.step(self.db, self.cc_state, self.dev_stats,
-                          jnp.int32(epoch), jnp.asarray(active_np), query)
+                          jnp.int32(epoch), jnp.asarray(active_np),
+                          jnp.asarray(ts_np.astype(np.int32)), query)
             commit = np.asarray(commit)
             self._ph["process"] += time.monotonic() - t_step
             abort = np.asarray(abort)
@@ -411,7 +447,7 @@ class ServerNode:
                 # full command stream; ship the same record to my replica
                 # (LOG_MSG, SURVEY §5.4)
                 from deneva_tpu.runtime.logger import pack_record
-                rec = wire.encode_epoch_blob(epoch, merged)
+                rec = wire.encode_epoch_blob(epoch, merged, ts_np)
                 # LOG_MSG payload = the framed record verbatim, so each
                 # replica's log file is byte-identical to the primary's
                 # by construction (one packing, two destinations)
@@ -438,7 +474,8 @@ class ServerNode:
                 idx = np.where(restart)[0]
                 # aborts bump the backoff counter; defers restart free
                 self.retry.push(block.take(idx),
-                                abort_cnt[idx] + abort[mine][idx], epoch)
+                                abort_cnt[idx] + abort[mine][idx],
+                                birth_ts[idx], epoch)
             now = time.monotonic()
             if progress and epoch % 50 == 0:
                 progress(self, epoch)

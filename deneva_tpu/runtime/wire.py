@@ -78,15 +78,28 @@ def decode_qry_block(buf: bytes) -> QueryBlock:
     return QueryBlock(keys=keys, types=types, scalars=scalars, tags=tags)
 
 
-# ---- EPOCH_BLOB: header(epoch) + query block --------------------------
+# ---- EPOCH_BLOB: header(epoch) + birth timestamps + query block --------
+# Birth ts ride the blob explicitly so every node's merged batch carries
+# identical ages: WAIT_DIE's wound-wait rule needs timestamps preserved
+# across restarts (reference keeps them, `worker_thread.cpp:492-508`),
+# which epoch-derived ts cannot do.
 
-def encode_epoch_blob(epoch: int, b: QueryBlock) -> bytes:
-    return _HDR.pack(epoch) + encode_qry_block(b)
+_TS_HDR = struct.Struct("<qI")      # epoch, n
 
 
-def decode_epoch_blob(buf: bytes) -> tuple[int, QueryBlock]:
-    (epoch,) = _HDR.unpack_from(buf)
-    return epoch, decode_qry_block(buf[_HDR.size:])
+def encode_epoch_blob(epoch: int, b: QueryBlock,
+                      ts: np.ndarray | None = None) -> bytes:
+    if ts is None:
+        ts = np.zeros(len(b), np.int64)
+    ts = np.ascontiguousarray(ts, np.int64)
+    return _TS_HDR.pack(epoch, len(ts)) + ts.tobytes() \
+        + encode_qry_block(b)
+
+
+def decode_epoch_blob(buf: bytes) -> tuple[int, QueryBlock, np.ndarray]:
+    epoch, n = _TS_HDR.unpack_from(buf)
+    ts = np.frombuffer(buf, np.int64, count=n, offset=_TS_HDR.size)
+    return epoch, decode_qry_block(buf[_TS_HDR.size + 8 * n:]), ts
 
 
 # ---- CL_RSP: tags + commit latency echo --------------------------------
