@@ -24,6 +24,7 @@ deterministic dataflow equal to serial execution in sequence order.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from dataclasses import dataclass
 from typing import Any
@@ -37,6 +38,19 @@ from deneva_tpu.engine.pool import PoolState, TxnPool
 from deneva_tpu.ops import forward_verdict, forwarding_applies
 
 LAT_BUCKETS = 64
+
+
+def forced_sentinel_mask(batch):
+    """YCSB_ABORT_MODE (reference `config.h:103`, `ycsb_txn.cpp:243-246`):
+    a sentinel condition forces a logical abort, exercising the abort
+    accounting deterministically.  Batch analogue: a txn whose RW-set
+    touches key 0 logically aborts — ONCE: it releases its slot like a
+    completed txn (a logical abort is a final answer, not a retry; an
+    ever-firing sentinel would otherwise fill the pool with immortal
+    txns).  Under the forwarding executor the forced txns are removed
+    from the batch BEFORE dependency resolution, so no reader ever
+    observes an aborted txn's write."""
+    return ((batch.keys == 0) & batch.valid).any(axis=1) & batch.active
 
 
 @dataclass
@@ -116,47 +130,62 @@ class Engine:
         # 4. validate
         forwarding = forwarding_applies(be, wl) and cfg.mode == Mode.NORMAL
         fwd = None
+        forced = forced_sentinel_mask(batch) if cfg.ycsb_abort_mode else None
         if cfg.mode == Mode.NOCC:
             nocc = get_backend("NOCC")
             verdict, cc_state = nocc.validate(cfg, state.cc_state, batch, None)
         elif forwarding:
             # single-pass forwarding executor (ops/forward): everything
-            # commits in rank order; the sort IS the validation
-            verdict, fwd = forward_verdict(batch)
+            # commits in rank order; the sort IS the validation.  Forced
+            # sentinel txns leave the batch before dependency resolution
+            # so their (never-applied) writes are invisible to readers.
+            fbatch = batch if forced is None else dataclasses.replace(
+                batch, active=batch.active & ~forced)
+            verdict, fwd = forward_verdict(fbatch)
             cc_state = state.cc_state
         else:
             inc = build_incidence(batch, cfg.conflict_buckets,
                                   cfg.conflict_exact) if be.needs_incidence else None
             verdict, cc_state = be.validate(cfg, state.cc_state, batch, inc)
+        # a forced txn completes-as-aborted only when the CC would not
+        # retry it anyway (CC aborts/defers follow their normal path)
+        if forced is not None:
+            forced = forced & ~(verdict.abort | verdict.defer)
+        exec_commit = verdict.commit if forced is None \
+            else verdict.commit & ~forced
+        # released slots: real commits + forced completions
+        release = verdict.commit if forced is None \
+            else verdict.commit | forced
 
         # 5. execute committed txns
         db = state.db
         if cfg.mode in (Mode.NORMAL, Mode.NOCC):
             if forwarding:
-                db = wl.execute(db, queries, verdict.commit, verdict.order,
+                db = wl.execute(db, queries, exec_commit, verdict.order,
                                 stats, fwd_rank=fwd)
             elif be.chained and cfg.mode == Mode.NORMAL:
                 for lvl in range(cfg.exec_subrounds):
-                    m = verdict.commit & (verdict.level == lvl)
+                    m = exec_commit & (verdict.level == lvl)
                     db = wl.execute(db, queries, m, verdict.order, stats)
             else:
-                db = wl.execute(db, queries, verdict.commit, verdict.order,
+                db = wl.execute(db, queries, exec_commit, verdict.order,
                                 stats)
         # Mode.SIMPLE / QRY_ONLY: ack without touching tables
         # (reference SIMPLE_MODE / QRY_ONLY_MODE, config.h:276-281)
 
-        # 6. update pool + counters
-        pool = self.pool.update(pool, slots, active, verdict.commit,
+        # 6. update pool + counters (forced txns release like commits)
+        pool = self.pool.update(pool, slots, active, release,
                                 verdict.abort, state.epoch,
                                 be.fresh_ts_on_restart)
-        ncommit = (verdict.commit & active).sum(dtype=jnp.uint32)
+        ncommit = (exec_commit & active).sum(dtype=jnp.uint32)
         stats["total_txn_commit_cnt"] += ncommit
-        stats["total_txn_abort_cnt"] += (verdict.abort & active).sum(dtype=jnp.uint32)
+        aborts = verdict.abort if forced is None else verdict.abort | forced
+        stats["total_txn_abort_cnt"] += (aborts & active).sum(dtype=jnp.uint32)
         stats["defer_cnt"] += (verdict.defer & active).sum(dtype=jnp.uint32)
         lat = state.epoch - jnp.take(pool.entry_epoch, slots)
         lat = jnp.clip(lat, 0, LAT_BUCKETS - 1)
         hist = stats["latency_hist"].at[lat].add(
-            (verdict.commit & active).astype(jnp.uint32))
+            (exec_commit & active).astype(jnp.uint32))
         stats["latency_hist"] = hist
 
         return EngineState(db=db, cc_state=cc_state, pool=pool, rng=rng,

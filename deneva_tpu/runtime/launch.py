@@ -21,7 +21,11 @@ import os
 import sys
 import traceback
 
+import itertools
+
 from deneva_tpu.config import Config
+
+_tcp_seq = itertools.count()
 
 
 def _server_main(cfg: Config, endpoints: str, platform: str | None, q) -> None:
@@ -76,8 +80,18 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
             "adapters (to_wire/from_wire) or partitioned loader")
     n_srv, n_cl = cfg.node_cnt, cfg.client_node_cnt
     n_repl = cfg.replica_cnt * n_srv
+    n_all = n_srv + n_cl + n_repl
     run_id = run_id or f"{os.getpid()}_{abs(hash(cfg)) % 99999}"
-    endpoints = ipc_endpoints(n_srv + n_cl + n_repl, run_id)
+    if cfg.tport_type == "tcp":
+        # loopback TCP (the reference's cluster mode, TPORT_TYPE TCP,
+        # config.h:335).  Ports stay below Linux's ephemeral range
+        # (default starts at 32768) and vary by pid + a per-process
+        # counter so concurrent launches (even same-process) coexist
+        from deneva_tpu.runtime.native import tcp_endpoints
+        base = 10000 + (os.getpid() * 131 + next(_tcp_seq) * 997) % 22000
+        endpoints = tcp_endpoints(n_all, base_port=base)
+    else:
+        endpoints = ipc_endpoints(n_all, run_id)
     if cfg.logging:
         # namespace log files per run like the IPC endpoints, or two
         # concurrent clusters would truncate each other's logs

@@ -66,36 +66,53 @@ def make_dist_step(cfg: Config, wl, be):
 
     @jax.jit
     def step(db, cc_state, stats, epoch, active, ts, query):
+        import dataclasses as _dc
+
+        from deneva_tpu.engine.step import forced_sentinel_mask
+
         rank = jnp.arange(b, dtype=jnp.int32)
         planned = wl.plan(db, query)
         batch = AccessBatch(
             table_ids=planned["table_ids"], keys=planned["keys"],
             is_read=planned["is_read"], is_write=planned["is_write"],
             valid=planned["valid"], ts=ts, rank=rank, active=active)
+        forced = forced_sentinel_mask(batch) if cfg.ycsb_abort_mode else None
         if forwarding:
-            verdict, fwd = forward_verdict(batch)
-            db = wl.execute(db, query, verdict.commit, verdict.order, stats,
+            fbatch = batch if forced is None else _dc.replace(
+                batch, active=batch.active & ~forced)
+            verdict, fwd = forward_verdict(fbatch)
+            exec_commit = verdict.commit
+            db = wl.execute(db, query, exec_commit, verdict.order, stats,
                             fwd_rank=fwd)
         else:
             inc = build_incidence(
                 batch, cfg.conflict_buckets,
                 cfg.conflict_exact) if be.needs_incidence else None
             verdict, cc_state = be.validate(cfg, cc_state, batch, inc)
+            if forced is not None:
+                forced = forced & ~(verdict.abort | verdict.defer)
+            exec_commit = verdict.commit if forced is None \
+                else verdict.commit & ~forced
             if be.chained:
                 for lvl in range(cfg.exec_subrounds):
-                    m = verdict.commit & (verdict.level == lvl)
+                    m = exec_commit & (verdict.level == lvl)
                     db = wl.execute(db, query, m, verdict.order, stats)
             else:
-                db = wl.execute(db, query, verdict.commit, verdict.order,
+                db = wl.execute(db, query, exec_commit, verdict.order,
                                 stats)
-        commit = verdict.commit & active
+        # forced txns complete (acked + released by the caller via the
+        # commit mask) but count as aborts, exactly like the engine
+        commit = exec_commit & active
+        done = commit if forced is None else (commit | (forced & active))
         abort = verdict.abort & active
+        if forced is not None:
+            abort = abort | (forced & active)
         defer = verdict.defer & active
         stats = dict(stats)
         stats["total_txn_commit_cnt"] += commit.sum(dtype=jnp.uint32)
         stats["total_txn_abort_cnt"] += abort.sum(dtype=jnp.uint32)
         stats["defer_cnt"] += defer.sum(dtype=jnp.uint32)
-        return db, cc_state, stats, commit, abort, defer
+        return db, cc_state, stats, done, abort & ~done, defer
 
     return step
 

@@ -215,3 +215,15 @@ def test_wait_die_cluster_commits_agree():
     assert s0["total_txn_commit_cnt"] == s1["total_txn_commit_cnt"] > 0
     # WAIT_DIE under contention must actually wait (defer) and/or die
     assert s0["defer_cnt"] + s0["total_txn_abort_cnt"] > 0
+
+
+@pytest.mark.slow
+def test_cluster_tcp_transport():
+    """TCP transport mode (reference TPORT_TYPE TCP, config.h:335):
+    same cluster protocol over loopback TCP sockets."""
+    cfg = small_cfg(node_cnt=2, client_node_cnt=1, tport_type="tcp")
+    out = boot(cfg)
+    s0 = parse_summary(out[0][1])
+    s1 = parse_summary(out[1][1])
+    assert s0["total_txn_commit_cnt"] == s1["total_txn_commit_cnt"] > 0
+    assert parse_summary(out[2][1])["txn_cnt"] > 0
