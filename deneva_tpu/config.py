@@ -210,6 +210,10 @@ class Config:
                    "max_accesses must cover req_per_query")
             _check(abs(self.read_perc + self.write_perc - 1.0) < 1e-6,
                    "read_perc + write_perc must sum to 1")
+        else:
+            _check(not self.ycsb_abort_mode,
+                   "ycsb_abort_mode is YCSB-only (the sentinel key would "
+                   "force-abort hot TPCC/PPS rows)")
         if self.workload == WorkloadKind.TPCC:
             _check(self.max_accesses >= 3 + self.max_items_per_txn,
                    "TPCC max_accesses must cover wh+dist+cust+items "

@@ -86,7 +86,12 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
         # loopback TCP (the reference's cluster mode, TPORT_TYPE TCP,
         # config.h:335).  Ports stay below Linux's ephemeral range
         # (default starts at 32768) and vary by pid + a per-process
-        # counter so concurrent launches (even same-process) coexist
+        # counter so concurrent launches (even same-process) coexist.
+        # Best-effort only: no bind-availability probe — a range clash
+        # with a resident service fails the cluster at dt_start (the
+        # reference's static ifconfig.txt has the same property); rerun
+        # or set tport_port explicitly.  IPC mode is the collision-free
+        # default for single-box rigs.
         from deneva_tpu.runtime.native import tcp_endpoints
         base = 10000 + (os.getpid() * 131 + next(_tcp_seq) * 997) % 22000
         endpoints = tcp_endpoints(n_all, base_port=base)

@@ -57,7 +57,10 @@ def make_dist_step(cfg: Config, wl, be):
     import jax
     import jax.numpy as jnp
 
+    import dataclasses as _dc
+
     from deneva_tpu.cc import AccessBatch, build_incidence
+    from deneva_tpu.engine.step import forced_sentinel_mask
     from deneva_tpu.ops import forward_verdict, forwarding_applies
 
     # merged batch = equal slices per server; epoch_batch is the budget
@@ -66,10 +69,6 @@ def make_dist_step(cfg: Config, wl, be):
 
     @jax.jit
     def step(db, cc_state, stats, epoch, active, ts, query):
-        import dataclasses as _dc
-
-        from deneva_tpu.engine.step import forced_sentinel_mask
-
         rank = jnp.arange(b, dtype=jnp.int32)
         planned = wl.plan(db, query)
         batch = AccessBatch(
@@ -81,6 +80,12 @@ def make_dist_step(cfg: Config, wl, be):
             fbatch = batch if forced is None else _dc.replace(
                 batch, active=batch.active & ~forced)
             verdict, fwd = forward_verdict(fbatch)
+            # forward_verdict never aborts/defers, so the CC-retry filter
+            # below is a no-op here — applied anyway to keep the forced
+            # semantics identical to Engine.step (and future-proof against
+            # forwarding backends that defer)
+            if forced is not None:
+                forced = forced & ~(verdict.abort | verdict.defer)
             exec_commit = verdict.commit
             db = wl.execute(db, query, exec_commit, verdict.order, stats,
                             fwd_rank=fwd)

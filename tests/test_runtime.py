@@ -227,3 +227,19 @@ def test_cluster_tcp_transport():
     s1 = parse_summary(out[1][1])
     assert s0["total_txn_commit_cnt"] == s1["total_txn_commit_cnt"] > 0
     assert parse_summary(out[2][1])["txn_cnt"] > 0
+
+
+@pytest.mark.slow
+def test_cluster_abort_mode_forces_and_completes():
+    """YCSB_ABORT_MODE in the distributed runtime: forced aborts are
+    counted identically on every server, forced txns complete (client
+    gets acked, no immortal retries) and commits keep flowing."""
+    cfg = small_cfg(node_cnt=2, client_node_cnt=1, cc_alg=CCAlg.TPU_BATCH,
+                    ycsb_abort_mode=True, zipf_theta=0.9,
+                    synth_table_size=8192)
+    out = boot(cfg)
+    s0 = parse_summary(out[0][1])
+    s1 = parse_summary(out[1][1])
+    assert s0["total_txn_abort_cnt"] == s1["total_txn_abort_cnt"] > 0
+    assert s0["total_txn_commit_cnt"] == s1["total_txn_commit_cnt"] > 0
+    assert parse_summary(out[2][1])["txn_cnt"] > 0
