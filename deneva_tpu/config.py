@@ -98,7 +98,15 @@ class Config:
     req_per_query: int = 10
     zipf_theta: float = 0.6
     read_perc: float = 0.5
-    write_perc: float = 0.5
+    write_perc: float = 0.5        # per-tuple write prob (TUP_WRITE_PERC)
+    txn_write_perc: float = 1.0    # P(txn may write at all); with prob 1-p the
+    #                                whole txn is read-only (TXN_WRITE_PERC,
+    #                                ycsb_query.cpp:313,331: r_twr drawn once per txn)
+    skew_method: str = "ZIPF"      # ZIPF | HOT (config.h:162-167)
+    data_perc: int = 100           # HOT: hot-set size in KEYS (g_data_perc is cast
+    #                                to an absolute key count, ycsb_query.cpp:218)
+    access_perc: float = 0.03      # HOT: fraction of accesses hitting the hot set
+    key_order: bool = False        # sort request keys ascending (KEY_ORDER config.h:106)
     tup_size: int = 100            # bytes per field payload (SIM_FULL_ROW analogue)
     field_per_tuple: int = 10
     first_part_local: bool = True
@@ -210,6 +218,16 @@ class Config:
                    "max_accesses must cover req_per_query")
             _check(abs(self.read_perc + self.write_perc - 1.0) < 1e-6,
                    "read_perc + write_perc must sum to 1")
+            _check(self.skew_method in ("ZIPF", "HOT"),
+                   f"bad skew_method {self.skew_method!r}")
+            _check(0.0 <= self.txn_write_perc <= 1.0,
+                   "txn_write_perc must be in [0, 1]")
+            if self.skew_method == "HOT":
+                _check(1 <= self.data_perc < self.synth_table_size,
+                       "HOT skew: data_perc (hot-set key count) must be in "
+                       "[1, synth_table_size)")
+                _check(0.0 <= self.access_perc <= 1.0,
+                       "access_perc must be in [0, 1]")
         else:
             _check(not self.ycsb_abort_mode,
                    "ycsb_abort_mode is YCSB-only (the sentinel key would "

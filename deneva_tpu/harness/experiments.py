@@ -65,6 +65,15 @@ def ycsb_skew(quick: bool) -> list[Config]:
     return [c for t in thetas for c in _alg_sweep(base.replace(zipf_theta=t))]
 
 
+def ycsb_hot(quick: bool) -> list[Config]:
+    """HOT skew sweep (SKEW_METHOD HOT, `config.h:162-167`): ACCESS_PERC of
+    accesses hit a DATA_PERC-key hot set — the reference's alternative
+    contention dial to zipf theta."""
+    base = paper_base(quick).replace(skew_method="HOT", data_perc=100)
+    aps = (0.03, 0.5) if quick else (0.01, 0.03, 0.1, 0.5, 0.9)
+    return [c for a in aps for c in _alg_sweep(base.replace(access_perc=a))]
+
+
 def ycsb_writes(quick: bool) -> list[Config]:
     """Write-fraction sweep (paper fig: update rate)."""
     base = paper_base(quick).replace(zipf_theta=0.6)
@@ -157,6 +166,7 @@ def modes(quick: bool) -> list[Config]:
 experiment_map: dict[str, Callable[[bool], list[Config]]] = {
     "ycsb_scaling": ycsb_scaling,
     "ycsb_skew": ycsb_skew,
+    "ycsb_hot": ycsb_hot,
     "ycsb_writes": ycsb_writes,
     "ycsb_partitions": ycsb_partitions,
     "ycsb_inflight": ycsb_inflight,

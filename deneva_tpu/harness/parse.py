@@ -22,7 +22,8 @@ from deneva_tpu.stats import parse_summary
 SHORTNAMES = {
     "workload": "WL", "cc_alg": "CC", "mode": "MODE",
     "node_cnt": "N", "part_cnt": "P", "zipf_theta": "SKEW",
-    "write_perc": "WR", "part_per_txn": "PPT",
+    "write_perc": "WR", "txn_write_perc": "TWR", "part_per_txn": "PPT",
+    "access_perc": "A", "data_perc": "D", "skew_method": "SK",
     "max_txn_in_flight": "TIF", "num_wh": "WH",
     "perc_payment": "PAY", "isolation_level": "ISO",
     "epoch_batch": "EB", "load_rate": "LR",

@@ -7,7 +7,7 @@ managers (`concurrency_control/*`, dispatched from `storage/row.cpp:197-310`).
 """
 
 from deneva_tpu.ops.hashing import bucket_hash, combine_key  # noqa: F401
-from deneva_tpu.ops.sampling import Zipfian, uniform_keys  # noqa: F401
+from deneva_tpu.ops.sampling import HotSet, Zipfian, uniform_keys  # noqa: F401
 from deneva_tpu.ops.scatter import last_writer  # noqa: F401
 from deneva_tpu.ops.forward import (forward_verdict,  # noqa: F401
                                     forwarding_applies, last_earlier_writer)

@@ -66,6 +66,27 @@ class Zipfian:
         return jnp.clip(v, 0, self.n - 1)
 
 
+@dataclass(frozen=True)
+class HotSet:
+    """HOT skew sampler (reference `ycsb_query.cpp:205-260`, config.h:162-167):
+    ``access_perc`` of accesses hit the first ``hot_max`` keys uniformly; the
+    rest hit ``[hot_max, n)`` uniformly.  ``g_data_perc`` is an absolute key
+    count despite the name (`ycsb_query.cpp:218` casts it straight to
+    ``hot_key_max``)."""
+
+    n: int
+    hot_max: int
+    access_perc: float
+
+    def sample(self, key: jax.Array, shape: tuple) -> jax.Array:
+        k1, k2, k3 = jax.random.split(key, 3)
+        is_hot = jax.random.bernoulli(k1, self.access_perc, shape)
+        hot = jax.random.randint(k2, shape, 0, self.hot_max, dtype=jnp.int32)
+        cold = jax.random.randint(k3, shape, self.hot_max, self.n,
+                                  dtype=jnp.int32)
+        return jnp.where(is_hot, hot, cold)
+
+
 def uniform_keys(key: jax.Array, shape: tuple, n: int) -> jax.Array:
     """Uniform int32 keys in [0, n)."""
     return jax.random.randint(key, shape, 0, n, dtype=jnp.int32)

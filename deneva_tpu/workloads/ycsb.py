@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deneva_tpu.config import Config
-from deneva_tpu.ops import Zipfian, last_writer
+from deneva_tpu.ops import HotSet, Zipfian, last_writer
 from deneva_tpu.storage.catalog import parse_schema
 from deneva_tpu.storage.index import DenseIndex
 from deneva_tpu.storage.table import DeviceTable
@@ -85,7 +85,13 @@ class YCSBWorkload:
             self.n_local = self.n_rows
             self.index = DenseIndex(base=0, stride=1, size=self.n_rows,
                                     miss_slot=self.n_rows)
-        self.zipf = Zipfian(self.n_rows, cfg.zipf_theta)
+        # key sampler: Gray zipfian or HOT two-tier uniform
+        # (SKEW_METHOD, config.h:162-167)
+        if cfg.skew_method == "HOT":
+            self.zipf = HotSet(self.n_rows, int(cfg.data_perc),
+                               cfg.access_perc)
+        else:
+            self.zipf = Zipfian(self.n_rows, cfg.zipf_theta)
         self.n_req = cfg.req_per_query
 
     # -- loader (ycsb_wl.cpp:125-203) ----------------------------------
@@ -106,10 +112,21 @@ class YCSBWorkload:
 
     # -- query generation (ycsb_query.cpp:303-376) ---------------------
     def generate(self, rng: jax.Array, n: int) -> YCSBQuery:
-        k1, k2 = jax.random.split(rng)
+        k1, k2, k3 = jax.random.split(rng, 3)
         keys = self.zipf.sample(k1, (n, self.n_req))
+        if self.cfg.key_order:
+            # KEY_ORDER (config.h:106): requests sorted ascending by key.
+            # acctype is iid per slot so sorting keys alone is
+            # distribution-identical to the reference's paired sort.
+            keys = jnp.sort(keys, axis=1)
         is_write = jax.random.bernoulli(k2, self.cfg.write_perc,
                                         (n, self.n_req))
+        if self.cfg.txn_write_perc < 1.0:
+            # TXN_WRITE_PERC: one per-txn draw gates all writes — with prob
+            # 1-p the whole txn is read-only (ycsb_query.cpp:313,331)
+            may_write = jax.random.bernoulli(
+                k3, self.cfg.txn_write_perc, (n, 1))
+            is_write = is_write & may_write
         return YCSBQuery(keys=keys, is_write=is_write)
 
     # -- wire adapters (distributed runtime, CL_QRY/EPOCH_BLOB bodies) --

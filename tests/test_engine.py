@@ -122,6 +122,30 @@ def test_forwarding_executor_equals_serial_execution():
     assert (got_f0 == f0).all()
 
 
+def test_ycsb_hot_skew_and_txn_read_only():
+    """HOT skew method + TXN_WRITE_PERC + KEY_ORDER generator parity
+    (reference ycsb_query.cpp:205-260, config.h:106,162-171)."""
+    from deneva_tpu.workloads.ycsb import YCSBWorkload
+
+    cfg = small_cfg(synth_table_size=4096, req_per_query=4, max_accesses=4,
+                    skew_method="HOT", data_perc=16, access_perc=0.5,
+                    txn_write_perc=0.25, key_order=True)
+    wl = YCSBWorkload(cfg)
+    q = wl.generate(jax.random.PRNGKey(7), 2048)
+    keys = np.asarray(q.keys)
+    is_w = np.asarray(q.is_write)
+    # ~half the accesses land on the 16-key hot set
+    assert abs((keys < 16).mean() - 0.5) < 0.05
+    # KEY_ORDER: ascending within each txn
+    assert (np.diff(keys, axis=1) >= 0).all()
+    # ~75% of txns are entirely read-only; write rows still mix per tuple
+    ro_frac = (~is_w.any(axis=1)).mean()
+    assert 0.65 < ro_frac < 0.85
+    # HOT mode runs end-to-end through the engine
+    stats, _ = run_epochs(cfg, n=10)
+    assert int(stats["total_txn_commit_cnt"]) > 0
+
+
 def test_ycsb_abort_mode_forces_deterministic_aborts():
     """YCSB_ABORT_MODE (reference config.h:103): sentinel key 0 forces
     logical aborts, exercising abort/backoff deterministically even for

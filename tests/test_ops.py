@@ -46,6 +46,17 @@ def test_zipfian_skew():
     assert (s == 0).mean() > 0.015
 
 
+def test_hotset_two_tier_split():
+    from deneva_tpu.ops import HotSet
+    h = HotSet(n=1 << 20, hot_max=100, access_perc=0.3)
+    s = np.asarray(h.sample(jax.random.PRNGKey(2), (40000,)))
+    assert s.min() >= 0 and s.max() < (1 << 20)
+    hot_frac = (s < 100).mean()
+    assert abs(hot_frac - 0.3) < 0.02          # ACCESS_PERC of accesses...
+    hot = s[s < 100]
+    assert np.bincount(hot, minlength=100).min() > 0  # ...uniform over DATA_PERC keys
+
+
 def test_last_writer_oracle():
     rng = np.random.default_rng(0)
     n, cap = 256, 32
