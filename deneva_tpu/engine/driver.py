@@ -46,6 +46,21 @@ def run_simulation(cfg: Config, chunk: int = 50,
     jax.block_until_ready(state.stats["total_txn_commit_cnt"])
 
     ckpt_due = [cfg.checkpoint_every_epochs]
+    run_t0 = time.monotonic()
+    prog_next = [run_t0 + cfg.prog_timer_secs]
+    epochs_total = [0]      # cumulative across warmup+measure windows
+
+    def prog_tick(state):
+        # [prog] line every prog_timer_secs (reference PROG_TIMER,
+        # system/thread.cpp:86-105)
+        now = time.monotonic()
+        if quiet or cfg.prog_timer_secs <= 0 or now < prog_next[0]:
+            return
+        prog_next[0] = now + cfg.prog_timer_secs
+        from deneva_tpu.stats import make_prog_line
+        print(make_prog_line(now - run_t0, _counters(state),
+                             {"epoch_cnt": float(epochs_total[0])}),
+              flush=True)
 
     def run_window(state, secs):
         t0 = time.monotonic()
@@ -54,6 +69,8 @@ def run_simulation(cfg: Config, chunk: int = 50,
             state = eng.jit_run(state, chunk)
             jax.block_until_ready(state.stats["total_txn_commit_cnt"])
             epochs += chunk
+            epochs_total[0] += chunk
+            prog_tick(state)
             if cfg.checkpoint_path and cfg.checkpoint_every_epochs:
                 ckpt_due[0] -= chunk
                 if ckpt_due[0] <= 0:

@@ -1,0 +1,92 @@
+"""Timeline log analysis (reference `scripts/timeline.py`).
+
+The reference parses DEBUG_TIMELINE event prints (START/ABORT/LOCK/
+UNLOCK/COMMIT, `timeline.py:29-31`) into per-txn scatter plots.  Here the
+equivalent trace is the ``[timeline]`` per-epoch phase line emitted by
+servers under ``--debug_timeline=true`` (`deneva_tpu.runtime.server`):
+
+    [timeline] node=0 epoch=412 loop=0.3ms validate=1.2ms respond=0.1ms
+
+This CLI aggregates those lines into a per-node × per-phase table
+(total / mean / p95 milliseconds) — the where-does-the-epoch-go view the
+reference builds its timeline plots for.
+
+    python -m deneva_tpu.harness.timeline run.log [--node N] [--tsv]
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+import numpy as np
+
+_LINE = re.compile(r"\[timeline\] node=(\d+) epoch=(\d+) (.*)")
+_SPAN = re.compile(r"(\w+)=([0-9.]+)ms")
+
+
+def parse_timeline(lines) -> list[dict]:
+    """[{node, epoch, phases: {name: ms}}] from raw log lines."""
+    out = []
+    for line in lines:
+        m = _LINE.search(line)
+        if not m:
+            continue
+        phases = {k: float(v) for k, v in _SPAN.findall(m.group(3))}
+        out.append({"node": int(m.group(1)), "epoch": int(m.group(2)),
+                    "phases": phases})
+    return out
+
+
+def phase_table(rows: list[dict], node: int | None = None) -> list[list[str]]:
+    """Aligned rows: node, phase, epochs, total_ms, mean_ms, p95_ms, share."""
+    acc: dict[tuple[int, str], list[float]] = {}
+    for r in rows:
+        if node is not None and r["node"] != node:
+            continue
+        for name, ms in r["phases"].items():
+            acc.setdefault((r["node"], name), []).append(ms)
+    per_node_total = {}
+    for (n, _), vals in acc.items():
+        per_node_total[n] = per_node_total.get(n, 0.0) + sum(vals)
+    table = [["node", "phase", "epochs", "total_ms", "mean_ms", "p95_ms",
+              "share"]]
+    for (n, name), vals in sorted(acc.items()):
+        v = np.asarray(vals)
+        tot = float(v.sum())
+        table.append([str(n), name, str(len(v)), f"{tot:.1f}",
+                      f"{v.mean():.3f}", f"{np.percentile(v, 95):.3f}",
+                      f"{tot / max(per_node_total[n], 1e-12):.1%}"])
+    return table
+
+
+def render(table: list[list[str]], tsv: bool = False) -> str:
+    if len(table) <= 1:
+        return "(no [timeline] lines found — run with --debug_timeline=true)"
+    if tsv:
+        return "\n".join("\t".join(r) for r in table)
+    widths = [max(len(r[i]) for r in table) for i in range(len(table[0]))]
+    return "\n".join("  ".join(c.rjust(w) for c, w in zip(r, widths))
+                     for r in table)
+
+
+def main(argv: list[str]) -> int:
+    if not argv or argv[0].startswith("-"):
+        print("usage: python -m deneva_tpu.harness.timeline <log-file> "
+              "[--node N] [--tsv]", file=sys.stderr)
+        return 2
+    node = None
+    if "--node" in argv:
+        i = argv.index("--node")
+        if i + 1 >= len(argv):
+            print("--node needs a value", file=sys.stderr)
+            return 2
+        node = int(argv[i + 1])
+    with open(argv[0]) as f:
+        rows = parse_timeline(f)
+    print(render(phase_table(rows, node), tsv="--tsv" in argv))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

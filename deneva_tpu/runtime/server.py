@@ -365,6 +365,7 @@ class ServerNode:
         jax.block_until_ready(out[3])
         self.barrier()
         t_start = time.monotonic()
+        prog_next = t_start + cfg.prog_timer_secs
         warm_edge = t_start + cfg.warmup_secs
         measured = None     # counter snapshot at measure start
         epoch = 0
@@ -501,6 +502,18 @@ class ServerNode:
             now = time.monotonic()
             if progress and epoch % 50 == 0:
                 progress(self, epoch)
+            if cfg.prog_timer_secs > 0 and now >= prog_next \
+                    and epoch % 10 == 0:
+                # [prog] tick (reference PROG_TIMER, system/thread.cpp:86-105);
+                # device_get only on the tick, never in the steady loop
+                prog_next = now + cfg.prog_timer_secs
+                from deneva_tpu.stats import make_prog_line
+                c = {k: float(np.asarray(v))
+                     for k, v in jax.device_get(self.dev_stats).items()
+                     if k in ("total_txn_commit_cnt", "total_txn_abort_cnt")}
+                print(f"node {self.me} " + make_prog_line(
+                    now - t_start, c, {"epoch_cnt": float(epoch)}),
+                    flush=True)
             if self.me == 0 and self.stop_epoch is None \
                     and self.measure_epoch is not None \
                     and now >= warm_edge + cfg.done_secs:

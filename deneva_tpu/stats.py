@@ -17,6 +17,7 @@ total_txn_abort_cnt, unique_txn_abort_cnt`` (`statistics/stats.h:44-289`).
 
 from __future__ import annotations
 
+import os
 import time
 from collections import defaultdict
 from typing import Iterable
@@ -162,6 +163,52 @@ class Stats:
         ordered += sorted((k, v) for k, v in fields.items() if k not in head)
         body = ",".join(f"{k}={_fmt(v)}" for k, v in ordered)
         return f"[summary] {body}"
+
+
+    def prog_line(self, extra: dict[str, float] | None = None) -> str:
+        """Reference ``[prog]`` progress tick (`system/thread.cpp:86-105`
+        prints running stats every PROG_TIMER; `statistics/stats.h:311-316`
+        appends process mem/cpu utilization from /proc/self)."""
+        f = self.summary_fields()
+        f.update(proc_utilization())
+        keys = ("total_runtime", "tput", "txn_cnt", "total_txn_commit_cnt",
+                "total_txn_abort_cnt", "mem_util", "cpu_util")
+        body = ",".join(f"{k}={_fmt(f.get(k, 0.0))}" for k in keys)
+        tail = ",".join(f"{k}={_fmt(v)}" for k, v in (extra or {}).items()
+                        if k not in keys)
+        return f"[prog] {body}" + (f",{tail}" if tail else "")
+
+
+def make_prog_line(runtime: float, counters: dict,
+                   extra: dict[str, float] | None = None) -> str:
+    """Shared [prog] emitter for the in-process driver and cluster servers:
+    one format, one call site per consumer."""
+    ps = Stats()
+    ps.set("total_runtime", runtime)
+    for k in ("total_txn_commit_cnt", "total_txn_abort_cnt"):
+        ps.set(k, float(counters.get(k, 0.0)))
+    return ps.prog_line(extra)
+
+
+def proc_utilization() -> dict[str, float]:
+    """{mem_util: RSS MiB, cpu_util: process CPU seconds} from /proc/self
+    (reference `statistics/stats.h:311-316` reads VmRSS the same way)."""
+    out = {"mem_util": 0.0, "cpu_util": 0.0}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["mem_util"] = float(line.split()[1]) / 1024.0
+                    break
+        with open("/proc/self/stat") as f:
+            stat = f.read()
+        # comm (field 2) may contain spaces; fields restart after last ')'
+        parts = stat[stat.rindex(")") + 2:].split()
+        tick = os.sysconf("SC_CLK_TCK")
+        out["cpu_util"] = (int(parts[11]) + int(parts[12])) / tick
+    except (OSError, IndexError, ValueError):
+        pass  # non-Linux / restricted proc: report zeros
+    return out
 
 
 def _fmt(v: float) -> str:

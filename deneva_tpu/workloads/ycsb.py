@@ -30,7 +30,7 @@ import numpy as np
 from deneva_tpu.config import Config
 from deneva_tpu.ops import HotSet, Zipfian, last_writer
 from deneva_tpu.storage.catalog import parse_schema
-from deneva_tpu.storage.index import DenseIndex
+from deneva_tpu.storage.index import DenseIndex, SortedIndex
 from deneva_tpu.storage.table import DeviceTable
 
 # benchmarks/YCSB_schema.txt: MAIN_TABLE, 10 x 100-byte string fields
@@ -85,6 +85,16 @@ class YCSBWorkload:
             self.n_local = self.n_rows
             self.index = DenseIndex(base=0, stride=1, size=self.n_rows,
                                     miss_slot=self.n_rows)
+        if cfg.index_struct == "IDX_BTREE":
+            # INDEX_STRUCT=IDX_BTREE (global.h:320-324): probe an ordered
+            # index (binary-search ladder) instead of the affine perfect
+            # hash that dense YCSB keys otherwise admit.  Same key->slot
+            # map, so results are identical; this exercises the
+            # `index_btree` analogue on the primary path.
+            self.index = SortedIndex.build(
+                self._owned_keys(),
+                np.arange(self.n_local, dtype=np.int32),
+                miss_slot=self.n_local)
         # key sampler: Gray zipfian or HOT two-tier uniform
         # (SKEW_METHOD, config.h:162-167)
         if cfg.skew_method == "HOT":
@@ -94,14 +104,20 @@ class YCSBWorkload:
             self.zipf = Zipfian(self.n_rows, cfg.zipf_theta)
         self.n_req = cfg.req_per_query
 
+    def _owned_keys(self) -> np.ndarray:
+        """Global keys owned by this node, in slot order — the single
+        definition of the `key % part_cnt` partition layout
+        (ycsb_wl.cpp:70-74); shared by both index kinds and the loader."""
+        base = self.cfg.node_id if self.n_parts > 1 else 0
+        stride = self.n_parts if self.n_parts > 1 else 1
+        return (base + np.arange(self.n_local, dtype=np.int64)
+                * stride).astype(np.int32)
+
     # -- loader (ycsb_wl.cpp:125-203) ----------------------------------
     def load(self):
         tab = DeviceTable.create(self.catalog.table(TABLE), self.n_local,
                                  full_row=False)
-        # global keys owned by this node, in slot order
-        keys = (self.cfg.node_id if self.n_parts > 1 else 0) \
-            + np.arange(self.n_local, dtype=np.int32) \
-            * (self.n_parts if self.n_parts > 1 else 1)
+        keys = self._owned_keys()
         cols = {"F0": np.asarray(_field_fingerprint(keys, 0))}
         # remaining fields share the same fingerprint law; only F0 is
         # touched by queries (ycsb_txn.cpp reads/writes one field)

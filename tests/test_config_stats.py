@@ -57,3 +57,24 @@ def test_stats_merge_and_summary_roundtrip():
     assert fields["total_txn_commit_cnt"] == 150
     assert fields["total_txn_abort_cnt"] == 7
     assert fields["client_client_latency_p50"] == 2.0
+
+
+def test_prog_line_and_proc_utilization():
+    """[prog] tick parity (system/thread.cpp:86-105 + stats.h:311-316
+    mem/cpu utilization from /proc/self)."""
+    import sys
+
+    from deneva_tpu.stats import proc_utilization
+
+    u = proc_utilization()
+    if sys.platform == "linux":     # zeros are the documented non-/proc fallback
+        assert u["mem_util"] > 1.0  # this process surely exceeds 1 MiB RSS
+        assert u["cpu_util"] > 0.0
+    assert u["cpu_util"] >= 0.0
+    s = Stats()
+    s.incr("total_txn_commit_cnt", 40)
+    s.set("total_runtime", 2.0)
+    line = s.prog_line({"epoch_cnt": 9})
+    assert line.startswith("[prog] total_runtime=2,tput=20,txn_cnt=40")
+    assert "mem_util=" in line and "cpu_util=" in line
+    assert line.endswith("epoch_cnt=9")

@@ -146,6 +146,17 @@ def test_ycsb_hot_skew_and_txn_read_only():
     assert int(stats["total_txn_commit_cnt"]) > 0
 
 
+def test_btree_index_struct_equals_hash_results():
+    """INDEX_STRUCT=IDX_BTREE (global.h:320-324) swaps the primary probe
+    to the ordered index; same key->slot map, so every counter — including
+    the read checksum over actual gathered values — must be identical."""
+    a, _ = run_epochs(small_cfg(index_struct="IDX_HASH"), n=15, seed=4)
+    b, _ = run_epochs(small_cfg(index_struct="IDX_BTREE"), n=15, seed=4)
+    for k in ("total_txn_commit_cnt", "total_txn_abort_cnt",
+              "read_checksum", "write_cnt"):
+        assert a[k] == b[k], k
+
+
 def test_ycsb_abort_mode_forces_deterministic_aborts():
     """YCSB_ABORT_MODE (reference config.h:103): sentinel key 0 forces
     logical aborts, exercising abort/backoff deterministically even for

@@ -88,3 +88,27 @@ def test_plot_renders_pivot(tmp_path):
     tsv = render(str(tmp_path), x="zipf_theta", y="tput", series="cc_alg",
                  tsv=True)
     assert "\t" in tsv
+
+
+def test_timeline_parse_and_render(tmp_path):
+    """`scripts/timeline.py` analogue: aggregate [timeline] phase lines."""
+    from deneva_tpu.harness.timeline import parse_timeline, phase_table, render
+
+    log = tmp_path / "run.log"
+    log.write_text(
+        "noise\n"
+        "[timeline] node=0 epoch=1 loop=1.0ms respond=3.0ms\n"
+        "[timeline] node=0 epoch=2 loop=2.0ms respond=1.0ms\n"
+        "[timeline] node=1 epoch=1 loop=4.0ms\n")
+    rows = parse_timeline(log.read_text().splitlines())
+    assert len(rows) == 3 and rows[0]["phases"] == {"loop": 1.0, "respond": 3.0}
+    tab = phase_table(rows)
+    by = {(r[0], r[1]): r for r in tab[1:]}
+    assert by[("0", "loop")][3] == "3.0"        # total ms
+    assert by[("0", "loop")][6] == "42.9%"      # 3 of 7ms on node 0
+    assert by[("1", "loop")][2] == "1"          # epochs seen
+    out = render(tab)
+    assert "share" in out.splitlines()[0]
+    assert render(phase_table([])).startswith("(no [timeline]")
+    # node filter
+    assert all(r[0] == "1" for r in phase_table(rows, node=1)[1:])
