@@ -56,9 +56,9 @@ def child(platform: str) -> None:
 
     # each algorithm at its own best operating point (measured on v5e:
     # OCC peaks at 2048 — larger batches blow up its B^2 conflict work —
-    # while the forwarding executor keeps scaling to 16384)
+    # while the forwarding executor keeps scaling through 65536)
     occ_tput, _ = tput("OCC", 2048 // scale)
-    tpu_tput, _ = tput("TPU_BATCH", 16384 // scale)
+    tpu_tput, _ = tput("TPU_BATCH", 65536 // scale)
     print(json.dumps({
         "metric": "ycsb_zipf0.9_committed_txns_per_sec",
         "value": round(tpu_tput, 1),

@@ -161,7 +161,9 @@ class Engine:
         db = state.db
         if cfg.mode in (Mode.NORMAL, Mode.NOCC):
             if forwarding:
-                db = wl.execute(db, queries, exec_commit, verdict.order,
+                # commit set baked into the plan (fbatch.active); mask=None
+                # is asserted by the executor so the two cannot diverge
+                db = wl.execute(db, queries, None, verdict.order,
                                 stats, fwd_rank=fwd)
             elif be.chained and cfg.mode == Mode.NORMAL:
                 for lvl in range(cfg.exec_subrounds):

@@ -87,7 +87,9 @@ def make_dist_step(cfg: Config, wl, be):
             if forced is not None:
                 forced = forced & ~(verdict.abort | verdict.defer)
             exec_commit = verdict.commit
-            db = wl.execute(db, query, exec_commit, verdict.order, stats,
+            # commit set baked into the plan (fbatch.active); mask=None is
+            # asserted by the executor so the two cannot diverge
+            db = wl.execute(db, query, None, verdict.order, stats,
                             fwd_rank=fwd)
         else:
             inc = build_incidence(

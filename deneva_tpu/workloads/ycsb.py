@@ -171,21 +171,45 @@ class YCSBWorkload:
 
     # -- execution (ycsb_txn.cpp:177-209 collapsed to one batch) -------
     def execute(self, db, q: YCSBQuery, mask: jax.Array, order: jax.Array,
-                stats: dict, fwd_rank: jax.Array | None = None):
+                stats: dict, fwd_rank=None):
         tab: DeviceTable = db[TABLE]
+        if fwd_rank is not None:
+            # single-pass forwarding executor, in the plan's sorted
+            # coordinates (ops/forward.ForwardPlan): a read whose key has
+            # an earlier in-batch writer takes that writer's value —
+            # f(key, writer rank), computable without the writer having
+            # executed (blind writes; RFWD as arithmetic) — and only the
+            # final writer of each key touches the table.  Exactly one
+            # gather and one scatter against table storage per epoch;
+            # checksum and table state are order-independent, so no
+            # unsort is needed.  The commit set is BAKED INTO the plan
+            # (forward_verdict builds it from batch.valid & batch.active)
+            # — a caller with a narrower per-txn mask must rebuild the
+            # plan, so we demand mask=None rather than silently ignoring
+            # a mask the plan does not reflect.
+            assert mask is None, \
+                "ForwardPlan embodies the commit set; pass mask=None"
+            p = fwd_rank
+            slots = self.index.lookup(p.keys)                  # [N]
+            vals = jnp.take(tab.columns["F0"],
+                            jnp.where(p.is_read, slots, tab.capacity),
+                            axis=0)
+            vals = jnp.where(p.fwd >= 0,
+                             _field_fingerprint(p.keys, p.fwd), vals)
+            stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
+                jnp.where(p.is_read, vals, 0), dtype=jnp.uint32)
+            wvals = _field_fingerprint(p.keys, p.rank)
+            db = dict(db)
+            db[TABLE] = tab.scatter(slots, {"F0": wvals}, mask=p.win)
+            stats["write_cnt"] = stats["write_cnt"] + p.is_write.sum(
+                dtype=jnp.uint32)
+            return db
         slots = self.index.lookup(q.keys)                      # [n, R]
         act = mask[:, None] & jnp.ones_like(q.is_write)
         # reads: gather F0, fold into checksum (keeps the load alive)
         rmask = act & ~q.is_write
         vals = jnp.take(tab.columns["F0"], jnp.where(rmask, slots, tab.capacity),
                         axis=0)
-        if fwd_rank is not None:
-            # single-pass forwarding executor: a read whose key has an
-            # earlier in-batch writer takes that writer's value — which is
-            # f(key, writer rank), computable without the writer having
-            # executed (blind writes).  RFWD as arithmetic.
-            vals = jnp.where(fwd_rank >= 0,
-                             _field_fingerprint(q.keys, fwd_rank), vals)
         stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
             jnp.where(rmask, vals, 0), dtype=jnp.uint32)
         # writes: new fingerprint versioned by serialization order

@@ -82,7 +82,7 @@ def test_forwarding_executor_equals_serial_execution():
     the read values and final table state of serial execution in rank
     order (the property that makes commit-everything serializable)."""
     import jax.numpy as jnp
-    from deneva_tpu.ops import last_earlier_writer
+    from deneva_tpu.ops import forward_plan
     from deneva_tpu.workloads.ycsb import (YCSBQuery, YCSBWorkload,
                                            _field_fingerprint)
 
@@ -97,12 +97,10 @@ def test_forwarding_executor_equals_serial_execution():
     q = YCSBQuery(keys=jnp.asarray(keys), is_write=jnp.asarray(is_w))
     rank = np.arange(B, dtype=np.int32)
     order = jnp.asarray(rank)
-    mask = jnp.ones(B, bool)
-    fwd = last_earlier_writer(q.keys, order, q.is_write,
-                              jnp.ones((B, R), bool))
+    fwd = forward_plan(q.keys, order, q.is_write, jnp.ones((B, R), bool))
     stats = {"read_checksum": jnp.zeros((), jnp.uint32),
              "write_cnt": jnp.zeros((), jnp.uint32)}
-    db2 = wl.execute(dict(db), q, mask, order, stats, fwd_rank=fwd)
+    db2 = wl.execute(dict(db), q, None, order, stats, fwd_rank=fwd)
     got_sum = int(stats["read_checksum"])
     got_f0 = np.asarray(db2["MAIN_TABLE"].columns["F0"])[:32]
 
