@@ -115,8 +115,10 @@ class Engine:
         stats["generated_cnt"] += jnp.uint32(self.pool.g)
         stats["admitted_cnt"] += admitted.astype(jnp.uint32)
 
-        # 2. select epoch batch
+        # 2. select epoch batch (full-pool mode: identity, no gathers)
         slots, active, queries = self.pool.select(pool, state.epoch)
+        sel = (lambda v: v) if self.pool.full_pool \
+            else (lambda v: jnp.take(v, slots))
 
         # 3. plan RW-sets
         planned = wl.plan(state.db, queries)
@@ -124,7 +126,7 @@ class Engine:
             table_ids=planned["table_ids"], keys=planned["keys"],
             is_read=planned["is_read"], is_write=planned["is_write"],
             valid=planned["valid"],
-            ts=jnp.take(pool.ts, slots), rank=jnp.take(pool.seq, slots),
+            ts=sel(pool.ts), rank=sel(pool.seq),
             active=active)
 
         # 4. validate
@@ -184,11 +186,15 @@ class Engine:
         aborts = verdict.abort if forced is None else verdict.abort | forced
         stats["total_txn_abort_cnt"] += (aborts & active).sum(dtype=jnp.uint32)
         stats["defer_cnt"] += (verdict.defer & active).sum(dtype=jnp.uint32)
-        lat = state.epoch - jnp.take(pool.entry_epoch, slots)
-        lat = jnp.clip(lat, 0, LAT_BUCKETS - 1)
-        hist = stats["latency_hist"].at[lat].add(
-            (exec_commit & active).astype(jnp.uint32))
-        stats["latency_hist"] = hist
+        # histogram as a one-hot reduction: a 64-bucket scatter-add over
+        # the batch serializes on bucket contention on TPU (~4.5 ms at
+        # 64k lanes on v5e); the dense compare-and-sum is ~free
+        lat = jnp.clip(state.epoch - sel(pool.entry_epoch),
+                       0, LAT_BUCKETS - 1)
+        onehot = (lat[:, None] == jnp.arange(LAT_BUCKETS, dtype=jnp.int32)) \
+            & (exec_commit & active)[:, None]
+        stats["latency_hist"] = stats["latency_hist"] + onehot.sum(
+            axis=0, dtype=jnp.uint32)
 
         return EngineState(db=db, cc_state=cc_state, pool=pool, rng=rng,
                            epoch=state.epoch + 1, stats=stats)

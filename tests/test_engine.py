@@ -120,6 +120,104 @@ def test_forwarding_executor_equals_serial_execution():
     assert (got_f0 == f0).all()
 
 
+@pytest.mark.parametrize("alg", ["TPU_BATCH", "NO_WAIT", "OCC"])
+def test_full_pool_epoch_mode(alg):
+    """epoch_batch == max_txn_in_flight flips the pool to dense
+    (indexing-free) refill/select/update; every invariant of the normal
+    path must hold, including abort backoff (NO_WAIT/OCC abort on
+    conflict; the sentinel mode exercises forced completions)."""
+    cfg = small_cfg(cc_alg=alg, epoch_batch=256, max_txn_in_flight=256,
+                    zipf_theta=0.9, synth_table_size=256)
+    stats, pool = run_epochs(cfg)
+    commit = int(stats["total_txn_commit_cnt"])
+    admitted = int(stats["admitted_cnt"])
+    inflight = int(np.asarray(pool.occupied).sum())
+    assert commit > 0
+    assert commit + inflight == admitted
+    assert int(stats["latency_hist"].sum()) == commit
+    if alg != "TPU_BATCH":
+        assert int(stats["total_txn_abort_cnt"]) > 0   # contention bites
+    # determinism across runs
+    s2, _ = run_epochs(cfg)
+    for k in stats:
+        assert (stats[k] == s2[k]).all(), k
+
+
+def test_full_pool_serial_shadow():
+    """Full-pool TPU_BATCH epochs must be bit-identical to a host-side
+    serial shadow: replay generation + dense admission + serial
+    execution in seq order in numpy, and compare read checksum, commit
+    count, and the entire table after every epoch.  Any mis-stamped seq,
+    stale query, or forwarding divergence in the dense pool paths shows
+    up as a checksum or table mismatch."""
+    import jax.numpy as jnp
+    from deneva_tpu.workloads.ycsb import _field_fingerprint
+
+    cfg = small_cfg(cc_alg="TPU_BATCH", epoch_batch=64,
+                    max_txn_in_flight=64, req_per_query=4, max_accesses=4,
+                    zipf_theta=0.9, synth_table_size=64)
+    wl = get_workload(cfg)
+    eng = Engine(cfg, wl)
+    assert eng.pool.full_pool
+    state = eng.init_state(9)
+    stepf = jax.jit(eng.step)
+
+    P, R, N = 64, 4, 64
+    shadow = np.asarray(state.db["MAIN_TABLE"].columns["F0"])[:N].copy()
+    sh_keys = np.zeros((P, R), np.int32)
+    sh_w = np.zeros((P, R), bool)
+    sh_seq = np.zeros(P, np.int64)
+    occupied = np.zeros(P, bool)
+    next_seq, checksum, commits = 1, 0, 0
+    rng = jax.device_get(state.rng)
+
+    def fp(key, ver):
+        return int(np.asarray(_field_fingerprint(jnp.int32(key),
+                                                 jnp.int32(ver))))
+
+    for _ in range(3):
+        gen_key = jax.random.split(jnp.asarray(rng))[1]
+        newq = jax.device_get(wl.generate(gen_key, P))
+        free = ~occupied
+        sh_keys[free] = np.asarray(newq.keys)[free]
+        sh_w[free] = np.asarray(newq.is_write)[free]
+        sh_seq[free] = next_seq + np.flatnonzero(free)
+        occupied[:] = True
+        next_seq += 2 * P
+        for s in np.argsort(sh_seq):          # serial, in rank order
+            for r in range(R):
+                if not sh_w[s, r]:
+                    checksum = (checksum + int(shadow[sh_keys[s, r]])) \
+                        & 0xFFFFFFFF
+            for r in range(R):
+                if sh_w[s, r]:
+                    shadow[sh_keys[s, r]] = fp(sh_keys[s, r], sh_seq[s])
+        commits += P
+        occupied[:] = False                   # everything committed
+
+        state = stepf(state)
+        rng = jax.device_get(state.rng)
+        assert int(state.stats["total_txn_commit_cnt"]) == commits
+        assert int(state.stats["read_checksum"]) == checksum
+        got = np.asarray(state.db["MAIN_TABLE"].columns["F0"])[:N]
+        assert (got == shadow).all()
+
+
+def test_full_pool_forced_abort_conservation():
+    """YCSB_ABORT_MODE under full-pool: forced txns complete-as-aborted
+    and release their slot, so commits + forced + inflight == admitted."""
+    cfg = small_cfg(cc_alg="TPU_BATCH", epoch_batch=256,
+                    max_txn_in_flight=256, zipf_theta=0.9,
+                    synth_table_size=64, ycsb_abort_mode=True)
+    stats, pool = run_epochs(cfg)
+    assert int(stats["total_txn_abort_cnt"]) > 0
+    assert int(stats["total_txn_commit_cnt"]) > 0
+    commit = int(stats["total_txn_commit_cnt"])
+    forced = int(stats["total_txn_abort_cnt"])
+    inflight = int(np.asarray(pool.occupied).sum())
+    assert commit + forced + inflight == int(stats["admitted_cnt"])
+
+
 def test_ycsb_hot_skew_and_txn_read_only():
     """HOT skew method + TXN_WRITE_PERC + KEY_ORDER generator parity
     (reference ycsb_query.cpp:205-260, config.h:106,162-171)."""
