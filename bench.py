@@ -55,11 +55,11 @@ def child(platform: str) -> None:
         return f["tput"], f
 
     # each algorithm at its own best operating point (measured on v5e:
-    # OCC peaks at 2048 — larger batches blow up its B^2 conflict work —
+    # OCC peaks at 1024 — larger batches blow up its B^2 conflict work —
     # while the forwarding executor peaks in full-pool mode, where the
     # epoch IS the inflight window: both become 65536, the largest
     # power of two within the spec's 100k inflight budget)
-    occ_tput, _ = tput("OCC", 2048 // scale)
+    occ_tput, _ = tput("OCC", 1024 // scale)
     tpu_tput, _ = tput("TPU_BATCH", 65536 // scale,
                        max_txn_in_flight=65536 // scale)
     print(json.dumps({

@@ -16,7 +16,8 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from deneva_tpu.config import CCAlg, Config
-from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, build_incidence  # noqa: F401
+from deneva_tpu.cc.base import (AccessBatch, Incidence, Verdict,  # noqa: F401
+                                build_conflict_incidence, build_incidence)
 from deneva_tpu.cc.calvin import validate_calvin, validate_tpu_batch
 from deneva_tpu.cc.maat import validate_maat
 from deneva_tpu.cc.nocc import validate_nocc
@@ -37,6 +38,12 @@ class CCBackend:
     # workloads the whole batch commits with reads forwarded in-batch —
     # no conflict matrix at all; chained path is the fallback otherwise
     forward: bool = False
+    # deterministic batch executors may EXCLUDE accesses the workload
+    # marks ``order_free`` from conflict detection (escrow/commutative
+    # semantics: scatter-add updates and immutable-column reads need no
+    # ordering; the executor applies them order-exactly).  Lock/ts-based
+    # baselines keep the reference's row-level conflicts.
+    exempt_order_free: bool = False
 
 
 _NO_STATE = lambda cfg: ()  # noqa: E731
@@ -53,9 +60,10 @@ _REGISTRY: dict[CCAlg, CCBackend] = {
     CCAlg.MVCC: CCBackend(CCAlg.MVCC, validate_mvcc, init_to_state),
     CCAlg.MAAT: CCBackend(CCAlg.MAAT, validate_maat, _NO_STATE),
     CCAlg.CALVIN: CCBackend(CCAlg.CALVIN, validate_calvin, _NO_STATE,
-                            chained=True),
+                            chained=True, exempt_order_free=True),
     CCAlg.TPU_BATCH: CCBackend(CCAlg.TPU_BATCH, validate_tpu_batch, _NO_STATE,
-                               chained=True, forward=True),
+                               chained=True, forward=True,
+                               exempt_order_free=True),
 }
 
 

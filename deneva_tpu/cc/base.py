@@ -116,6 +116,22 @@ class Incidence:
     bucket1: jax.Array     # int32[B, A]
 
 
+def build_conflict_incidence(cfg, be, batch: AccessBatch,
+                             order_free: jax.Array | None):
+    """`build_incidence` honoring the backend's ``order_free`` exemption
+    (escrow/commutative accesses carry no conflict edges for the
+    deterministic executors).  Shared by the single-node engine and the
+    distributed server step so their conflict semantics cannot diverge."""
+    import dataclasses
+
+    if not be.needs_incidence:
+        return None
+    if be.exempt_order_free and order_free is not None:
+        batch = dataclasses.replace(batch,
+                                    valid=batch.valid & ~order_free)
+    return build_incidence(batch, cfg.conflict_buckets, cfg.conflict_exact)
+
+
 def build_incidence(batch: AccessBatch, n_buckets: int, exact: bool) -> Incidence:
     # `shard_buckets` is a no-op single-device; under a parallel.use_mesh
     # context it shards the bucket dim so the conflict matmul contracts

@@ -87,6 +87,12 @@ class Config:
     replica_cnt: int = 0
     repl_type: str = "AP"          # active-passive
 
+    # ---- multi-chip (single process, jax.sharding.Mesh) ----
+    device_parts: int = 1          # keyspace partitions ACROSS CHIPS: tables
+    #                                shard owner-major over the mesh and the
+    #                                forwarding executor runs partition-
+    #                                parallel under shard_map (parallel/)
+
     # ---- workload ----
     workload: WorkloadKind = WorkloadKind.YCSB
     cc_alg: CCAlg = CCAlg.TPU_BATCH
@@ -210,6 +216,31 @@ class Config:
         # real raises, not asserts: must hold under `python -O` too
         _check(self.node_cnt >= 1 and self.part_cnt >= 1,
                "node_cnt/part_cnt must be >= 1")
+        _check(self.device_parts >= 1, "device_parts must be >= 1")
+        if self.device_parts > 1:
+            _check(self.part_cnt == 1,
+                   "device_parts (multi-chip) and part_cnt (multi-process) "
+                   "partitioning do not compose yet")
+            _check(self.workload == WorkloadKind.YCSB,
+                   "device_parts > 1 is implemented for the YCSB "
+                   "forwarding executor only")
+            _check(self.cc_alg == CCAlg.TPU_BATCH
+                   and self.mode == Mode.NORMAL,
+                   "device_parts > 1 requires cc_alg=TPU_BATCH in NORMAL "
+                   "mode (the partition-parallel executor is the "
+                   "forwarding path)")
+            # the real invariant is on the PADDED row count the table
+            # allocates (owner-major blocks must split evenly and leave a
+            # free per-block trash row)
+            from deneva_tpu.storage.table import padded_rows
+            nrows = padded_rows(self.synth_table_size)
+            _check(nrows % self.device_parts == 0,
+                   f"padded table rows ({nrows}) must divide over "
+                   "device_parts")
+            _check((self.synth_table_size - 1) // self.device_parts
+                   < nrows // self.device_parts - 1,
+                   "device_parts leaves no free per-block trash row "
+                   "(table too small for this mesh)")
         _check(self.epoch_batch > 0
                and (self.epoch_batch & (self.epoch_batch - 1)) == 0,
                "epoch_batch must be a power of two (tiling discipline)")

@@ -30,6 +30,19 @@ def _counters(state: EngineState) -> dict:
     return {k: np.asarray(v) for k, v in host.items()}
 
 
+def _sync(state: EngineState) -> int:
+    """Real device->host transfer as the pacing barrier.
+
+    `jax.block_until_ready` on a donated scan output can return before
+    the execution finishes on tunneled TPU backends (the aliased buffer's
+    definition event is already set), letting a wall-clock-bounded loop
+    enqueue an unbounded backlog — which wedges the single-client tunnel
+    and, past ~50 s of queued work, kills the worker.  A scalar transfer
+    cannot complete early, so it both paces the loop and surfaces any
+    execution error at the call site."""
+    return int(jax.device_get(state.stats["total_txn_commit_cnt"]))
+
+
 def run_simulation(cfg: Config, chunk: int = 50,
                    quiet: bool = False) -> Stats:
     """Warmup for ``warmup_secs``, measure for ``done_secs``; returns Stats."""
@@ -43,7 +56,20 @@ def run_simulation(cfg: Config, chunk: int = 50,
     # compile once (excluded from both windows, like the reference's setup
     # barrier, system/thread.cpp:62-84)
     state = eng.jit_run(state, chunk)
-    jax.block_until_ready(state.stats["total_txn_commit_cnt"])
+    _sync(state)
+    # adaptive chunking: size each device call to ~1 s — large enough
+    # that the per-call sync round-trip (tens of ms on a tunneled chip)
+    # stays in the noise, small enough that no single execution
+    # approaches the tunnel's multi-second RPC limits
+    t1 = time.monotonic()
+    state = eng.jit_run(state, chunk)
+    _sync(state)
+    per_chunk = max(time.monotonic() - t1, 1e-4)
+    target = max(1, min(int(chunk * 1.0 / per_chunk), 20_000))
+    if target > chunk * 2 or target < chunk // 2:
+        chunk = target
+        state = eng.jit_run(state, chunk)     # one more compile, new n
+        _sync(state)
 
     ckpt_due = [cfg.checkpoint_every_epochs]
     run_t0 = time.monotonic()
@@ -67,7 +93,7 @@ def run_simulation(cfg: Config, chunk: int = 50,
         epochs = 0
         while time.monotonic() - t0 < secs:
             state = eng.jit_run(state, chunk)
-            jax.block_until_ready(state.stats["total_txn_commit_cnt"])
+            _sync(state)
             epochs += chunk
             epochs_total[0] += chunk
             prog_tick(state)

@@ -32,10 +32,12 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 
-from deneva_tpu.cc import AccessBatch, build_incidence, get_backend
+from deneva_tpu.cc import (AccessBatch, build_conflict_incidence,
+                           get_backend)
 from deneva_tpu.config import Config, Mode
 from deneva_tpu.engine.pool import PoolState, TxnPool
-from deneva_tpu.ops import forward_verdict, forwarding_applies
+from deneva_tpu.ops import (commit_all_verdict, forward_verdict,
+                            forwarding_applies)
 
 LAT_BUCKETS = 64
 
@@ -143,11 +145,18 @@ class Engine:
             # so their (never-applied) writes are invisible to readers.
             fbatch = batch if forced is None else dataclasses.replace(
                 batch, active=batch.active & ~forced)
-            verdict, fwd = forward_verdict(fbatch)
+            if cfg.device_parts > 1:
+                # multi-chip: plans are built per-shard inside
+                # wl.execute_mc; only the (trivial) verdict is global
+                verdict = commit_all_verdict(fbatch)
+                mc_batch = fbatch
+            else:
+                verdict, fwd = forward_verdict(fbatch)
+                mc_batch = None
             cc_state = state.cc_state
         else:
-            inc = build_incidence(batch, cfg.conflict_buckets,
-                                  cfg.conflict_exact) if be.needs_incidence else None
+            inc = build_conflict_incidence(cfg, be, batch,
+                                           planned.get("order_free"))
             verdict, cc_state = be.validate(cfg, state.cc_state, batch, inc)
         # a forced txn completes-as-aborted only when the CC would not
         # retry it anyway (CC aborts/defers follow their normal path)
@@ -163,14 +172,22 @@ class Engine:
         db = state.db
         if cfg.mode in (Mode.NORMAL, Mode.NOCC):
             if forwarding:
-                # commit set baked into the plan (fbatch.active); mask=None
-                # is asserted by the executor so the two cannot diverge
-                db = wl.execute(db, queries, None, verdict.order,
-                                stats, fwd_rank=fwd)
+                if cfg.device_parts > 1:
+                    db = wl.execute_mc(db, mc_batch, stats)
+                else:
+                    # commit set baked into the plan (fbatch.active);
+                    # mask=None is asserted by the executor so the two
+                    # cannot diverge
+                    db = wl.execute(db, queries, None, verdict.order,
+                                    stats, fwd_rank=fwd)
             elif be.chained and cfg.mode == Mode.NORMAL:
                 for lvl in range(cfg.exec_subrounds):
                     m = exec_commit & (verdict.level == lvl)
-                    db = wl.execute(db, queries, m, verdict.order, stats)
+                    # level_exec: each level's committed set is
+                    # write-conflict-free by construction, so executors
+                    # skip the last_writer scatter-max tournament
+                    db = wl.execute(db, queries, m, verdict.order, stats,
+                                    level_exec=True)
             else:
                 db = wl.execute(db, queries, exec_commit, verdict.order,
                                 stats)

@@ -10,8 +10,9 @@ from deneva_tpu.ops.hashing import bucket_hash, combine_key  # noqa: F401
 from deneva_tpu.ops.sampling import HotSet, Zipfian, uniform_keys  # noqa: F401
 from deneva_tpu.ops.scatter import last_writer  # noqa: F401
 from deneva_tpu.ops.forward import (ForwardPlan,  # noqa: F401
-                                    forward_plan, forward_verdict,
-                                    forwarding_applies, last_earlier_writer)
+                                    commit_all_verdict, forward_plan,
+                                    forward_verdict, forwarding_applies,
+                                    last_earlier_writer)
 from deneva_tpu.ops.conflict import (  # noqa: F401
     access_incidence,
     overlap,

@@ -83,18 +83,24 @@ jax.tree_util.register_dataclass(
     meta_fields=[])
 
 
+def commit_all_verdict(batch):
+    """Commit-everything Verdict in rank order — the forwarding
+    executor's invariant (also used standalone by the multi-chip path,
+    whose plans are built per-shard inside shard_map)."""
+    from deneva_tpu.cc.base import Verdict
+
+    z = jnp.zeros_like(batch.active)
+    return Verdict(commit=batch.active, abort=z, defer=z,
+                   order=batch.rank, level=jnp.zeros_like(batch.rank))
+
+
 def forward_verdict(batch):
     """Commit-everything Verdict + sorted ForwardPlan for the single-pass
     executor.  Shared by the single-node engine and the distributed
     server step so their semantics cannot diverge."""
-    from deneva_tpu.cc.base import Verdict
-
-    z = jnp.zeros_like(batch.active)
-    verdict = Verdict(commit=batch.active, abort=z, defer=z,
-                      order=batch.rank, level=jnp.zeros_like(batch.rank))
     plan = forward_plan(batch.keys, batch.rank, batch.is_write,
                         batch.valid & batch.active[:, None])
-    return verdict, plan
+    return commit_all_verdict(batch), plan
 
 
 def _seg_scan(flags: jax.Array, vals: jax.Array, combine) -> jax.Array:

@@ -19,6 +19,6 @@ Multi-host distribution (separate processes, message passing) lives in
 """
 
 from deneva_tpu.parallel.mesh import (  # noqa: F401
-    AXIS, make_mesh, use_mesh, shard_buckets, state_shardings,
-    make_sharded_run,
+    AXIS, current_mesh, make_mesh, use_mesh, shard_buckets,
+    state_shardings, make_sharded_run,
 )

@@ -41,6 +41,12 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.array(devs[:n]), (AXIS,))
 
 
+def current_mesh() -> Mesh | None:
+    """Mesh of the enclosing `use_mesh` context (None outside one).
+    Read at jit *trace* time by the engine to pick sharded code paths."""
+    return _current["mesh"]
+
+
 @contextlib.contextmanager
 def use_mesh(mesh: Mesh):
     prev = _current["mesh"]

@@ -59,7 +59,7 @@ def make_dist_step(cfg: Config, wl, be):
 
     import dataclasses as _dc
 
-    from deneva_tpu.cc import AccessBatch, build_incidence
+    from deneva_tpu.cc import AccessBatch, build_conflict_incidence
     from deneva_tpu.engine.step import forced_sentinel_mask
     from deneva_tpu.ops import forward_verdict, forwarding_applies
 
@@ -92,9 +92,8 @@ def make_dist_step(cfg: Config, wl, be):
             db = wl.execute(db, query, None, verdict.order, stats,
                             fwd_rank=fwd)
         else:
-            inc = build_incidence(
-                batch, cfg.conflict_buckets,
-                cfg.conflict_exact) if be.needs_incidence else None
+            inc = build_conflict_incidence(cfg, be, batch,
+                                           planned.get("order_free"))
             verdict, cc_state = be.validate(cfg, cc_state, batch, inc)
             if forced is not None:
                 forced = forced & ~(verdict.abort | verdict.defer)
@@ -103,7 +102,10 @@ def make_dist_step(cfg: Config, wl, be):
             if be.chained:
                 for lvl in range(cfg.exec_subrounds):
                     m = exec_commit & (verdict.level == lvl)
-                    db = wl.execute(db, query, m, verdict.order, stats)
+                    # per-level committed sets are write-conflict-free;
+                    # executors skip the last_writer tournament
+                    db = wl.execute(db, query, m, verdict.order, stats,
+                                    level_exec=True)
             else:
                 db = wl.execute(db, query, exec_commit, verdict.order,
                                 stats)

@@ -38,6 +38,15 @@ import numpy as np
 from deneva_tpu.storage.catalog import TableSchema
 
 
+def padded_rows(capacity: int) -> int:
+    """Allocated row count for a table of ``capacity`` rows: padded to a
+    multiple of 64 past the trash slot so the row dimension shards evenly
+    over any mesh up to 64 devices (jax NamedSharding requires
+    divisibility); pad rows are inert.  Config validation for
+    ``device_parts`` checks divisibility against THIS number."""
+    return -(-(capacity + 1) // 64) * 64
+
+
 def _col_spec(ctype: str, size: int, full_row: bool) -> tuple[object, tuple]:
     """(dtype, extra_shape) for one column."""
     if ctype in ("int64_t", "uint64_t", "int32_t", "uint32_t"):
@@ -69,10 +78,7 @@ class DeviceTable:
     @classmethod
     def create(cls, schema: TableSchema, capacity: int,
                full_row: bool = False, ring: bool = False) -> "DeviceTable":
-        # rows are padded to a multiple of 64 past the trash slot so the
-        # row dimension shards evenly over any mesh up to 64 devices
-        # (jax NamedSharding requires divisibility); pad rows are inert.
-        nrows = -(-(capacity + 1) // 64) * 64
+        nrows = padded_rows(capacity)
         cols = {}
         for c in schema.columns:
             dtype, extra = _col_spec(c.ctype, c.size, full_row)

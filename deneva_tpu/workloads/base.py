@@ -68,7 +68,14 @@ class Workload(Protocol):
         ...
 
     def execute(self, db: DB, queries: Any, mask: jax.Array,
-                order: jax.Array, stats: dict) -> DB:
+                order: jax.Array, stats: dict, fwd_rank=None,
+                level_exec: bool = False) -> DB:
         """Apply txns selected by ``mask`` to ``db``; update device stats
-        dict in place (read checksums keep gathers alive under XLA)."""
+        dict in place (read checksums keep gathers alive under XLA).
+
+        ``fwd_rank`` — a `deneva_tpu.ops.ForwardPlan` when the single-pass
+        forwarding executor applies (``mask`` must then be None: the plan
+        embodies the commit set).  ``level_exec`` — the caller guarantees
+        this committed set is write-conflict-free (a chained sub-round),
+        so duplicate-writer resolution may be skipped."""
         ...

@@ -259,7 +259,7 @@ class PPSWorkload:
     blind_writes = False
 
     def execute(self, db, q: PPSQuery, mask: jax.Array, order: jax.Array,
-                stats: dict, fwd_rank=None):
+                stats: dict, fwd_rank=None, level_exec: bool = False):
         db = dict(db)
         t = q.txn_type
         per = self.per
@@ -297,8 +297,13 @@ class PPSWorkload:
         # (run_updateproductpart_1 set_value(1, part_key))
         pm = mask & (t == UPDATEPRODUCTPART)
         pslot = self.product_slot(q.product_key)
-        win = last_writer(jnp.where(pm, pslot, db["PRODUCTS"].capacity),
-                          order, pm, db["PRODUCTS"].capacity)
+        if level_exec:
+            # chained sub-round: committed set is write-conflict-free,
+            # so each product has at most one writer in this call
+            win = pm
+        else:
+            win = last_writer(jnp.where(pm, pslot, db["PRODUCTS"].capacity),
+                              order, pm, db["PRODUCTS"].capacity)
         db["PRODUCTS"] = db["PRODUCTS"].scatter(
             pslot, {"PRODUCT_PART": q.part_key}, mask=win)
 

@@ -222,6 +222,18 @@ class TPCCWorkload:
 
     # -- loader (tpcc_wl.cpp:89-152 parallel loaders) -------------------
     def load(self):
+        """Build the initial database ON DEVICE as one jitted program.
+
+        The reference's loaders are parallel host threads writing rows
+        (`tpcc_wl.cpp:89-152`); the first cut here mirrored that with
+        numpy columns copied to the device — which meant shipping
+        hundreds of MB over the host link at num_wh=64 (minutes on a
+        tunneled chip).  Every initial value is arithmetic on the row
+        index, so the whole load is a single XLA program: zero
+        host->device bytes, compile + run in seconds at any scale."""
+        return jax.jit(self._build_db)()
+
+    def _build_db(self):
         cfg = self.cfg
         db = {}
 
@@ -236,14 +248,14 @@ class TPCCWorkload:
         p, me = self.n_parts, self.me
 
         wh = tab("WAREHOUSE", self.n_wh_loc)
-        w_glob = me + p * np.arange(self.n_wh_loc, dtype=np.int32)
+        w_glob = me + p * jnp.arange(self.n_wh_loc, dtype=jnp.int32)
         db["WAREHOUSE"] = fill_columns(wh, self.n_wh_loc, {
             "W_ID": w_glob,
             "W_TAX": _rand01(w_glob, 7) * 0.2,      # URand(0,.2) (init_wh)
-            "W_YTD": np.full(self.n_wh_loc, 300000.0, np.float32)})
+            "W_YTD": jnp.full(self.n_wh_loc, 300000.0, jnp.float32)})
 
         dist = tab("DISTRICT", self.n_districts_loc)
-        dl = np.arange(self.n_districts_loc, dtype=np.int32)
+        dl = jnp.arange(self.n_districts_loc, dtype=jnp.int32)
         d_w = me + p * (dl // self.n_dist)
         d_id = dl % self.n_dist
         d_glob = d_w * self.n_dist + d_id
@@ -251,11 +263,11 @@ class TPCCWorkload:
             "D_ID": d_id,
             "D_W_ID": d_w,
             "D_TAX": _rand01(d_glob, 11) * 0.2,
-            "D_YTD": np.full(self.n_districts_loc, 30000.0, np.float32),
-            "D_NEXT_O_ID": np.full(self.n_districts_loc, 3001, np.int32)})
+            "D_YTD": jnp.full(self.n_districts_loc, 30000.0, jnp.float32),
+            "D_NEXT_O_ID": jnp.full(self.n_districts_loc, 3001, jnp.int32)})
 
         cust = tab("CUSTOMER", self.n_cust_loc)
-        cl = np.arange(self.n_cust_loc, dtype=np.int32)
+        cl = jnp.arange(self.n_cust_loc, dtype=jnp.int32)
         c_local = cl % self.cust_per_dist
         c_d = (cl // self.cust_per_dist) % self.n_dist
         c_w = me + p * (cl // (self.cust_per_dist * self.n_dist))
@@ -266,29 +278,27 @@ class TPCCWorkload:
             "C_W_ID": c_w,
             "C_LAST": c_local % self.lastnames,
             "C_DISCOUNT": _rand01(c_glob, 13) * 0.5,
-            "C_BALANCE": np.full(self.n_cust_loc, -10.0, np.float32),
-            "C_YTD_PAYMENT": np.full(self.n_cust_loc, 10.0, np.float32),
-            "C_PAYMENT_CNT": np.ones(self.n_cust_loc, np.int32)})
+            "C_BALANCE": jnp.full(self.n_cust_loc, -10.0, jnp.float32),
+            "C_YTD_PAYMENT": jnp.full(self.n_cust_loc, 10.0, jnp.float32),
+            "C_PAYMENT_CNT": jnp.ones(self.n_cust_loc, jnp.int32)})
 
         item = tab("ITEM", self.max_items)
-        i_ids = np.arange(self.max_items, dtype=np.int32)
+        i_ids = jnp.arange(self.max_items, dtype=jnp.int32)
         db["ITEM"] = fill_columns(item, self.max_items, {
             "I_ID": i_ids,
-            "I_IM_ID": (i_ids.astype(np.int64) * 2654435761 % 10000
-                        ).astype(np.int32),
-            "I_PRICE": (1 + i_ids.astype(np.int64) * 48271 % 100
-                        ).astype(np.int32)})
+            "I_IM_ID": _mulmod(i_ids, 2654435761, 10000),
+            "I_PRICE": 1 + _mulmod(i_ids, 48271, 100)})
 
         stock = tab("STOCK", self.n_stock_loc)
-        sl = np.arange(self.n_stock_loc, dtype=np.int32)
+        sl = jnp.arange(self.n_stock_loc, dtype=jnp.int32)
         s_i = sl % self.max_items
         s_w = me + p * (sl // self.max_items)
-        s_glob = (s_w.astype(np.int64) * self.max_items + s_i)
+        s_glob = s_w * self.max_items + s_i
         db["STOCK"] = fill_columns(stock, self.n_stock_loc, {
             "S_I_ID": s_i,
             "S_W_ID": s_w,
-            "S_QUANTITY": (10 + s_glob * 69621 % 91).astype(np.int32),
-            "S_REMOTE_CNT": np.zeros(self.n_stock_loc, np.int32)})
+            "S_QUANTITY": 10 + _mulmod(s_glob, 69621, 91),
+            "S_REMOTE_CNT": jnp.zeros(self.n_stock_loc, jnp.int32)})
 
         cap = cfg.insert_table_cap
         tab("HISTORY", cap, ring=True)
@@ -405,35 +415,51 @@ class TPCCWorkload:
         is_read = jnp.zeros((n, A), bool)
         is_write = jnp.zeros((n, A), bool)
         valid = jnp.zeros((n, A), bool)
+        order_free = jnp.zeros((n, A), bool)
 
-        def put(a, tid, key, r, w, v):
-            nonlocal tables, keys, is_read, is_write, valid
+        def put(a, tid, key, r, w, v, of=False):
+            nonlocal tables, keys, is_read, is_write, valid, order_free
             tables = tables.at[:, a].set(tid)
             keys = keys.at[:, a].set(key)
             is_read = is_read.at[:, a].set(r)
             is_write = is_write.at[:, a].set(w)
             valid = valid.at[:, a].set(v)
+            if of is not False:
+                order_free = order_free.at[:, a].set(of)
 
+        # The warehouse/district/customer accesses are ``order_free``
+        # (escrow/commutative semantics): every write on them is a
+        # scatter-add (W_YTD/D_YTD/C_BALANCE/C_YTD_PAYMENT/
+        # C_PAYMENT_CNT += ...) or the rank-ordered D_NEXT_O_ID prefix
+        # sum, and every read is of an immutable column (W_TAX, D_TAX,
+        # C_DISCOUNT) — so the batched executor applies them
+        # order-exactly with no conflict edges.  The reference's
+        # row-level lock managers serialize payments on the warehouse
+        # row (`row_lock.cpp`), which is exactly the scaling cliff this
+        # column-aware declaration removes for the deterministic
+        # backends (lock/ts baselines still see the full RW-sets).
+        # Stock is a genuine RMW (quantity rule) and stays ordered.
         one = jnp.ones((n,), bool)
         # 0: warehouse — payment updates W_YTD (run_payment_0), neworder
         #    reads W_TAX (new_order_0)
         wh_write = is_pay & cfg.wh_update
-        put(0, TID["WAREHOUSE"], q.w_id, one, wh_write, one)
+        put(0, TID["WAREHOUSE"], q.w_id, one, wh_write, one, of=one)
         # 1: district — payment D_YTD += (run_payment_2/3); neworder
         #    D_NEXT_O_ID++ (new_order_2)
-        put(1, TID["DISTRICT"], self.dist_key(q.w_id, q.d_id), one, one, one)
+        put(1, TID["DISTRICT"], self.dist_key(q.w_id, q.d_id), one, one, one,
+            of=one)
         # 2: customer — payment balance update at (c_w,c_d); neworder
         #    reads C_DISCOUNT at home (new_order_4)
         ck = jnp.where(is_pay, self.cust_key(q.c_w_id, q.c_d_id, q.c_id),
                        self.cust_key(q.w_id, q.d_id, q.c_id))
-        put(2, TID["CUSTOMER"], ck, one, is_pay, one)
+        put(2, TID["CUSTOMER"], ck, one, is_pay, one, of=one)
         # 3..3+I: stock rows (new_order_8); ITEM reads excluded (immutable)
         sk = self.stock_key(q.supply_w, q.items)
         iv = q.item_valid & ~is_pay[:, None]
         for j in range(self.ipt):
             put(3 + j, TID["STOCK"], sk[:, j], iv[:, j], iv[:, j], iv[:, j])
         return dict(table_ids=tables, keys=keys, is_read=is_read,
-                    is_write=is_write, valid=valid)
+                    is_write=is_write, valid=valid, order_free=order_free)
 
     # -- execution ------------------------------------------------------
     # NewOrder's stock update is a true RMW (the new quantity depends on
@@ -441,13 +467,13 @@ class TPCCWorkload:
     blind_writes = False
 
     def execute(self, db, q: TPCCQuery, mask: jax.Array, order: jax.Array,
-                stats: dict, fwd_rank=None):
+                stats: dict, fwd_rank=None, level_exec: bool = False):
         db = dict(db)
         is_pay = q.txn_type == TPCC_PAYMENT
         pay = mask & is_pay
         neworder = mask & ~is_pay
         db = self._exec_payment(db, q, pay, stats)
-        db = self._exec_neworder(db, q, neworder, order, stats)
+        db = self._exec_neworder(db, q, neworder, order, stats, level_exec)
         return db
 
     def _exec_payment(self, db, q, m, stats):
@@ -475,7 +501,8 @@ class TPCCWorkload:
             (m.sum() * 6).astype(jnp.uint32)
         return db
 
-    def _exec_neworder(self, db, q, m, order, stats):
+    def _exec_neworder(self, db, q, m, order, stats,
+                       level_exec: bool = False):
         """new_order_0..9 (`tpcc_txn.cpp:`): O_ID allocation is a
         per-district segmented prefix sum over the committed batch in
         serialization order — D_NEXT_O_ID++ under the row latch, batched."""
@@ -526,9 +553,16 @@ class TPCCWorkload:
         s_q = stock.gather(sk, ("S_QUANTITY",))["S_QUANTITY"]
         # strict: replenish at s_q - qty <= 10 (tpcc_txn.cpp new_order_8/9)
         new_q = jnp.where(s_q - qty > 10, s_q - qty, s_q - qty + 91)
-        worder = jnp.broadcast_to(order[:, None], (n, I)).reshape(-1)
-        win = last_writer(jnp.where(iv, sk, stock.capacity), worder, iv,
-                          stock.capacity)
+        if level_exec:
+            # chained sub-round: the level's committed set is stock-
+            # conflict-free and item_valid dedups in-txn items, so every
+            # valid lane IS the final writer — the scatter-max
+            # tournament (4 full-table passes) is redundant
+            win = iv
+        else:
+            worder = jnp.broadcast_to(order[:, None], (n, I)).reshape(-1)
+            win = last_writer(jnp.where(iv, sk, stock.capacity), worder, iv,
+                              stock.capacity)
         stock = stock.scatter(sk, {"S_QUANTITY": new_q}, mask=win)
         remote = (q.supply_w != q.w_id[:, None]).reshape(-1)
         db["STOCK"] = stock.scatter_add(
@@ -562,8 +596,21 @@ class TPCCWorkload:
         return db
 
 
-def _rand01(ids: np.ndarray, salt: int) -> np.ndarray:
-    """Deterministic per-row uniform [0,1) for loader columns."""
-    h = (ids.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)
-         + np.uint64(salt)) & np.uint64(0xFFFFFFFF)
-    return (h / np.float64(2**32)).astype(np.float32)
+def _rand01(ids: jax.Array, salt: int) -> jax.Array:
+    """Deterministic per-row uniform [0,1) for loader columns (device
+    arithmetic; uint32 product keeps the low 32 bits, which is all the
+    64-bit golden-ratio multiply contributed)."""
+    h = (ids.astype(jnp.uint32) * jnp.uint32(0x7F4A7C15)
+         + jnp.uint32(salt))
+    # split so each half converts to f32 exactly; one rounding at the add
+    hi = (h >> 8).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+    lo = (h & 0xFF).astype(jnp.float32) * jnp.float32(2.0 ** -32)
+    return hi + lo
+
+
+def _mulmod(ids: jax.Array, mul: int, mod: int) -> jax.Array:
+    """(ids * mul) % mod, bit-exact to the old int64 host loader without
+    64-bit device math: (x*y) mod m == ((x mod m) * (y mod m)) mod m,
+    and both reduced factors fit comfortably in 32 bits."""
+    return ((ids.astype(jnp.uint32) % jnp.uint32(mod))
+            * jnp.uint32(mul % mod) % jnp.uint32(mod)).astype(jnp.int32)

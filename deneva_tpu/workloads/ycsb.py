@@ -28,7 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deneva_tpu.config import Config
-from deneva_tpu.ops import HotSet, Zipfian, last_writer
+from deneva_tpu.ops import HotSet, Zipfian, forward_plan, last_writer
 from deneva_tpu.storage.catalog import parse_schema
 from deneva_tpu.storage.index import DenseIndex, SortedIndex
 from deneva_tpu.storage.table import DeviceTable
@@ -59,6 +59,21 @@ def _field_fingerprint(key: jax.Array | np.ndarray, version):
     k = jnp.asarray(key).astype(jnp.uint32)
     v = jnp.asarray(version).astype(jnp.uint32)
     return (k * jnp.uint32(2654435761)) ^ (v * jnp.uint32(0x9E3779B9)) | jnp.uint32(1)
+
+
+def _forward_execute_f0(f0: jax.Array, p, slots: jax.Array, trash):
+    """THE forwarding-executor data path, shared verbatim by the
+    single-chip `execute` and each shard of `execute_mc` so their
+    semantics cannot diverge: reads gather F0 (forwarded lanes take
+    f(key, writer rank) instead), the checksum folds over reads, and
+    only final writers scatter.  Returns (f0', checksum, write_cnt) —
+    the caller decides whether the scalars need a psum."""
+    vals = jnp.take(f0, jnp.where(p.is_read, slots, trash), axis=0)
+    vals = jnp.where(p.fwd >= 0, _field_fingerprint(p.keys, p.fwd), vals)
+    cks = jnp.sum(jnp.where(p.is_read, vals, 0), dtype=jnp.uint32)
+    wvals = _field_fingerprint(p.keys, p.rank).astype(f0.dtype)
+    f0 = f0.at[jnp.where(p.win, slots, trash)].set(wvals)
+    return f0, cks, p.is_write.sum(dtype=jnp.uint32)
 
 
 class YCSBWorkload:
@@ -118,6 +133,24 @@ class YCSBWorkload:
         tab = DeviceTable.create(self.catalog.table(TABLE), self.n_local,
                                  full_row=False)
         keys = self._owned_keys()
+        D = self.cfg.device_parts
+        if D > 1:
+            # multi-chip owner-major layout: key k lives at global row
+            # (k % D) * Lb + k // D, so mesh block d holds exactly the
+            # keys ≡ d (mod D) — the reference's strided node partition
+            # (ycsb_wl.cpp:70-74) across CHIPS.  Each block's last row is
+            # its local trash (provably unreachable by valid keys given
+            # the 64-row pad; asserted here).
+            nrows = tab.columns["F0"].shape[0]
+            assert nrows % D == 0, "table pad must divide over device_parts"
+            lb = nrows // D
+            assert (self.n_local - 1) // D < lb - 1, \
+                "need a free per-block trash row (table too small for D)"
+            rows = (keys % D).astype(np.int64) * lb + keys // D
+            col = np.zeros((nrows,), np.uint32)
+            col[rows] = np.asarray(_field_fingerprint(keys, 0))
+            tab.columns["F0"] = jnp.asarray(col)
+            return {TABLE: tab}
         cols = {"F0": np.asarray(_field_fingerprint(keys, 0))}
         # remaining fields share the same fingerprint law; only F0 is
         # touched by queries (ycsb_txn.cpp reads/writes one field)
@@ -169,9 +202,61 @@ class YCSBWorkload:
             valid=jnp.ones(shape, bool),
         )
 
+    # -- multi-chip execution (partition-parallel forwarding) ----------
+    def execute_mc(self, db, batch, stats: dict):
+        """Calvin-shaped multi-chip epoch: the batch is replicated (every
+        chip sees the full deterministic sequence, like the reference
+        sequencer's broadcast, `system/sequencer.cpp:283-326`) and each
+        chip plans + executes ONLY its keyspace partition — reads gather
+        and writes scatter against the local table shard, the read
+        checksum reduces with one psum over ICI.  Per-chip planning is
+        redundant compute (one fused sort each) but needs zero routing
+        collectives, no capacity factors, and no drops; the expensive
+        random-access DB work divides by the mesh size.
+
+        Tables must be in the owner-major layout `load()` produces for
+        ``device_parts > 1``; each local block's last row is its trash.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from deneva_tpu.parallel import AXIS, current_mesh
+
+        d_parts = self.cfg.device_parts
+        mesh = current_mesh()
+        assert mesh is not None and mesh.size == d_parts, \
+            f"execute_mc needs a use_mesh({d_parts}) context"
+        tab: DeviceTable = db[TABLE]
+        nrows = tab.columns["F0"].shape[0]
+        lb = nrows // d_parts
+        valid = batch.valid & batch.active[:, None]
+        big = jnp.int32(jnp.iinfo(jnp.int32).max)
+
+        def body(f0, keys, rank, is_write, valid):
+            me = jax.lax.axis_index(AXIS)
+            owned = valid & (keys % d_parts == me)
+            p = forward_plan(keys, rank, is_write, owned)
+            trash = jnp.int32(lb - 1)
+            slots = jnp.where(p.keys != big, p.keys // d_parts, trash)
+            f0, cks, wcnt = _forward_execute_f0(f0, p, slots, trash)
+            return f0, jax.lax.psum(cks, AXIS), jax.lax.psum(wcnt, AXIS)
+
+        f0, cks, wcnt = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(AXIS), P(), P(), P(), P()),
+            out_specs=(P(AXIS), P(), P()))(
+                tab.columns["F0"], batch.keys, batch.rank,
+                batch.is_write, valid)
+        stats["read_checksum"] = stats["read_checksum"] + cks
+        stats["write_cnt"] = stats["write_cnt"] + wcnt
+        db = dict(db)
+        db[TABLE] = tab._replace(columns={**tab.columns, "F0": f0})
+        return db
+
     # -- execution (ycsb_txn.cpp:177-209 collapsed to one batch) -------
     def execute(self, db, q: YCSBQuery, mask: jax.Array, order: jax.Array,
-                stats: dict, fwd_rank=None):
+                stats: dict, fwd_rank=None, level_exec: bool = False):
+        assert self.cfg.device_parts == 1, \
+            "device_parts > 1 executes via execute_mc under a mesh"
         tab: DeviceTable = db[TABLE]
         if fwd_rank is not None:
             # single-pass forwarding executor, in the plan's sorted
@@ -191,18 +276,12 @@ class YCSBWorkload:
                 "ForwardPlan embodies the commit set; pass mask=None"
             p = fwd_rank
             slots = self.index.lookup(p.keys)                  # [N]
-            vals = jnp.take(tab.columns["F0"],
-                            jnp.where(p.is_read, slots, tab.capacity),
-                            axis=0)
-            vals = jnp.where(p.fwd >= 0,
-                             _field_fingerprint(p.keys, p.fwd), vals)
-            stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
-                jnp.where(p.is_read, vals, 0), dtype=jnp.uint32)
-            wvals = _field_fingerprint(p.keys, p.rank)
+            f0, cks, wcnt = _forward_execute_f0(
+                tab.columns["F0"], p, slots, tab.capacity)
+            stats["read_checksum"] = stats["read_checksum"] + cks
+            stats["write_cnt"] = stats["write_cnt"] + wcnt
             db = dict(db)
-            db[TABLE] = tab.scatter(slots, {"F0": wvals}, mask=p.win)
-            stats["write_cnt"] = stats["write_cnt"] + p.is_write.sum(
-                dtype=jnp.uint32)
+            db[TABLE] = tab._replace(columns={**tab.columns, "F0": f0})
             return db
         slots = self.index.lookup(q.keys)                      # [n, R]
         act = mask[:, None] & jnp.ones_like(q.is_write)
@@ -216,7 +295,14 @@ class YCSBWorkload:
         wmask = (act & q.is_write).reshape(-1)
         wslots = jnp.where(act & q.is_write, slots, tab.capacity).reshape(-1)
         worder = jnp.broadcast_to(order[:, None], slots.shape).reshape(-1)
-        win = last_writer(wslots, worder, wmask, tab.capacity)
+        if level_exec:
+            # caller guarantees the committed set is write-conflict-free
+            # (chained sub-round): cross-txn duplicates cannot exist and
+            # a txn's own duplicate lanes write identical values, so the
+            # scatter-max tournament is redundant
+            win = wmask
+        else:
+            win = last_writer(wslots, worder, wmask, tab.capacity)
         wvals = _field_fingerprint(q.keys.reshape(-1), worder)
         db = dict(db)
         db[TABLE] = tab.scatter(wslots, {"F0": wvals}, mask=win)

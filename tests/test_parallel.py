@@ -42,6 +42,53 @@ def test_sharded_run_matches_single_device(alg):
         assert (ref_stats[k] == out_stats[k]).all(), k
 
 
+def test_partition_parallel_forwarding_matches_single_device():
+    """device_parts=8: tables shard owner-major and each device plans +
+    executes only its keyspace partition (ycsb.execute_mc under
+    shard_map).  Serial semantics are device-count-invariant, so every
+    counter — including the read checksum over forwarded values — must
+    be bit-identical to the single-device run."""
+    cfg = cfg_for("TPU_BATCH")
+    eng = Engine(cfg, get_workload(cfg))
+    ref = eng.jit_run(eng.init_state(seed=5), 12)
+    ref_stats = {k: np.asarray(v) for k, v in
+                 jax.device_get(ref.stats).items()}
+
+    cfg8 = cfg.replace(device_parts=8)
+    eng8 = Engine(cfg8, get_workload(cfg8))
+    mesh = make_mesh(8)
+    place, run = make_sharded_run(eng8, mesh)
+    out = run(place(eng8.init_state(seed=5)), 12)
+    out_stats = {k: np.asarray(v) for k, v in
+                 jax.device_get(out.stats).items()}
+    for k in ref_stats:
+        assert (ref_stats[k] == out_stats[k]).all(), k
+
+
+def test_partition_parallel_full_pool_and_forced_aborts():
+    """The multi-chip executor composes with full-pool epochs and the
+    forced-abort sentinel (forced txns leave the batch before the
+    per-shard plans are built, so no shard applies their writes)."""
+    cfg = cfg_for("TPU_BATCH").replace(
+        epoch_batch=256, max_txn_in_flight=256, zipf_theta=0.9,
+        synth_table_size=4096, ycsb_abort_mode=True)
+    ref = Engine(cfg, get_workload(cfg))
+    r = ref.jit_run(ref.init_state(seed=2), 10)
+    ref_stats = {k: np.asarray(v) for k, v in jax.device_get(r.stats).items()}
+
+    cfg8 = cfg.replace(device_parts=8)
+    eng8 = Engine(cfg8, get_workload(cfg8))
+    assert eng8.pool.full_pool
+    mesh = make_mesh(8)
+    place, run = make_sharded_run(eng8, mesh)
+    out = run(place(eng8.init_state(seed=2)), 10)
+    out_stats = {k: np.asarray(v) for k, v in
+                 jax.device_get(out.stats).items()}
+    assert int(out_stats["total_txn_abort_cnt"]) > 0
+    for k in ref_stats:
+        assert (ref_stats[k] == out_stats[k]).all(), k
+
+
 def test_state_shardings_partition_tables():
     cfg = cfg_for("TIMESTAMP")
     eng = Engine(cfg, get_workload(cfg))

@@ -135,6 +135,24 @@ def test_money_conservation_and_order_consistency():
     assert n_ol >= n_ord * 4
 
 
+def test_order_free_exemption_commit_rate():
+    """Warehouse/district/customer accesses are order_free (commutative
+    scatter-adds + immutable-column reads), so the deterministic
+    backends must not chain on them: with every txn hitting one of 2
+    warehouses, defers may come only from stock-row collisions —
+    row-level conflict declaration would defer nearly everything here."""
+    for alg in ("TPU_BATCH", "CALVIN"):
+        # max_items large enough that NURand stock collisions are rare;
+        # warehouse/district contention stays maximal (2 warehouses)
+        cfg = tpcc_cfg(cc_alg=alg, num_wh=2, perc_payment=0.5,
+                       max_items=4096)
+        state = run_epochs(cfg, n=30)
+        commits = int(state.stats["total_txn_commit_cnt"])
+        defers = int(state.stats["defer_cnt"])
+        assert commits > 0
+        assert defers < max(commits // 10, 5), (alg, commits, defers)
+
+
 def test_stock_quantity_rule():
     """S_QUANTITY stays in (0, 101): the new_order_8 replenish rule."""
     cfg = tpcc_cfg(cc_alg="TPU_BATCH", perc_payment=0.0, num_wh=1,
