@@ -114,25 +114,32 @@ class Incidence:
     pr2: jax.Array | None
     # per-access bucket ids in family 0 (for ts-table gathers/scatters)
     bucket1: jax.Array     # int32[B, A]
+    # ordered-union incidence: accesses NOT marked order_free.  The
+    # deterministic executors draw conflict edges from overlap(uo, w) —
+    # a pair conflicts iff it overlaps AND at least one side needs
+    # ordering — so escrow add-add pairs carry no edge while reads of
+    # the same accumulators still order against every write.  Equals
+    # u1/u2 when no exemption applies.
+    uo1: jax.Array | None = None
+    uo2: jax.Array | None = None
 
 
 def build_conflict_incidence(cfg, be, batch: AccessBatch,
                              order_free: jax.Array | None):
     """`build_incidence` honoring the backend's ``order_free`` exemption
-    (escrow/commutative accesses carry no conflict edges for the
-    deterministic executors).  Shared by the single-node engine and the
+    (escrow/commutative accesses order only against ordered accesses,
+    never against each other).  Shared by the single-node engine and the
     distributed server step so their conflict semantics cannot diverge."""
-    import dataclasses
-
     if not be.needs_incidence:
         return None
-    if be.exempt_order_free and order_free is not None:
-        batch = dataclasses.replace(batch,
-                                    valid=batch.valid & ~order_free)
-    return build_incidence(batch, cfg.conflict_buckets, cfg.conflict_exact)
+    if not be.exempt_order_free:
+        order_free = None
+    return build_incidence(batch, cfg.conflict_buckets, cfg.conflict_exact,
+                           order_free=order_free)
 
 
-def build_incidence(batch: AccessBatch, n_buckets: int, exact: bool) -> Incidence:
+def build_incidence(batch: AccessBatch, n_buckets: int, exact: bool,
+                    order_free: jax.Array | None = None) -> Incidence:
     # `shard_buckets` is a no-op single-device; under a parallel.use_mesh
     # context it shards the bucket dim so the conflict matmul contracts
     # over partitions and XLA inserts the cross-device reduction.
@@ -141,17 +148,23 @@ def build_incidence(batch: AccessBatch, n_buckets: int, exact: bool) -> Incidenc
     v = batch.valid & batch.active[:, None]
     rmask = v & batch.is_read
     wmask = v & batch.is_write
+    omask = (rmask | wmask) if order_free is None \
+        else (rmask | wmask) & ~order_free
     b1 = bucket_hash(ident, n_buckets, family=0)
     r1 = shard_buckets(access_incidence(b1, rmask, n_buckets))
     w1 = shard_buckets(access_incidence(b1, wmask, n_buckets))
     u1 = shard_buckets(access_incidence(b1, rmask | wmask, n_buckets))
     pr1 = shard_buckets(access_incidence(b1, rmask & ~wmask, n_buckets))
-    r2 = w2 = u2 = pr2 = None
+    uo1 = u1 if order_free is None \
+        else shard_buckets(access_incidence(b1, omask, n_buckets))
+    r2 = w2 = u2 = pr2 = uo2 = None
     if exact:
         b2 = bucket_hash(ident, n_buckets, family=1)
         r2 = shard_buckets(access_incidence(b2, rmask, n_buckets))
         w2 = shard_buckets(access_incidence(b2, wmask, n_buckets))
         u2 = shard_buckets(access_incidence(b2, rmask | wmask, n_buckets))
         pr2 = shard_buckets(access_incidence(b2, rmask & ~wmask, n_buckets))
+        uo2 = u2 if order_free is None \
+            else shard_buckets(access_incidence(b2, omask, n_buckets))
     return Incidence(r1=r1, w1=w1, u1=u1, pr1=pr1, r2=r2, w2=w2, u2=u2,
-                     pr2=pr2, bucket1=b1)
+                     pr2=pr2, bucket1=b1, uo1=uo1, uo2=uo2)

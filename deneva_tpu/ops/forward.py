@@ -64,7 +64,9 @@ class ForwardPlan:
     win      — this lane is the final (max-rank) writer of its key: the
                only lane that must reach the table.
     perm     — flat index into the original [B, A] layout (row-major),
-               for callers that need unsorted coordinates.
+               for callers that need unsorted coordinates; None unless
+               requested (the hot path never unsorts, so it skips
+               carrying the extra sort payload).
     """
 
     keys: jax.Array      # int32[N]
@@ -73,7 +75,7 @@ class ForwardPlan:
     is_write: jax.Array  # bool[N]
     fwd: jax.Array       # int32[N]
     win: jax.Array       # bool[N]
-    perm: jax.Array      # int32[N]
+    perm: jax.Array | None  # int32[N] | None
 
 
 jax.tree_util.register_dataclass(
@@ -119,7 +121,8 @@ def _shift1(x: jax.Array, fill) -> jax.Array:
 
 
 def forward_plan(keys: jax.Array, rank: jax.Array,
-                 is_write: jax.Array, valid: jax.Array) -> ForwardPlan:
+                 is_write: jax.Array, valid: jax.Array,
+                 with_perm: bool = False) -> ForwardPlan:
     """Build the sorted forwarding plan for one epoch.
 
     keys: int32[B, A]; rank: int32[B] unique, >= 0; is_write/valid: bool[B, A].
@@ -133,8 +136,12 @@ def forward_plan(keys: jax.Array, rank: jax.Array,
 
     # one fused sort carries the payload with the keys — materially
     # faster on TPU than argsort + permutation gathers
-    lanes = jnp.arange(n, dtype=jnp.int32)
-    sk, sr, sw, perm = jax.lax.sort((k, r, w, lanes), num_keys=2)
+    perm = None
+    if with_perm:
+        lanes = jnp.arange(n, dtype=jnp.int32)
+        sk, sr, sw, perm = jax.lax.sort((k, r, w, lanes), num_keys=2)
+    else:
+        sk, sr, sw = jax.lax.sort((k, r, w), num_keys=2)
     srd = (sk != big) & ~sw                         # valid reads
     cand = jnp.where(sw, sr, jnp.int32(-1))
 
@@ -167,6 +174,6 @@ def last_earlier_writer(keys: jax.Array, rank: jax.Array,
                         is_write: jax.Array, valid: jax.Array) -> jax.Array:
     """int32[B, A]: ``ForwardPlan.fwd`` unsorted back to the [B, A]
     layout (testing/compatibility entry; the hot path stays sorted)."""
-    p = forward_plan(keys, rank, is_write, valid)
+    p = forward_plan(keys, rank, is_write, valid, with_perm=True)
     out = jnp.zeros_like(p.fwd).at[p.perm].set(p.fwd)
     return out.reshape(keys.shape)

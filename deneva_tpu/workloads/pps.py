@@ -211,6 +211,7 @@ class PPSWorkload:
         is_read = jnp.zeros((n, A), bool)
         is_write = jnp.zeros((n, A), bool)
         valid = jnp.zeros((n, A), bool)
+        order_free = jnp.zeros((n, A), bool)
 
         # access 0: anchor row
         a_tid = jnp.where(anchor_is_part, TID["PARTS"],
@@ -225,6 +226,10 @@ class PPSWorkload:
         is_read = is_read.at[:, 0].set(True)
         is_write = is_write.at[:, 0].set(a_write)
         valid = valid.at[:, 0].set(True)
+        # UPDATEPART is a pure escrow add (PART_AMOUNT += 100, no read
+        # used): order_free — adds commute, while GETPART's accumulator
+        # READ stays ordered against every add (base.build_incidence)
+        order_free = order_free.at[:, 0].set(t == UPDATEPART)
 
         # accesses 1..per: USES/SUPPLIES mapping rows (reads);
         # recon: gather the referenced part keys from the snapshot
@@ -249,9 +254,13 @@ class PPSWorkload:
         is_read = is_read.at[:, 1 + per:1 + 2 * per].set(wmask)
         is_write = is_write.at[:, 1 + per:1 + 2 * per].set(pw)
         valid = valid.at[:, 1 + per:1 + 2 * per].set(wmask)
+        # ORDERPRODUCT's part lanes are pure escrow decrements
+        # (PART_AMOUNT -= 1; the declared read is vestigial): add-add
+        # pairs need no ordering, GETPARTBY* reads of the same parts do
+        order_free = order_free.at[:, 1 + per:1 + 2 * per].set(pw)
 
         return dict(table_ids=tables, keys=keys, is_read=is_read,
-                    is_write=is_write, valid=valid)
+                    is_write=is_write, valid=valid, order_free=order_free)
 
     # -- execution ------------------------------------------------------
     # UPDATE* txns rewrite mapping fields read in the same txn (recon),
