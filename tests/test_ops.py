@@ -185,6 +185,36 @@ def test_precedence_levels_cycle_detection():
     assert unstable[3] and unstable[4] and unstable[5]
 
 
+def test_seg_scan_matches_serial_reference():
+    """The Kogge-Stone segmented scan must be exact for any associative
+    combine — including an unflagged first lane and additive combines
+    (regression: an earlier fill treated 0 as a combine identity)."""
+    import jax.numpy as jnp
+    from deneva_tpu.ops.forward import _seg_scan
+
+    rng = np.random.default_rng(0)
+    combs = {"max": max, "left": lambda a, b: a, "add": lambda a, b: a + b}
+    jcombs = {"max": jnp.maximum, "left": lambda a, b: a,
+              "add": lambda a, b: a + b}
+    for trial in range(25):
+        n = int(rng.integers(1, 50))
+        f = rng.random(n) < 0.25          # flags[0] frequently False
+        v = rng.integers(-9, 9, n)
+        for name in combs:
+            got = np.asarray(_seg_scan(jnp.asarray(f),
+                                       jnp.asarray(v, jnp.int32),
+                                       jcombs[name]))
+            ref = np.empty(n, np.int64)
+            for i in range(n):
+                acc = int(v[i])
+                j = i
+                while not f[j] and j > 0:
+                    j -= 1
+                    acc = combs[name](int(v[j]), acc)
+                ref[i] = acc
+            assert (got == ref).all(), (trial, name)
+
+
 # ---- in-batch read forwarding (ops/forward.py) -------------------------
 
 def test_last_earlier_writer_basic():
