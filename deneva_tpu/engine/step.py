@@ -184,8 +184,9 @@ class Engine:
                 for lvl in range(cfg.exec_subrounds):
                     m = exec_commit & (verdict.level == lvl)
                     # level_exec: each level's committed set is
-                    # write-conflict-free by construction, so executors
-                    # skip the last_writer scatter-max tournament
+                    # write-conflict-free by construction (true conflicts
+                    # are a subset of the hashed over-approximation), so
+                    # executors skip the last_writer scatter-max tournament
                     db = wl.execute(db, queries, m, verdict.order, stats,
                                     level_exec=True)
             else:

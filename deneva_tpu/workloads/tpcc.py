@@ -468,6 +468,11 @@ class TPCCWorkload:
 
     def execute(self, db, q: TPCCQuery, mask: jax.Array, order: jax.Array,
                 stats: dict, fwd_rank=None, level_exec: bool = False):
+        # NOTE: payments usually land at wavefront level 0 (all their
+        # accesses are order_free), but hash-collision FALSE edges can
+        # legitimately assign one a higher level, so every sub-round
+        # must execute its payment mask — skipping "provably empty"
+        # levels here would silently drop those payments' writes.
         db = dict(db)
         is_pay = q.txn_type == TPCC_PAYMENT
         pay = mask & is_pay
