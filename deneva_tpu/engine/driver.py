@@ -66,6 +66,10 @@ def run_simulation(cfg: Config, chunk: int = 50,
     _sync(state)
     per_chunk = max(time.monotonic() - t1, 1e-4)
     target = max(1, min(int(chunk * 1.0 / per_chunk), 20_000))
+    if cfg.checkpoint_path and cfg.checkpoint_every_epochs:
+        # chunks quantize the checkpoint cadence: never stretch a chunk
+        # past the configured checkpoint interval
+        target = min(target, cfg.checkpoint_every_epochs)
     if target > chunk * 2 or target < chunk // 2:
         chunk = target
         state = eng.jit_run(state, chunk)     # one more compile, new n
@@ -88,10 +92,23 @@ def run_simulation(cfg: Config, chunk: int = 50,
                              {"epoch_cnt": float(epochs_total[0])}),
               flush=True)
 
+    # int32 seq/ts wrap guard (see pool.py docstring): next_seq advances
+    # (G + B) per epoch; refuse to run a chunk that could cross 2^31
+    seq_per_chunk = (eng.pool.g + eng.pool.b) * chunk
+
+    def _guard_seq(state):
+        head = int(jax.device_get(state.pool.next_seq))
+        if head > 2**31 - 2 * seq_per_chunk:
+            raise RuntimeError(
+                f"int32 txn-sequence space nearly exhausted (next_seq="
+                f"{head}); shorten the run window or shrink epoch_batch "
+                "(seq advances epoch_batch+gen_chunk per epoch)")
+
     def run_window(state, secs):
         t0 = time.monotonic()
         epochs = 0
         while time.monotonic() - t0 < secs:
+            _guard_seq(state)
             state = eng.jit_run(state, chunk)
             _sync(state)
             epochs += chunk

@@ -20,9 +20,14 @@ first and the CC sweep sees them as earliest — a parked txn *is* its
 pool slot.
 
 Sequence numbers double as timestamps: ``next_seq`` advances by a static
-amount per epoch, giving globally unique, monotone int32 ts (wraps after
-~2^31 txns — beyond any benchmark window; the reference's 64-bit ts has
-the same finite-horizon caveat at larger scale).
+``G + B`` per epoch, giving globally unique, monotone int32 ts.  Concrete
+wrap horizon: at full-pool 64k epochs (G + B = 131072) and the measured
+~80 epochs/s that is ~2^31 / 131072 / 80 ≈ 200 s of wall time; smaller
+epochs push it out proportionally (eb=2048, ~1.5k eps ≈ 6 min).  The
+driver guards the horizon at run time (`driver.run_simulation` raises
+before ``next_seq`` can wrap mid-window) rather than paying TPU-emulated
+int64 compares in the sort/sweep hot paths; the reference's 64-bit ts
+has the same finite-horizon caveat at a scale no run reaches.
 
 **Full-pool epochs** (``batch == capacity``): when one epoch spans the
 entire inflight window — the natural operating point for the forwarding

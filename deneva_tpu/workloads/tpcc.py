@@ -430,8 +430,10 @@ class TPCCWorkload:
         # The warehouse/district/customer accesses are ``order_free``
         # (escrow/commutative semantics): every write on them is a
         # scatter-add (W_YTD/D_YTD/C_BALANCE/C_YTD_PAYMENT/
-        # C_PAYMENT_CNT += ...) or the rank-ordered D_NEXT_O_ID prefix
-        # sum, and every read is of an immutable column (W_TAX, D_TAX,
+        # C_PAYMENT_CNT += ...) or the D_NEXT_O_ID prefix sum
+        # (rank-ordered within each chained sub-round, level-major
+        # across sub-rounds — serializable as (level, rank) order),
+        # and every read is of an immutable column (W_TAX, D_TAX,
         # C_DISCOUNT) — so the batched executor applies them
         # order-exactly with no conflict edges.  The reference's
         # row-level lock managers serialize payments on the warehouse
