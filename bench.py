@@ -62,12 +62,43 @@ def child(platform: str) -> None:
     occ_tput, _ = tput("OCC", 1024 // scale)
     tpu_tput, _ = tput("TPU_BATCH", 65536 // scale,
                        max_txn_in_flight=65536 // scale)
+    # full-payload mode (SIM_FULL_ROW): reference-width rows — 10 fields
+    # x 100 real bytes — move through every gather/scatter.  Table shrinks
+    # to 2M rows so the ~2 GB of payload plus working copies fit HBM.
+    full_tput, _ = tput("TPU_BATCH", 65536 // scale,
+                        max_txn_in_flight=65536 // scale,
+                        sim_full_row=True,
+                        synth_table_size=(1 << 21) // scale)
+    host_occ = _host_occ_tput()
     print(json.dumps({
         "metric": "ycsb_zipf0.9_committed_txns_per_sec",
         "value": round(tpu_tput, 1),
         "unit": "txn/s" if platform == "tpu" else "txn/s (cpu-fallback)",
         "vs_baseline": round(tpu_tput / max(occ_tput, 1e-9), 3),
+        "full_payload_tput": round(full_tput, 1),
+        "host_occ_tput": round(host_occ, 1),
+        "vs_host_occ": round(tpu_tput / host_occ, 3) if host_occ else 0.0,
     }), flush=True)
+
+
+def _host_occ_tput() -> float:
+    """Native host-CPU OCC baseline (native/src/host_occ.cc — the
+    faithful stand-in for the unbuildable reference rundb): same YCSB
+    shape, 4 worker threads like the paper config."""
+    exe = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "native", "build", "host_occ")
+    if not os.path.exists(exe):
+        return 0.0
+    try:
+        out = subprocess.run(
+            [exe, str(1 << 23), "4", "10", "0.9", "0.5", "5.0"],
+            capture_output=True, text=True, timeout=120)
+        for tok in out.stdout.split():
+            if tok.startswith("tput="):
+                return float(tok[5:])
+    except (subprocess.TimeoutExpired, OSError, ValueError):
+        pass
+    return 0.0
 
 
 def main() -> None:

@@ -22,7 +22,8 @@ from deneva_tpu.cc.calvin import validate_calvin, validate_tpu_batch
 from deneva_tpu.cc.maat import validate_maat
 from deneva_tpu.cc.nocc import validate_nocc
 from deneva_tpu.cc.occ import validate_occ
-from deneva_tpu.cc.timestamp import init_to_state, validate_mvcc, validate_timestamp
+from deneva_tpu.cc.timestamp import (init_mvcc_state, init_to_state,
+                                     validate_mvcc, validate_timestamp)
 from deneva_tpu.cc.twopl import validate_no_wait, validate_wait_die
 
 
@@ -57,7 +58,7 @@ _REGISTRY: dict[CCAlg, CCBackend] = {
     CCAlg.OCC: CCBackend(CCAlg.OCC, validate_occ, _NO_STATE),
     CCAlg.TIMESTAMP: CCBackend(CCAlg.TIMESTAMP, validate_timestamp,
                                init_to_state),
-    CCAlg.MVCC: CCBackend(CCAlg.MVCC, validate_mvcc, init_to_state),
+    CCAlg.MVCC: CCBackend(CCAlg.MVCC, validate_mvcc, init_mvcc_state),
     CCAlg.MAAT: CCBackend(CCAlg.MAAT, validate_maat, _NO_STATE),
     CCAlg.CALVIN: CCBackend(CCAlg.CALVIN, validate_calvin, _NO_STATE,
                             chained=True, exempt_order_free=True),

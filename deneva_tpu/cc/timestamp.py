@@ -13,30 +13,51 @@ of the reference's hash-bucketed TimeTable for MAAT).  Within an epoch all
 reads observe the epoch-start snapshot, so the only intra-epoch violation
 is a *reader ordered after a committing writer* (ts_r > ts_w): the reader
 should have seen the writer's value but read the snapshot.  Those RW pairs
-are swept in timestamp order and the later reader loses.  Writer-after-read
-pairs serialize reader-first for free; blind write-write pairs both commit
-with last-writer-wins application — Thomas' write rule, exact because
+are swept in timestamp order and the later reader **waits** — the batch
+analogue of the reference parking the read on the row until the prewrite
+drains (`row_ts.cpp:63-80` buffer_req / `row_mvcc.cpp:252-258`): the
+reader defers with its timestamp intact, and next epoch the writer's value
+is the committed snapshot, which the reader then reads — exactly the value
+the reference's woken waiter gets.  Writer-after-read pairs serialize
+reader-first for free; blind write-write pairs both commit with
+last-writer-wins application — Thomas' write rule, exact because
 ``Verdict.order = ts``.
 
-TIMESTAMP rules (abort conditions):
+TIMESTAMP rules (abort conditions, cross-epoch):
 * read k:  ``wts[k] > ts``  — value from my future already committed
   (`row_ts.cpp` aborts the same read; we cannot time-travel either).
 * write k: ``rts[k] > ts`` or ``wts[k] > ts`` — a future read/write
   already committed against the old value.
 
-MVCC differences:
+MVCC (multi-version) differences:
 * Read-only transactions *always commit*: they serialize at the snapshot
   point (reads of old versions never conflict) — the multi-version win,
   mirroring the reference's read-only fast path (`system/txn.cpp:498-530`)
   made unconditional.
-* Reads of read-write txns still abort on ``wts[k] > ts``: the version the
-  read needs exists in the reference's history list but this build keeps
-  single-version tables (device memory economics, SURVEY §7); the case
-  only arises for txns that kept a stale ts across epochs, and a restart
-  refreshes ts.  Conservative, documented divergence.
+* Pure reads of read-write txns serve **old versions**: a per-bucket ring
+  of the last ``mvcc_his_len`` version-boundary timestamps (the
+  HIS_RECYCLE_LEN-bounded write history, `row_mvcc.cpp:172-196,303-321`)
+  decides whether the version a stale read needs is still retained — the
+  read commits iff ``ts >= min(ring)`` (the oldest retained boundary;
+  version at boundary w serves reads in [w, next boundary)).  Reads older
+  than the retained history abort, exactly like the reference's recycled
+  versions.  Version boundaries are recorded at epoch granularity (one
+  boundary per bucket per epoch — within an epoch the table has a single
+  committed state, so finer boundaries are unobservable).
+* RMW accesses (read & write of one key) must read latest: ``wts[k] > ts``
+  still aborts — serving an old version to a read-modify-write would
+  corrupt the newer committed value.
+* Value fidelity caveat (documented divergence): an old-version read
+  *commits with the correct serialization claim*, but the executed gather
+  returns the current snapshot value, not the historical bytes — version
+  *decisions* are tracked (the CC-observable behavior: commit/abort/order
+  match `row_mvcc.cpp`), version *payloads* are not materialized (device
+  memory economics, SURVEY §7).  Affects only the read-checksum statistic;
+  writes never depend on old-version reads (RMWs read latest, above).
 
 Timestamps are epoch-fresh on restart exactly as the reference re-stamps
-restarted txns (`system/worker_thread.cpp:492-508`).
+restarted txns (`system/worker_thread.cpp:492-508`); deferred (waiting)
+txns keep their birth ts like the reference's parked requests.
 """
 
 from __future__ import annotations
@@ -62,20 +83,53 @@ jax.tree_util.register_dataclass(TOState, data_fields=["rts", "wts"],
                                  meta_fields=[])
 
 
+@dataclass
+class MVCCState:
+    """TOState plus the bounded version-boundary ring (write history)."""
+
+    rts: jax.Array   # int32[K]
+    wts: jax.Array   # int32[K]
+    his: jax.Array   # int32[K, H] recent version-boundary ts (0 = the
+    #                  load-time base version, retained until overwritten)
+    pos: jax.Array   # int32[K] next ring slot per bucket
+
+
+jax.tree_util.register_dataclass(
+    MVCCState, data_fields=["rts", "wts", "his", "pos"], meta_fields=[])
+
+
 def init_to_state(cfg) -> TOState:
     k = cfg.conflict_buckets
     return TOState(rts=jnp.zeros((k,), jnp.int32),
                    wts=jnp.zeros((k,), jnp.int32))
 
 
-def _watermark_aborts(state: TOState, batch: AccessBatch, inc: Incidence,
+def init_mvcc_state(cfg) -> MVCCState:
+    k, h = cfg.conflict_buckets, cfg.mvcc_his_len
+    return MVCCState(rts=jnp.zeros((k,), jnp.int32),
+                     wts=jnp.zeros((k,), jnp.int32),
+                     his=jnp.zeros((k, h), jnp.int32),
+                     pos=jnp.zeros((k,), jnp.int32))
+
+
+def _watermark_aborts(state, batch: AccessBatch, inc: Incidence,
                       mvcc: bool) -> jax.Array:
     """bool[B]: txn violates a cross-epoch watermark."""
     v = batch.valid & batch.active[:, None]
     wts_at = jnp.take(state.wts, inc.bucket1)          # [B, A]
     rts_at = jnp.take(state.rts, inc.bucket1)
     ts = batch.ts[:, None]
-    read_bad = v & batch.is_read & (wts_at > ts)
+    if mvcc:
+        # pure reads serve the retained version at their ts; only reads
+        # older than the bounded history (version recycled,
+        # row_mvcc.cpp:303-321) or RMW reads (must read latest) abort
+        his_min = jnp.take(state.his.min(axis=1), inc.bucket1)
+        pure = batch.is_read & ~batch.is_write
+        rmw = batch.is_read & batch.is_write
+        read_bad = v & ((pure & (wts_at > ts) & (ts < his_min))
+                        | (rmw & (wts_at > ts)))
+    else:
+        read_bad = v & batch.is_read & (wts_at > ts)
     write_bad = v & batch.is_write & ((rts_at > ts) | (wts_at > ts))
     bad = (read_bad | write_bad).any(axis=1)
     if mvcc:
@@ -90,15 +144,28 @@ def _rw_later_reader_edges(cfg, batch: AccessBatch, inc: Incidence):
     return earlier_edges(rw, batch.ts, batch.active)   # j earlier by ts
 
 
-def _commit_watermarks(state: TOState, batch: AccessBatch, inc: Incidence,
-                       commit: jax.Array) -> TOState:
+def _commit_watermarks(state, batch: AccessBatch, inc: Incidence,
+                       commit: jax.Array):
     v = batch.valid & commit[:, None]
     ts = jnp.broadcast_to(batch.ts[:, None], batch.keys.shape)
     r_ts = jnp.where(v & batch.is_read, ts, 0)
     w_ts = jnp.where(v & batch.is_write, ts, 0)
     flat = inc.bucket1.reshape(-1)
-    return TOState(rts=state.rts.at[flat].max(r_ts.reshape(-1)),
-                   wts=state.wts.at[flat].max(w_ts.reshape(-1)))
+    rts = state.rts.at[flat].max(r_ts.reshape(-1))
+    wts = state.wts.at[flat].max(w_ts.reshape(-1))
+    if not isinstance(state, MVCCState):
+        return TOState(rts=rts, wts=wts)
+    # record this epoch's version boundary per written bucket: the ring
+    # keeps the last H boundaries (bounded write history); epoch
+    # granularity is exact because the table exposes one committed state
+    # per epoch
+    epoch_w = jnp.zeros_like(state.wts).at[flat].max(w_ts.reshape(-1))
+    wrote = epoch_w > 0
+    h = state.his.shape[1]
+    slot = jnp.arange(h, dtype=jnp.int32)[None, :] == state.pos[:, None]
+    his = jnp.where(wrote[:, None] & slot, epoch_w[:, None], state.his)
+    pos = jnp.where(wrote, (state.pos + 1) % h, state.pos)
+    return MVCCState(rts=rts, wts=wts, his=his, pos=pos)
 
 
 def _validate_to(cfg, state, batch, inc, mvcc: bool):
@@ -119,8 +186,12 @@ def _validate_to(cfg, state, batch, inc, mvcc: bool):
     # every epoch writer (ts are >= 1), so duplicate-write resolution and
     # the serializability oracle see reader-first order.
     order = jnp.where(ro, 0, batch.ts)
-    v = Verdict(commit=commit, abort=(batch.active & wm_abort) | lose,
-                defer=und, order=order, level=jnp.zeros_like(batch.rank))
+    # a swept-out later reader WAITS (buffered read, row_ts.cpp:63-80):
+    # defer with ts intact — next epoch the writer's value is committed
+    # state and the read proceeds.  Only watermark violations abort.
+    v = Verdict(commit=commit, abort=batch.active & wm_abort,
+                defer=und | lose, order=order,
+                level=jnp.zeros_like(batch.rank))
     return v, _commit_watermarks(state, batch, inc, commit)
 
 

@@ -115,6 +115,12 @@ class Config:
     key_order: bool = False        # sort request keys ascending (KEY_ORDER config.h:106)
     tup_size: int = 100            # bytes per field payload (SIM_FULL_ROW analogue)
     field_per_tuple: int = 10
+    sim_full_row: bool = False     # SIM_FULL_ROW (storage/row.cpp:30): tables
+    #                                materialize real payload bytes
+    #                                (uint8[tup_size] per field); reads
+    #                                checksum real bytes, writes store real
+    #                                bytes.  Off = fingerprint mode (the
+    #                                reference's SIM_FULL_ROW=false default).
     first_part_local: bool = True
     part_per_txn: int = 2
     mpr: float = 0.01              # multi-partition txn rate
@@ -128,6 +134,11 @@ class Config:
     mpr_neworder: float = 0.01     # remote-warehouse item probability
     tpcc_full_schema: bool = False
     cust_per_dist: int = 3000      # CUST_PER_DIST_NORM (config.h:188)
+    tpcc_by_last_index: bool = True  # resolve payment-by-lastname through
+    #                                  the CUSTOMER_LAST nonunique index
+    #                                  (hash probe + postings walk, like
+    #                                  index_hash.cpp:68-100); False =
+    #                                  closed-form arithmetic bypass
     max_items: int = 100000        # MAX_ITEMS_NORM (config.h:187)
     max_items_per_txn: int = 15    # MAX_ITEMS_PER_TXN (config.h:189)
     insert_table_cap: int = 1 << 17  # ring capacity of HISTORY/ORDER/... tables
@@ -221,29 +232,32 @@ class Config:
             _check(self.part_cnt == 1,
                    "device_parts (multi-chip) and part_cnt (multi-process) "
                    "partitioning do not compose yet")
-            _check(self.workload == WorkloadKind.YCSB,
-                   "device_parts > 1 is implemented for the YCSB "
-                   "forwarding executor only")
-            _check(self.cc_alg == CCAlg.TPU_BATCH
-                   and self.mode == Mode.NORMAL,
-                   "device_parts > 1 requires cc_alg=TPU_BATCH in NORMAL "
-                   "mode (the partition-parallel executor is the "
-                   "forwarding path)")
-            # the real invariant is on the PADDED row count the table
-            # allocates (owner-major blocks must split evenly and leave a
-            # free per-block trash row)
-            from deneva_tpu.storage.table import padded_rows
-            nrows = padded_rows(self.synth_table_size)
-            _check(nrows % self.device_parts == 0,
-                   f"padded table rows ({nrows}) must divide over "
-                   "device_parts")
-            _check((self.synth_table_size - 1) // self.device_parts
-                   < nrows // self.device_parts - 1,
-                   "device_parts leaves no free per-block trash row "
-                   "(table too small for this mesh)")
+            # ownership anchors must deal evenly over the mesh blocks
+            # (storage.table.to_mc_layout); each workload's anchor is the
+            # reference's node-partition unit across chips
+            D = self.device_parts
+            if self.workload == WorkloadKind.YCSB:
+                _check(self.synth_table_size % D == 0,
+                       "synth_table_size must divide over device_parts")
+            elif self.workload == WorkloadKind.TPCC:
+                _check(self.num_wh % D == 0,
+                       "num_wh must divide over device_parts "
+                       "(warehouses are the ownership anchor)")
+                _check(self.insert_table_cap % D == 0,
+                       "insert_table_cap must divide over device_parts")
+            elif self.workload == WorkloadKind.PPS:
+                for nm, n in (("pps_parts_cnt", self.pps_parts_cnt),
+                              ("pps_products_cnt", self.pps_products_cnt),
+                              ("pps_suppliers_cnt", self.pps_suppliers_cnt)):
+                    _check(n % D == 0,
+                           f"{nm} must divide over device_parts")
         _check(self.epoch_batch > 0
                and (self.epoch_batch & (self.epoch_batch - 1)) == 0,
                "epoch_batch must be a power of two (tiling discipline)")
+        if self.sim_full_row:
+            _check(self.workload == WorkloadKind.YCSB,
+                   "sim_full_row materializes YCSB payload bytes; TPCC/PPS "
+                   "rows are numeric columns (materialized always)")
         if self.workload == WorkloadKind.YCSB:
             _check(self.max_accesses >= self.req_per_query,
                    "max_accesses must cover req_per_query")

@@ -52,27 +52,38 @@ def run_simulation(cfg: Config, chunk: int = 50,
     if cfg.resume and cfg.checkpoint_path:
         from deneva_tpu.engine.checkpoint import load_state
         state = load_state(cfg.checkpoint_path, state)
+    if cfg.device_parts > 1:
+        # multi-chip: lay the state out over the partition mesh and run
+        # under it (tables owner-major sharded, workloads/mc executor)
+        from deneva_tpu.parallel import make_mesh, make_sharded_run
+        place, run_n = make_sharded_run(eng, make_mesh(cfg.device_parts))
+        state = place(state)
+    else:
+        run_n = eng.jit_run
 
     # compile once (excluded from both windows, like the reference's setup
     # barrier, system/thread.cpp:62-84)
-    state = eng.jit_run(state, chunk)
+    state = run_n(state, chunk)
     _sync(state)
     # adaptive chunking: size each device call to ~1 s — large enough
     # that the per-call sync round-trip (tens of ms on a tunneled chip)
     # stays in the noise, small enough that no single execution
     # approaches the tunnel's multi-second RPC limits
     t1 = time.monotonic()
-    state = eng.jit_run(state, chunk)
+    state = run_n(state, chunk)
     _sync(state)
     per_chunk = max(time.monotonic() - t1, 1e-4)
     target = max(1, min(int(chunk * 1.0 / per_chunk), 20_000))
-    if cfg.checkpoint_path and cfg.checkpoint_every_epochs:
-        # chunks quantize the checkpoint cadence: never stretch a chunk
-        # past the configured checkpoint interval
-        target = min(target, cfg.checkpoint_every_epochs)
-    if target > chunk * 2 or target < chunk // 2:
+    ckpt_bound = cfg.checkpoint_every_epochs \
+        if cfg.checkpoint_path and cfg.checkpoint_every_epochs else 0
+    if ckpt_bound:
+        # chunks quantize the checkpoint cadence: never run a chunk
+        # longer than the configured checkpoint interval
+        target = min(target, ckpt_bound)
+    if target > chunk * 2 or target < chunk // 2 \
+            or (ckpt_bound and chunk > ckpt_bound):
         chunk = target
-        state = eng.jit_run(state, chunk)     # one more compile, new n
+        state = run_n(state, chunk)     # one more compile, new n
         _sync(state)
 
     ckpt_due = [cfg.checkpoint_every_epochs]
@@ -109,7 +120,7 @@ def run_simulation(cfg: Config, chunk: int = 50,
         epochs = 0
         while time.monotonic() - t0 < secs:
             _guard_seq(state)
-            state = eng.jit_run(state, chunk)
+            state = run_n(state, chunk)
             _sync(state)
             epochs += chunk
             epochs_total[0] += chunk
@@ -134,20 +145,19 @@ def run_simulation(cfg: Config, chunk: int = 50,
     st.set("total_runtime", elapsed)
     st.set("epoch_cnt", float(epochs))
     for k in ("generated_cnt", "admitted_cnt", "total_txn_commit_cnt",
-              "total_txn_abort_cnt", "defer_cnt", "write_cnt"):
+              "total_txn_abort_cnt", "unique_txn_abort_cnt", "defer_cnt",
+              "write_cnt"):
         st.set(k, float(after[k] - before[k]))
     commits = after["total_txn_commit_cnt"] - before["total_txn_commit_cnt"]
     aborts = after["total_txn_abort_cnt"] - before["total_txn_abort_cnt"]
-    # unique aborted txns ~= aborts seen once per txn retry chain; the
-    # reference counts first-aborts per txn (stats.h:60-61).  Upper bound
-    # here; exact per-txn tracking lands with the runtime layer.
-    st.set("unique_txn_abort_cnt", float(aborts))
     sec_per_epoch = elapsed / max(epochs, 1)
+    # every committed txn contributes exactly one latency sample (its
+    # commit-epoch minus entry-epoch, engine latency_hist); the weighted
+    # StatsArr keeps the full multiset — no cap, no synthesis
     hist = (after["latency_hist"] - before["latency_hist"]).astype(np.float64)
     if hist.sum() > 0:
         centers = (np.arange(len(hist)) + 0.5) * sec_per_epoch
-        samples = np.repeat(centers, np.minimum(hist, 100000).astype(np.int64))
-        st.arr("client_client_latency").extend(samples)
+        st.arr("client_client_latency").extend_weighted(centers, hist)
     st.set("abort_rate", float(aborts) / max(float(commits + aborts), 1.0))
     if cfg.checkpoint_path:
         from deneva_tpu.engine.checkpoint import save_state

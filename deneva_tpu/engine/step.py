@@ -76,6 +76,7 @@ def init_device_stats() -> dict:
     return {
         "generated_cnt": z(), "admitted_cnt": z(),
         "total_txn_commit_cnt": z(), "total_txn_abort_cnt": z(),
+        "unique_txn_abort_cnt": z(),
         "defer_cnt": z(), "write_cnt": z(), "read_checksum": z(),
         "latency_hist": jnp.zeros((LAT_BUCKETS,), jnp.uint32),
     }
@@ -180,6 +181,14 @@ class Engine:
                     # cannot diverge
                     db = wl.execute(db, queries, None, verdict.order,
                                     stats, fwd_rank=fwd)
+            elif cfg.device_parts > 1:
+                # generic partition-parallel execution (workloads/mc):
+                # replicated verdict, owner-major sharded tables, the
+                # workload's own execute body per chip under shard_map
+                from deneva_tpu.workloads.mc import mc_execute
+                db = mc_execute(cfg, wl, db, queries, exec_commit,
+                                verdict.order, verdict.level, stats,
+                                chained=be.chained and cfg.mode == Mode.NORMAL)
             elif be.chained and cfg.mode == Mode.NORMAL:
                 for lvl in range(cfg.exec_subrounds):
                     m = exec_commit & (verdict.level == lvl)
@@ -196,6 +205,7 @@ class Engine:
         # (reference SIMPLE_MODE / QRY_ONLY_MODE, config.h:276-281)
 
         # 6. update pool + counters (forced txns release like commits)
+        pre_abort_cnt = sel(pool.abort_cnt)   # pre-update: 0 = never aborted
         pool = self.pool.update(pool, slots, active, release,
                                 verdict.abort, state.epoch,
                                 be.fresh_ts_on_restart)
@@ -203,6 +213,11 @@ class Engine:
         stats["total_txn_commit_cnt"] += ncommit
         aborts = verdict.abort if forced is None else verdict.abort | forced
         stats["total_txn_abort_cnt"] += (aborts & active).sum(dtype=jnp.uint32)
+        # exact unique-txn aborts (reference stats.h:60-61 counts each
+        # txn's FIRST abort): the slot's abort_cnt — reset on admission,
+        # bumped per abort — is zero exactly at a txn's first abort
+        stats["unique_txn_abort_cnt"] += (
+            aborts & active & (pre_abort_cnt == 0)).sum(dtype=jnp.uint32)
         stats["defer_cnt"] += (verdict.defer & active).sum(dtype=jnp.uint32)
         # histogram as a one-hot reduction: a 64-bucket scatter-add over
         # the batch serializes on bucket contention on TPU (~4.5 ms at

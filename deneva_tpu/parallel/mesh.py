@@ -69,10 +69,19 @@ def shard_buckets(x: jax.Array) -> jax.Array:
 
 def state_shardings(mesh: Mesh, state: Any):
     """Pytree of NamedSharding for an EngineState: db tables + CC watermark
-    tables shard dim 0 (keyspace slices per 'node'); the rest replicates."""
+    tables shard dim 0 (keyspace slices per 'node'); the rest replicates.
+    Tables marked ``mc_replicated`` (read-only ITEM/USES/SUPPLIES) keep a
+    full copy per device, like the reference's per-node copies."""
+    repl_tables = set()
+    db = getattr(state, "db", None)
+    if isinstance(db, dict):
+        repl_tables = {name for name, t in db.items()
+                       if getattr(t, "mc_replicated", False)}
 
     def spec(path, leaf) -> NamedSharding:
         keys = [getattr(p, "name", getattr(p, "key", None)) for p in path]
+        if "db" in keys and repl_tables.intersection(keys):
+            return NamedSharding(mesh, P())
         shard0 = ("db" in keys or "cc_state" in keys) and hasattr(leaf, "ndim") \
             and leaf.ndim >= 1 and leaf.shape[0] >= mesh.size \
             and leaf.shape[0] % mesh.size == 0

@@ -555,11 +555,10 @@ class ServerNode:
         st.set("total_runtime", end - self._t_meas)
         st.set("epoch_cnt", float(epoch + 1))
         for k in ("total_txn_commit_cnt", "total_txn_abort_cnt",
-                  "defer_cnt", "write_cnt"):
+                  "unique_txn_abort_cnt", "defer_cnt", "write_cnt"):
             st.set(k, float(final[k] - measured[k]))
         commits = final["total_txn_commit_cnt"] - measured["total_txn_commit_cnt"]
         aborts = final["total_txn_abort_cnt"] - measured["total_txn_abort_cnt"]
-        st.set("unique_txn_abort_cnt", float(aborts))
         st.set("abort_rate",
                float(aborts) / max(float(commits + aborts), 1.0))
         st.set("worker_idle_time", self._ph["idle"])

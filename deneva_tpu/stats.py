@@ -29,53 +29,83 @@ class StatsArr:
     """Percentile array (reference `statistics/stats_array.cpp:53-146`).
 
     The reference preallocates a fixed array and either sorts or histograms.
-    Here: an amortized-growth numpy buffer; percentiles computed on demand
-    (same 50/90/95/99 points as `scripts/latency_stats.py:20`).
+    Here: an amortized-growth (value, weight) buffer; ``extend`` appends
+    unit-weight samples, ``extend_weighted`` appends a whole histogram
+    exactly (a bucket of N txns contributes weight N — no synthesized
+    per-sample expansion, no cap).  Percentiles are weighted nearest-rank
+    over the full multiset, matching the reference's sorted-array indexing
+    (`stats_array.cpp:127-146` ``get_idx(pct)``) at any sample count.
     """
 
-    __slots__ = ("_buf", "_n")
+    __slots__ = ("_buf", "_w", "_n")
 
     def __init__(self, cap: int = 4096):
         self._buf = np.empty(max(1, cap), dtype=np.float64)
+        self._w = np.empty(max(1, cap), dtype=np.float64)
         self._n = 0
 
-    def insert(self, v: float) -> None:
-        if self._n == len(self._buf):
-            self._buf = np.resize(self._buf, len(self._buf) * 2)
-        self._buf[self._n] = v
-        self._n += 1
-
-    def extend(self, vs: Iterable[float]) -> None:
-        vs = np.asarray(list(vs) if not isinstance(vs, np.ndarray) else vs,
-                        dtype=np.float64)
-        need = self._n + len(vs)
+    def _grow(self, need: int) -> None:
         if need > len(self._buf):
             cap = len(self._buf)
             while cap < need:
                 cap *= 2
             self._buf = np.resize(self._buf, cap)
+            self._w = np.resize(self._w, cap)
+
+    def insert(self, v: float) -> None:
+        self._grow(self._n + 1)
+        self._buf[self._n] = v
+        self._w[self._n] = 1.0
+        self._n += 1
+
+    def extend(self, vs: Iterable[float], ws: Iterable[float] | None = None
+               ) -> None:
+        vs = np.asarray(list(vs) if not isinstance(vs, np.ndarray) else vs,
+                        dtype=np.float64)
+        need = self._n + len(vs)
+        self._grow(need)
         self._buf[self._n:need] = vs
+        self._w[self._n:need] = 1.0 if ws is None \
+            else np.asarray(ws, dtype=np.float64)
         self._n = need
 
+    def extend_weighted(self, values: np.ndarray, counts: np.ndarray) -> None:
+        """Append a histogram: value[i] occurs counts[i] times (exact)."""
+        values = np.asarray(values, np.float64)
+        counts = np.asarray(counts, np.float64)
+        keep = counts > 0
+        self.extend(values[keep], counts[keep])
+
     def __len__(self) -> int:
-        return self._n
+        return int(self._w[: self._n].sum())
 
     def view(self) -> np.ndarray:
-        return self._buf[: self._n]
+        """Materialized samples (tests / small series); weighted entries
+        expand, so call only when the total count is modest."""
+        return np.repeat(self._buf[: self._n],
+                         self._w[: self._n].astype(np.int64))
 
     def percentile(self, p: float) -> float:
         if self._n == 0:
             return 0.0
-        return float(np.percentile(self.view(), p))
+        order = np.argsort(self._buf[: self._n], kind="stable")
+        vals = self._buf[: self._n][order]
+        cum = np.cumsum(self._w[: self._n][order])
+        total = cum[-1]
+        if total <= 0:
+            return 0.0
+        # nearest-rank over the weighted multiset
+        target = p / 100.0 * total
+        idx = int(np.searchsorted(cum, target, side="left"))
+        return float(vals[min(idx, len(vals) - 1)])
 
     def percentiles(self, ps=(50, 90, 95, 99)) -> dict[str, float]:
-        if self._n == 0:
-            return {f"p{p}": 0.0 for p in ps}
-        vals = np.percentile(self.view(), list(ps))
-        return {f"p{p}": float(v) for p, v in zip(ps, vals)}
+        return {f"p{p}": self.percentile(p) for p in ps}
 
     def mean(self) -> float:
-        return float(self.view().mean()) if self._n else 0.0
+        w = self._w[: self._n]
+        tot = w.sum()
+        return float((self._buf[: self._n] * w).sum() / tot) if tot else 0.0
 
 
 class Stats:
@@ -111,7 +141,7 @@ class Stats:
         for k, v in other.counters.items():
             self.counters[k] += v
         for k, a in other.arrays.items():
-            self.arr(k).extend(a.view())
+            self.arr(k).extend(a._buf[: a._n], a._w[: a._n])
         # Union of run windows: workers measure concurrently, so the
         # aggregate window spans min(start)..max(end), not the sum.
         if other._t_start is not None:

@@ -66,6 +66,7 @@ class DeviceTable:
 
     columns: dict[str, jax.Array]
     row_cnt: jax.Array           # int32 scalar: next free slot
+    #                              (int32[mc_parts] in the stacked layout)
     # -- static metadata --
     name: str
     capacity: int
@@ -73,6 +74,16 @@ class DeviceTable:
     ring: bool = False     # append wraps (windowed retention for insert-only
     #                        tables: HISTORY/ORDER/ORDER-LINE keep the last
     #                        `capacity` rows instead of growing unboundedly)
+    # -- multi-chip layout metadata (see to_mc_layout) --
+    mc_parts: int = 1      # >1: columns hold mc_parts owner-major blocks
+    anchor_rows: int = 1   # rows per ownership anchor (e.g. rows per
+    #                        warehouse for TPCC's warehouse-partitioned
+    #                        tables); owner(slot) = (slot // anchor_rows)
+    #                        % mc_parts
+    mc_replicated: bool = False  # multi-chip runs keep a full copy per
+    #                              device (read-only tables: ITEM, USES,
+    #                              SUPPLIES — same replication choice as
+    #                              the reference's per-node copies)
 
     # ------------------------------------------------------------------
     @classmethod
@@ -123,7 +134,8 @@ class DeviceTable:
             cols[n] = cols[n].at[slots].add(v.astype(cols[n].dtype))
         return self._replace(columns=cols)
 
-    def append(self, rows: dict[str, jax.Array], mask: jax.Array
+    def append(self, rows: dict[str, jax.Array], mask: jax.Array,
+               anchor: jax.Array | None = None
                ) -> tuple["DeviceTable", jax.Array]:
         """Insert up to len(mask) rows; returns (table, slot ids).
 
@@ -131,6 +143,12 @@ class DeviceTable:
         ``row_cnt`` (`table_t::get_new_row` without the latch).  Rows past
         capacity fall into the trash slot and are dropped (callers size
         tables for the run length, as the reference pre-sizes pools).
+
+        ``anchor`` — each row's ownership anchor (e.g. home warehouse):
+        ignored here, consumed by the multi-chip `McTableView.append`,
+        which keeps rows on their owner's block.  Callers pass it
+        unconditionally so single-chip and multi-chip runs share one
+        executor body.
         """
         mask = mask.astype(jnp.int32)
         offs = jnp.cumsum(mask) - mask
@@ -156,9 +174,60 @@ class DeviceTable:
     def _replace(self, **kw) -> "DeviceTable":
         d = dict(columns=self.columns, row_cnt=self.row_cnt, name=self.name,
                  capacity=self.capacity, full_row=self.full_row,
-                 ring=self.ring)
+                 ring=self.ring, mc_parts=self.mc_parts,
+                 anchor_rows=self.anchor_rows,
+                 mc_replicated=self.mc_replicated)
         d.update(kw)
         return DeviceTable(**d)
+
+
+def mc_block_geometry(capacity: int, anchor_rows: int, d_parts: int
+                      ) -> tuple[int, int]:
+    """(data rows per block, padded rows per block) of the stacked layout.
+
+    ``capacity`` global data rows group into ``capacity // anchor_rows``
+    ownership anchors dealt round-robin over ``d_parts`` blocks (the
+    reference's ``key % g_part_cnt`` node striping, `system/global.h:294`,
+    across CHIPS); each block is padded like a standalone table so its
+    tail rows serve as the block-local trash."""
+    R = anchor_rows
+    if capacity % R != 0:
+        raise ValueError(f"capacity {capacity} not a multiple of "
+                         f"anchor_rows {R}")
+    anchors = capacity // R
+    if anchors % d_parts != 0:
+        raise ValueError(f"{anchors} ownership anchors do not divide over "
+                         f"{d_parts} device partitions")
+    local_rows = (anchors // d_parts) * R
+    return local_rows, padded_rows(local_rows)
+
+
+def to_mc_layout(tab: DeviceTable, d_parts: int, anchor_rows: int = 1
+                 ) -> DeviceTable:
+    """Permute a single-device table into the owner-major stacked layout.
+
+    Block ``d`` (rows ``[d*Lb, (d+1)*Lb)`` of every column) holds the rows
+    whose ownership anchor ≡ d (mod d_parts), in anchor order; sharding
+    dim 0 of the result over a ``d_parts`` mesh gives each device exactly
+    its partition, and `workloads.mc.McTableView` translates global slots
+    inside `shard_map` bodies.  Ring tables (empty at load) keep per-block
+    append cursors: ``row_cnt`` becomes int32[d_parts]."""
+    R = anchor_rows
+    local_rows, lb = mc_block_geometry(tab.capacity, R, d_parts)
+    if tab.ring:
+        cols = {n: jnp.zeros((d_parts * lb, *v.shape[1:]), v.dtype)
+                for n, v in tab.columns.items()}
+        row_cnt = jnp.zeros((d_parts,), jnp.int32)
+    else:
+        pos = jnp.arange(d_parts * lb, dtype=jnp.int32)
+        d, j = pos // lb, pos % lb
+        src = ((j // R) * d_parts + d) * R + j % R
+        # block pad rows read the (zero, never-yet-scattered) trash slot
+        src = jnp.where(j < local_rows, src, jnp.int32(tab.capacity))
+        cols = {n: jnp.take(v, src, axis=0) for n, v in tab.columns.items()}
+        row_cnt = jnp.full((d_parts,), local_rows, jnp.int32)
+    return tab._replace(columns=cols, row_cnt=row_cnt, mc_parts=d_parts,
+                        anchor_rows=R)
 
 
 def fill_columns(tab: DeviceTable, n: int, cols: dict) -> DeviceTable:
@@ -183,5 +252,6 @@ def _sanitize(slots: jax.Array, capacity: int,
 jax.tree_util.register_dataclass(
     DeviceTable,
     data_fields=["columns", "row_cnt"],
-    meta_fields=["name", "capacity", "full_row", "ring"],
+    meta_fields=["name", "capacity", "full_row", "ring", "mc_parts",
+                 "anchor_rows", "mc_replicated"],
 )

@@ -46,7 +46,7 @@ from deneva_tpu.config import Config
 from deneva_tpu.ops import last_writer
 from deneva_tpu.storage.catalog import parse_schema
 from deneva_tpu.workloads.base import partition_owned, partition_slot
-from deneva_tpu.storage.table import DeviceTable, fill_columns
+from deneva_tpu.storage.table import DeviceTable, fill_columns, to_mc_layout
 
 _FIELDS = "".join(f"\t10,string,FIELD{i}\n" for i in range(1, 11))
 PPS_SCHEMA = (
@@ -161,6 +161,15 @@ class PPSWorkload:
              {"SUPPLIER_KEY": s // self.per,
               "PART_KEY": _map_part(s // self.per, s % self.per, 2,
                                     self.n_parts)})
+        D = self.cfg.device_parts
+        if D > 1:
+            # anchor keys stripe across chips; the immutable USES/SUPPLIES
+            # mapping tables replicate (what keeps recon local, see class
+            # docstring), exactly like the multi-process deployment
+            for name in ("PARTS", "PRODUCTS", "SUPPLIERS"):
+                db[name] = to_mc_layout(db[name], D)
+            for name in ("USES", "SUPPLIES"):
+                db[name] = db[name]._replace(mc_replicated=True)
         return db
 
     # -- generation (pps_query.cpp:40-120) ------------------------------

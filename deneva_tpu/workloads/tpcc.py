@@ -48,7 +48,7 @@ from deneva_tpu.config import Config
 from deneva_tpu.ops import last_writer
 from deneva_tpu.storage.catalog import parse_schema
 from deneva_tpu.workloads.base import partition_owned, partition_slot
-from deneva_tpu.storage.table import DeviceTable, fill_columns
+from deneva_tpu.storage.table import DeviceTable, fill_columns, to_mc_layout
 
 # ---------------------------------------------------------------------------
 # schema (column set of benchmarks/TPCC_short_schema.txt)
@@ -175,6 +175,47 @@ class TPCCWorkload:
                              "num_wh/max_items/cust_per_dist")
         if (self.n_districts + 1) * 2 * cfg.epoch_batch > lim:
             raise ValueError("num_wh*10*2*epoch_batch must fit int32")
+        if cfg.tpcc_by_last_index:
+            self._build_lastname_index()
+
+    def _build_lastname_index(self):
+        """CUSTOMER_LAST nonunique secondary index (reference
+        `tpcc_wl.cpp` index_insert on custNPKey, probed
+        `index_hash.cpp:68-100`): hash probe on (w, d, lastname) ->
+        packed (postings start, count); the postings array lists the
+        matching customers' c_ids in ascending order, and payment picks
+        the middle one (`tpcc_txn.cpp` run_payment by-last-name).  Global
+        (every node resolves remote customers — queries are generated
+        before planning, like the reference client)."""
+        from deneva_tpu.storage.index import HashIndex
+
+        cpd, names = self.cust_per_dist, self.lastnames
+        c = np.arange(self.n_cust, dtype=np.int64)
+        c_local = (c % cpd).astype(np.int32)
+        dist = (c // cpd).astype(np.int64)
+        lastkey = dist * names + c_local % names        # (w,d,L) composite
+        order = np.lexsort((c_local, lastkey))
+        postings = c_local[order]                       # grouped by lastkey
+        sorted_keys = lastkey[order]
+        uniq, starts, counts = np.unique(sorted_keys, return_index=True,
+                                         return_counts=True)
+        if counts.max() >= 256 or len(postings) >= (1 << 23):
+            raise ValueError("CUSTOMER_LAST packing overflow: shrink "
+                             "cust_per_dist or num_wh")
+        packed = (starts.astype(np.int64) << 8 | counts).astype(np.int32)
+        self.last_idx = HashIndex.build(uniq.astype(np.int32), packed,
+                                        miss_slot=0)
+        self.last_postings = jnp.asarray(postings)
+
+    def _lastname_middle(self, c_w, c_d, lastname):
+        """Middle same-lastname customer via the real index probe."""
+        names = self.lastnames
+        key = (c_w * self.n_dist + c_d) * names + lastname
+        packed = self.last_idx.lookup(key)
+        start, cnt = packed >> 8, packed & 0xFF
+        return jnp.take(self.last_postings,
+                        jnp.clip(start + cnt // 2, 0,
+                                 self.last_postings.shape[0] - 1))
 
     # -- composite keys (tpcc_helper.h:24-30, flattened dense) ----------
     # global keys: CC identity (plan / conflict detection) — same on
@@ -306,6 +347,20 @@ class TPCCWorkload:
         tab("NEW-ORDER", cap, ring=True)
         # lines wrap no earlier than their orders (<= ipt lines per order)
         tab("ORDER-LINE", cap * self.ipt, ring=True)
+
+        D = cfg.device_parts
+        if D > 1:
+            # owner-major stacked layout across chips: warehouses are the
+            # ownership anchor (reference wh_to_part node partition,
+            # `benchmarks/tpcc_helper.cpp`); read-only ITEM replicates
+            # like the reference's per-node copy
+            db["ITEM"] = db["ITEM"]._replace(mc_replicated=True)
+            for name, anchor_rows in (
+                    ("WAREHOUSE", 1), ("DISTRICT", self.n_dist),
+                    ("CUSTOMER", self.n_dist * self.cust_per_dist),
+                    ("STOCK", self.max_items), ("HISTORY", 1),
+                    ("ORDER", 1), ("NEW-ORDER", 1), ("ORDER-LINE", 1)):
+                db[name] = to_mc_layout(db[name], D, anchor_rows)
         return db
 
     # -- generation (tpcc_query.cpp:144-260) ----------------------------
@@ -325,13 +380,18 @@ class TPCCWorkload:
                            jax.random.randint(ks[5], (n,), 0, self.n_dist),
                            d_id)
 
-        # by-last-name 60% resolves to the middle same-lastname customer:
-        # customers with lastname L are {L, L+names, L+2*names, ...}
+        # by-last-name 60% resolves to the middle same-lastname customer
+        # (customers with lastname L are {L, L+names, L+2*names, ...}) —
+        # through the CUSTOMER_LAST index probe (hash + postings walk) on
+        # the generation hot path, or the closed form when disabled
         by_last = jax.random.bernoulli(ks[6], 0.6, (n,))
         names = self.lastnames
         lastname = _nurand(ks[7], 255, names, (n,))
-        per_name = self.cust_per_dist // names
-        mid = lastname + names * (per_name // 2)
+        if cfg.tpcc_by_last_index:
+            mid = self._lastname_middle(c_w_id, c_d_id, lastname)
+        else:
+            per_name = self.cust_per_dist // names
+            mid = lastname + names * (per_name // 2)
         c_direct = _nurand(ks[8], 1023, self.cust_per_dist, (n,))
         c_id = jnp.where(by_last & is_pay, mid, c_direct)
 
@@ -501,7 +561,7 @@ class TPCCWorkload:
         hist, _ = db["HISTORY"].append(
             {"H_C_ID": q.c_id, "H_C_D_ID": q.c_d_id, "H_C_W_ID": q.c_w_id,
              "H_D_ID": q.d_id, "H_W_ID": q.w_id, "H_AMOUNT": q.h_amount},
-            m & self.wh_owned(q.w_id))
+            m & self.wh_owned(q.w_id), anchor=q.w_id)
         db["HISTORY"] = hist
         # W_YTD + D_YTD + 3 customer cols + HISTORY row per payment
         stats["write_cnt"] = stats["write_cnt"] + \
@@ -526,9 +586,13 @@ class TPCCWorkload:
         c_disc = db["CUSTOMER"].gather(
             self.cust_slot(q.w_id, q.d_id, q.c_id),
             ("C_DISCOUNT",))["C_DISCOUNT"]
+        # per-lane integer conversion BEFORE the sum: uint32 addition is
+        # associative, so the multi-chip psum of per-chip partial sums is
+        # bit-identical to the single-chip value (mc.py contract) — a
+        # float sum would round differently per reduction order
         stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
             jnp.where(m, (w_tax + d["D_TAX"] + c_disc) * 1000, 0)
-        ).astype(jnp.uint32)
+            .astype(jnp.uint32), dtype=jnp.uint32)
 
         # o_id = snapshot next_o_id + rank among committed same-district
         # neworders ordered by serialization order
@@ -585,9 +649,11 @@ class TPCCWorkload:
             {"O_ID": o_id, "O_C_ID": q.c_id, "O_D_ID": q.d_id,
              "O_W_ID": q.w_id, "O_ENTRY_D": jnp.full((n,), 2013),
              "O_OL_CNT": q.ol_cnt,
-             "O_ALL_LOCAL": all_local.astype(jnp.int32)}, m_ins)
+             "O_ALL_LOCAL": all_local.astype(jnp.int32)}, m_ins,
+            anchor=q.w_id)
         db["NEW-ORDER"], _ = db["NEW-ORDER"].append(
-            {"NO_O_ID": o_id, "NO_D_ID": q.d_id, "NO_W_ID": q.w_id}, m_ins)
+            {"NO_O_ID": o_id, "NO_D_ID": q.d_id, "NO_W_ID": q.w_id}, m_ins,
+            anchor=q.w_id)
         ol_m = (q.item_valid & m_ins[:, None]).reshape(-1)
         bcast = lambda x: jnp.broadcast_to(x[:, None], (n, I)).reshape(-1)  # noqa: E731
         db["ORDER-LINE"], _ = db["ORDER-LINE"].append(
@@ -596,7 +662,8 @@ class TPCCWorkload:
              "OL_NUMBER": jnp.broadcast_to(jnp.arange(I)[None], (n, I)
                                            ).reshape(-1),
              "OL_I_ID": q.items.reshape(-1),
-             "OL_QUANTITY": q.quantity.reshape(-1)}, ol_m)
+             "OL_QUANTITY": q.quantity.reshape(-1)}, ol_m,
+            anchor=bcast(q.w_id))
 
         stats["write_cnt"] = stats["write_cnt"] + \
             (iv.sum() + m.sum() * 2).astype(jnp.uint32)

@@ -31,7 +31,7 @@ from deneva_tpu.config import Config
 from deneva_tpu.ops import HotSet, Zipfian, forward_plan, last_writer
 from deneva_tpu.storage.catalog import parse_schema
 from deneva_tpu.storage.index import DenseIndex, SortedIndex
-from deneva_tpu.storage.table import DeviceTable
+from deneva_tpu.storage.table import DeviceTable, to_mc_layout
 
 # benchmarks/YCSB_schema.txt: MAIN_TABLE, 10 x 100-byte string fields
 YCSB_SCHEMA = "TABLE=MAIN_TABLE\n" + "".join(
@@ -61,17 +61,42 @@ def _field_fingerprint(key: jax.Array | np.ndarray, version):
     return (k * jnp.uint32(2654435761)) ^ (v * jnp.uint32(0x9E3779B9)) | jnp.uint32(1)
 
 
+def _field_bytes(key, version, nbytes: int) -> jax.Array:
+    """SIM_FULL_ROW payload: uint8[..., nbytes] real field bytes, still a
+    pure function of (key, version) so consistency tests can recompute
+    expected content (reference `storage/row.cpp:30`; the reference fills
+    'hello' + garbage, `ycsb_wl.cpp` init — ours must be
+    version-dependent so forwarded reads are checkable)."""
+    fp = _field_fingerprint(key, version)
+    i = jnp.arange(nbytes, dtype=jnp.uint32)
+    mixed = fp[..., None] * (i * jnp.uint32(2654435761)
+                             + jnp.uint32(0x9E3779B9))
+    return ((mixed >> jnp.uint32(13)) & jnp.uint32(0xFF)).astype(jnp.uint8)
+
+
 def _forward_execute_f0(f0: jax.Array, p, slots: jax.Array, trash):
     """THE forwarding-executor data path, shared verbatim by the
     single-chip `execute` and each shard of `execute_mc` so their
     semantics cannot diverge: reads gather F0 (forwarded lanes take
     f(key, writer rank) instead), the checksum folds over reads, and
     only final writers scatter.  Returns (f0', checksum, write_cnt) —
-    the caller decides whether the scalars need a psum."""
+    the caller decides whether the scalars need a psum.
+
+    ``f0`` is uint32[N] in fingerprint mode or uint8[N, S] under
+    SIM_FULL_ROW — the full-row branch moves the real payload bytes, so
+    benchmark numbers measure reference-width HBM traffic."""
     vals = jnp.take(f0, jnp.where(p.is_read, slots, trash), axis=0)
-    vals = jnp.where(p.fwd >= 0, _field_fingerprint(p.keys, p.fwd), vals)
-    cks = jnp.sum(jnp.where(p.is_read, vals, 0), dtype=jnp.uint32)
-    wvals = _field_fingerprint(p.keys, p.rank).astype(f0.dtype)
+    if f0.ndim == 2:
+        nbytes = f0.shape[1]
+        vals = jnp.where((p.fwd >= 0)[:, None],
+                         _field_bytes(p.keys, p.fwd, nbytes), vals)
+        cks = jnp.sum(jnp.where(p.is_read[:, None], vals, 0),
+                      dtype=jnp.uint32)
+        wvals = _field_bytes(p.keys, p.rank, nbytes)
+    else:
+        vals = jnp.where(p.fwd >= 0, _field_fingerprint(p.keys, p.fwd), vals)
+        cks = jnp.sum(jnp.where(p.is_read, vals, 0), dtype=jnp.uint32)
+        wvals = _field_fingerprint(p.keys, p.rank).astype(f0.dtype)
     f0 = f0.at[jnp.where(p.win, slots, trash)].set(wvals)
     return f0, cks, p.is_write.sum(dtype=jnp.uint32)
 
@@ -83,7 +108,14 @@ class YCSBWorkload:
 
     def __init__(self, cfg: Config):
         self.cfg = cfg
-        self.catalog = parse_schema(YCSB_SCHEMA)
+        # schema at configured width (TUP_SIZE × FIELD_PER_TUPLE,
+        # config.h:150-152); the module-level YCSB_SCHEMA is the
+        # reference default (10 × 100B)
+        self.catalog = parse_schema(
+            "TABLE=MAIN_TABLE\n"
+            + "".join(f"\t{cfg.tup_size},string,F{i}\n"
+                      for i in range(cfg.field_per_tuple))
+            + "INDEX=MAIN_INDEX\n\tMAIN_TABLE,0\n")
         self.n_rows = cfg.synth_table_size
         # partitioned deployment (reference `key % g_part_cnt` node
         # ownership, ycsb_wl.cpp:70-74 / global.h:294): this node stores
@@ -130,33 +162,29 @@ class YCSBWorkload:
 
     # -- loader (ycsb_wl.cpp:125-203) ----------------------------------
     def load(self):
+        full = self.cfg.sim_full_row
         tab = DeviceTable.create(self.catalog.table(TABLE), self.n_local,
-                                 full_row=False)
+                                 full_row=full)
         keys = self._owned_keys()
-        D = self.cfg.device_parts
-        if D > 1:
-            # multi-chip owner-major layout: key k lives at global row
-            # (k % D) * Lb + k // D, so mesh block d holds exactly the
-            # keys ≡ d (mod D) — the reference's strided node partition
-            # (ycsb_wl.cpp:70-74) across CHIPS.  Each block's last row is
-            # its local trash (provably unreachable by valid keys given
-            # the 64-row pad; asserted here).
-            nrows = tab.columns["F0"].shape[0]
-            assert nrows % D == 0, "table pad must divide over device_parts"
-            lb = nrows // D
-            assert (self.n_local - 1) // D < lb - 1, \
-                "need a free per-block trash row (table too small for D)"
-            rows = (keys % D).astype(np.int64) * lb + keys // D
-            col = np.zeros((nrows,), np.uint32)
-            col[rows] = np.asarray(_field_fingerprint(keys, 0))
-            tab.columns["F0"] = jnp.asarray(col)
-            return {TABLE: tab}
-        cols = {"F0": np.asarray(_field_fingerprint(keys, 0))}
-        # remaining fields share the same fingerprint law; only F0 is
-        # touched by queries (ycsb_txn.cpp reads/writes one field)
-        for name, v in cols.items():
-            tab.columns[name] = tab.columns[name].at[:self.n_local].set(
-                jnp.asarray(v))
+        if full:
+            # SIM_FULL_ROW: every field materializes real payload bytes —
+            # rows are reference-width resident data (10 × 100B default)
+            init = _field_bytes(jnp.asarray(keys), 0, self.cfg.tup_size)
+            for name in tab.columns:
+                tab.columns[name] = tab.columns[name].at[
+                    : self.n_local].set(init)
+        else:
+            cols = {"F0": np.asarray(_field_fingerprint(keys, 0))}
+            # remaining fields share the same fingerprint law; only F0 is
+            # touched by queries (ycsb_txn.cpp reads/writes one field)
+            for name, v in cols.items():
+                tab.columns[name] = tab.columns[name].at[
+                    : self.n_local].set(jnp.asarray(v))
+        if self.cfg.device_parts > 1:
+            # multi-chip owner-major stacked layout: mesh block d holds
+            # exactly the keys ≡ d (mod D) — the reference's strided node
+            # partition (ycsb_wl.cpp:70-74) across CHIPS
+            tab = to_mc_layout(tab, self.cfg.device_parts)
         return {TABLE: tab}
 
     # -- query generation (ycsb_query.cpp:303-376) ---------------------
@@ -226,8 +254,6 @@ class YCSBWorkload:
         assert mesh is not None and mesh.size == d_parts, \
             f"execute_mc needs a use_mesh({d_parts}) context"
         tab: DeviceTable = db[TABLE]
-        nrows = tab.columns["F0"].shape[0]
-        lb = nrows // d_parts
         valid = batch.valid & batch.active[:, None]
         big = jnp.int32(jnp.iinfo(jnp.int32).max)
 
@@ -235,7 +261,9 @@ class YCSBWorkload:
             me = jax.lax.axis_index(AXIS)
             owned = valid & (keys % d_parts == me)
             p = forward_plan(keys, rank, is_write, owned)
-            trash = jnp.int32(lb - 1)
+            # f0 here is one owner-major block (to_mc_layout): its last
+            # padded row is the block-local trash
+            trash = jnp.int32(f0.shape[0] - 1)
             slots = jnp.where(p.keys != big, p.keys // d_parts, trash)
             f0, cks, wcnt = _forward_execute_f0(f0, p, slots, trash)
             return f0, jax.lax.psum(cks, AXIS), jax.lax.psum(wcnt, AXIS)
@@ -255,10 +283,11 @@ class YCSBWorkload:
     # -- execution (ycsb_txn.cpp:177-209 collapsed to one batch) -------
     def execute(self, db, q: YCSBQuery, mask: jax.Array, order: jax.Array,
                 stats: dict, fwd_rank=None, level_exec: bool = False):
-        assert self.cfg.device_parts == 1, \
-            "device_parts > 1 executes via execute_mc under a mesh"
         tab: DeviceTable = db[TABLE]
         if fwd_rank is not None:
+            assert self.cfg.device_parts == 1, \
+                "device_parts > 1 forwarding executes via execute_mc " \
+                "under a mesh (the masked path runs through McTableView)"
             # single-pass forwarding executor, in the plan's sorted
             # coordinates (ops/forward.ForwardPlan): a read whose key has
             # an earlier in-batch writer takes that writer's value —
@@ -283,15 +312,18 @@ class YCSBWorkload:
             db = dict(db)
             db[TABLE] = tab._replace(columns={**tab.columns, "F0": f0})
             return db
+        full = self.cfg.sim_full_row
         slots = self.index.lookup(q.keys)                      # [n, R]
         act = mask[:, None] & jnp.ones_like(q.is_write)
-        # reads: gather F0, fold into checksum (keeps the load alive)
+        # reads: gather F0, fold into checksum (keeps the load alive);
+        # through .gather so the multi-chip McTableView can interpose
         rmask = act & ~q.is_write
-        vals = jnp.take(tab.columns["F0"], jnp.where(rmask, slots, tab.capacity),
-                        axis=0)
+        vals = tab.gather(jnp.where(rmask, slots, tab.capacity),
+                          ("F0",))["F0"]
+        rm = rmask[..., None] if full else rmask
         stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
-            jnp.where(rmask, vals, 0), dtype=jnp.uint32)
-        # writes: new fingerprint versioned by serialization order
+            jnp.where(rm, vals, 0), dtype=jnp.uint32)
+        # writes: new payload versioned by serialization order
         wmask = (act & q.is_write).reshape(-1)
         wslots = jnp.where(act & q.is_write, slots, tab.capacity).reshape(-1)
         worder = jnp.broadcast_to(order[:, None], slots.shape).reshape(-1)
@@ -303,7 +335,8 @@ class YCSBWorkload:
             win = wmask
         else:
             win = last_writer(wslots, worder, wmask, tab.capacity)
-        wvals = _field_fingerprint(q.keys.reshape(-1), worder)
+        wvals = _field_bytes(q.keys.reshape(-1), worder, self.cfg.tup_size) \
+            if full else _field_fingerprint(q.keys.reshape(-1), worder)
         db = dict(db)
         db[TABLE] = tab.scatter(wslots, {"F0": wvals}, mask=win)
         stats["write_cnt"] = stats["write_cnt"] + wmask.sum(dtype=jnp.uint32)

@@ -154,11 +154,16 @@ def test_occ_blind_ww_conflicts():
 
 # ---- TIMESTAMP ---------------------------------------------------------
 
-def test_to_reader_after_writer_aborts():
+def test_to_reader_after_writer_waits():
+    # buffered read (row_ts.cpp:63-80): the later reader parks until the
+    # writer's value is committed — defer, not abort
     txns = [[(5, "w")], [(5, "r")]]
-    v, _, b = run("TIMESTAMP", txns, ts=[1, 2])
+    v, st, b = run("TIMESTAMP", txns, ts=[1, 2])
     c, a, d = check_verdict(v, b, txns)
-    assert c[0] and a[1]
+    assert c[0] and d[1] and not a[1]
+    # next epoch the parked reader finds the committed value (wts=1 < 2)
+    v, st, b = run("TIMESTAMP", [[(5, "r")]], ts=[2], state=st)
+    assert np.asarray(v.commit)[0]
 
 def test_to_reader_before_writer_both_commit():
     txns = [[(5, "r")], [(5, "w")]]
@@ -201,9 +206,47 @@ def test_mvcc_rw_txn_still_validates():
     be = get_backend("MVCC")
     st = be.init_state(CFG)
     v, st, _ = run("MVCC", [[(5, "w")]], ts=[10], state=st)
-    # read-write txn with stale write ts aborts (rts/wts watermark)
+    # RMW with stale ts aborts: it must read latest AND its write hits
+    # the wts watermark (row_mvcc.cpp P_REQ conflict)
     v, st, b = run("MVCC", [[(5, "rw")]], ts=[7], state=st)
     assert np.asarray(v.abort)[0]
+
+
+def test_mvcc_version_ring_serves_stale_read():
+    """The round-1 divergence, fixed: a read-WRITE txn whose pure read
+    hits ``wts > ts`` commits when the needed version is retained in the
+    bounded history ring (reference serves the old version,
+    row_mvcc.cpp:264-270) — under TIMESTAMP the same txn aborts."""
+    be = get_backend("MVCC")
+    st = be.init_state(CFG)
+    v, st, _ = run("MVCC", [[(5, "w")]], ts=[10], state=st)
+    txns = [[(5, "r"), (6, "w")]]          # stale read + fresh blind write
+    v, st, b = run("MVCC", txns, ts=[7], state=st)
+    assert np.asarray(v.commit)[0]
+    # same interleaving under single-version T/O: abort
+    be_to = get_backend("TIMESTAMP")
+    st2 = be_to.init_state(CFG)
+    v2, st2, _ = run("TIMESTAMP", [[(5, "w")]], ts=[10], state=st2)
+    v2, st2, _ = run("TIMESTAMP", txns, ts=[7], state=st2)
+    assert np.asarray(v2.abort)[0]
+
+
+def test_mvcc_recycled_version_aborts():
+    """Reads older than the retained history abort, mirroring
+    HIS_RECYCLE_LEN garbage collection (row_mvcc.cpp:303-321): after
+    mvcc_his_len version boundaries, the oldest retained boundary rises
+    above a sufficiently stale reader's ts."""
+    be = get_backend("MVCC")
+    st = be.init_state(CFG)
+    for wts in (10, 20, 30, 40):           # mvcc_his_len = 4 boundaries
+        v, st, _ = run("MVCC", [[(5, "w")]], ts=[wts], state=st)
+        assert np.asarray(v.commit)[0]
+    # ring now [10, 20, 30, 40]: ts 5 predates every retained version
+    v, _, _ = run("MVCC", [[(5, "r"), (6, "w")]], ts=[5], state=st)
+    assert np.asarray(v.abort)[0]
+    # ts 15 is covered by the ts-10 version: served, commits
+    v, _, _ = run("MVCC", [[(5, "r"), (6, "w")]], ts=[15], state=st)
+    assert np.asarray(v.commit)[0]
 
 
 # ---- MAAT --------------------------------------------------------------

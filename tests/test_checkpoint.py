@@ -24,6 +24,7 @@ def _leaves(state):
             for v in jax.tree_util.tree_leaves(state)]
 
 
+@pytest.mark.slow
 def test_resume_is_bit_exact(tmp_path):
     path = str(tmp_path / "ck.npz")
     eng = _engine()
@@ -60,6 +61,7 @@ def test_load_rejects_config_mismatch(tmp_path):
         load_state(path, bad_eng.init_state())
 
 
+@pytest.mark.slow
 def test_driver_resume_round_trip(tmp_path):
     """run_simulation writes a final checkpoint; a resumed simulation
     starts from it (epoch counter advanced, commits accumulate)."""

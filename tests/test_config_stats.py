@@ -35,11 +35,30 @@ def test_config_rejects_unknown_and_bad():
 
 
 def test_stats_arr_percentiles():
+    # weighted nearest-rank, matching the reference's sorted-array
+    # indexing (stats_array.cpp:127-146 get_idx)
     a = StatsArr(cap=4)
     a.extend(range(1, 101))
-    assert a.percentile(50) == pytest.approx(50.5)
-    assert a.percentile(99) == pytest.approx(99.01)
+    assert a.percentile(50) == pytest.approx(50.0)
+    assert a.percentile(99) == pytest.approx(99.0)
     assert len(a) == 100
+
+
+def test_stats_arr_weighted_equals_expanded():
+    """extend_weighted(values, counts) is exactly the expanded multiset —
+    the driver feeds whole latency histograms through this path with no
+    sample cap (round-1 weakness #7 fixed)."""
+    import numpy as np
+    vals = np.array([0.5, 1.5, 2.5, 3.5])
+    counts = np.array([500_000, 300_000, 150_000, 50_000])
+    w = StatsArr()
+    w.extend_weighted(vals, counts)
+    e = StatsArr()
+    e.extend(np.repeat(vals, counts))
+    assert len(w) == counts.sum() == len(e)
+    for p in (50, 90, 95, 99):
+        assert w.percentile(p) == e.percentile(p)
+    assert w.mean() == pytest.approx(e.mean())
 
 
 def test_stats_merge_and_summary_roundtrip():

@@ -1,6 +1,8 @@
 """Experiment harness tests (SURVEY §2.9): experiment map, runner output
 files, `[summary]` parsing round-trip."""
 
+import pytest
+
 from deneva_tpu.config import CCAlg, Config, Mode
 from deneva_tpu.harness import (experiment_map, get_experiment, load_results,
                                 outfile_name, parse_file, results_table)
@@ -44,6 +46,7 @@ def test_outfile_name_encodes_sweep_fields():
     assert name != outfile_name(cfg.replace(synth_table_size=1 << 10))
 
 
+@pytest.mark.slow
 def test_run_point_and_parse_roundtrip(tmp_path):
     cfg = Config(
         workload="YCSB", cc_alg=CCAlg.TPU_BATCH, mode=Mode.NORMAL,
@@ -73,6 +76,7 @@ def test_parse_file_none_when_no_summary(tmp_path):
     assert rows[0]["cc_alg"] == "OCC" and "tput" not in rows[0]
 
 
+@pytest.mark.slow
 def test_plot_renders_pivot(tmp_path):
     from deneva_tpu.harness.plot import render
     from deneva_tpu.harness.run import run_point

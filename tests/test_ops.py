@@ -185,6 +185,7 @@ def test_precedence_levels_cycle_detection():
     assert unstable[3] and unstable[4] and unstable[5]
 
 
+@pytest.mark.slow
 def test_seg_scan_matches_serial_reference():
     """The Kogge-Stone segmented scan must be exact for any associative
     combine — including an unflagged first lane and additive combines
@@ -245,6 +246,7 @@ def test_last_earlier_writer_same_rank_not_own_write():
     assert fwd[0, 0] == -1
 
 
+@pytest.mark.slow
 def test_last_earlier_writer_matches_serial_reference():
     from deneva_tpu.ops import last_earlier_writer
     rng = np.random.default_rng(11)
@@ -289,6 +291,7 @@ def test_overlap_fused_falls_back_and_matches():
                 == np.asarray(overlap(a1, b1))).all()
 
 
+@pytest.mark.slow
 def test_engine_use_pallas_flag_runs():
     """Drive the Pallas kernel through the full engine: tile-eligible
     shapes (B=128, K=512) with the interpreter forced on so the kernel

@@ -21,6 +21,7 @@ def cfg_for(alg):
                   zipf_theta=0.6, max_txn_in_flight=256)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("alg", ["OCC", "TPU_BATCH", "TIMESTAMP"])
 def test_sharded_run_matches_single_device(alg):
     cfg = cfg_for(alg)
@@ -42,6 +43,7 @@ def test_sharded_run_matches_single_device(alg):
         assert (ref_stats[k] == out_stats[k]).all(), k
 
 
+@pytest.mark.slow
 def test_partition_parallel_forwarding_matches_single_device():
     """device_parts=8: tables shard owner-major and each device plans +
     executes only its keyspace partition (ycsb.execute_mc under
@@ -65,6 +67,7 @@ def test_partition_parallel_forwarding_matches_single_device():
         assert (ref_stats[k] == out_stats[k]).all(), k
 
 
+@pytest.mark.slow
 def test_partition_parallel_full_pool_and_forced_aborts():
     """The multi-chip executor composes with full-pool epochs and the
     forced-abort sentinel (forced txns leave the batch before the
@@ -87,6 +90,57 @@ def test_partition_parallel_full_pool_and_forced_aborts():
     assert int(out_stats["total_txn_abort_cnt"]) > 0
     for k in ref_stats:
         assert (ref_stats[k] == out_stats[k]).all(), k
+
+
+def _mc_bit_identity(cfg, seed=7, epochs=10):
+    """stats of an 8-partition run must equal the single-device run
+    bit-for-bit (serial semantics are device-count-invariant; the mc.py
+    executor contract makes every counter exactly reconstructable)."""
+    eng = Engine(cfg, get_workload(cfg))
+    ref = jax.device_get(eng.jit_run(eng.init_state(seed=seed), epochs).stats)
+    cfg8 = cfg.replace(device_parts=8)
+    eng8 = Engine(cfg8, get_workload(cfg8))
+    place, run = make_sharded_run(eng8, make_mesh(8))
+    out = jax.device_get(run(place(eng8.init_state(seed=seed)), epochs).stats)
+    for k in ref:
+        assert (np.asarray(ref[k]) == np.asarray(out[k])).all(), k
+    assert int(out["total_txn_commit_cnt"]) > 0
+    return out
+
+
+TPCC_MC = Config(workload="TPCC", cc_alg="TPU_BATCH", epoch_batch=64,
+                 conflict_buckets=1024, num_wh=8, cust_per_dist=30,
+                 max_items=100, max_accesses=18, max_txn_in_flight=256,
+                 insert_table_cap=1 << 10)
+PPS_MC = Config(workload="PPS", cc_alg="TPU_BATCH", epoch_batch=64,
+                conflict_buckets=1024, pps_parts_cnt=400,
+                pps_products_cnt=80, pps_suppliers_cnt=80, pps_parts_per=4,
+                max_accesses=9, max_txn_in_flight=256)
+
+
+@pytest.mark.parametrize("alg", ["TPU_BATCH", "NO_WAIT"])
+def test_tpcc_partition_parallel_matches_single_device(alg):
+    """TPC-C multi-chip (VERDICT round-1 #1): warehouses shard owner-major
+    (the reference's wh_to_part node partition, `benchmarks/
+    tpcc_helper.cpp`, across chips); remote-customer payments and
+    remote-supply neworder stock rows split across their owners like the
+    reference's remote hops (`tpcc_txn.cpp:332-368`)."""
+    _mc_bit_identity(TPCC_MC.replace(cc_alg=alg))
+
+
+@pytest.mark.parametrize("alg", ["TPU_BATCH", "MAAT"])
+def test_pps_partition_parallel_matches_single_device(alg):
+    """PPS multi-chip: anchor keys stripe across chips; the replicated
+    USES/SUPPLIES mapping tables keep recon local (`pps_wl.cpp`)."""
+    _mc_bit_identity(PPS_MC.replace(cc_alg=alg))
+
+
+def test_ycsb_chained_calvin_partition_parallel():
+    """CALVIN's chained wavefront execution runs partition-parallel: the
+    replicated verdict plays the sequencer broadcast, each chip executes
+    its partition's slice of every level."""
+    out = _mc_bit_identity(cfg_for("CALVIN"))
+    assert int(out["write_cnt"]) > 0
 
 
 def test_state_shardings_partition_tables():

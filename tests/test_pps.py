@@ -115,6 +115,7 @@ def test_escrow_adds_do_not_chain():
     assert defers < max(commits // 5, 10), (commits, defers)
 
 
+@pytest.mark.slow
 def test_part_amount_accounting():
     """Exact accounting per txn type (pure mixes so the audit is exact):
     UPDATEPART adds 100/commit; ORDERPRODUCT subtracts parts_per/commit."""

@@ -50,6 +50,7 @@ def test_loader_shapes_and_invariants():
     assert sq.min() >= 10 and sq.max() <= 100
 
 
+@pytest.mark.slow
 def test_generation_distributions():
     cfg = tpcc_cfg(perc_payment=0.5)
     wl = get_workload(cfg)
@@ -73,6 +74,7 @@ def test_generation_distributions():
 
 @pytest.mark.parametrize("alg", ["NOCC", "OCC", "TPU_BATCH", "CALVIN",
                                  "NO_WAIT", "MVCC"])
+@pytest.mark.slow
 def test_tpcc_runs_and_commits(alg):
     cfg = tpcc_cfg(cc_alg=alg)
     state = run_epochs(cfg)
@@ -82,6 +84,7 @@ def test_tpcc_runs_and_commits(alg):
         assert int(state.stats["total_txn_abort_cnt"]) == 0
 
 
+@pytest.mark.slow
 def test_money_conservation_and_order_consistency():
     """TPC-C audit: sum(D_YTD)+sum(W_YTD) grows by exactly 2x the committed
     payment amounts; orders inserted == sum of D_NEXT_O_ID advances."""
@@ -135,6 +138,7 @@ def test_money_conservation_and_order_consistency():
     assert n_ol >= n_ord * 4
 
 
+@pytest.mark.slow
 def test_order_free_exemption_commit_rate():
     """Warehouse/district/customer accesses are order_free (commutative
     scatter-adds + immutable-column reads), so the deterministic
@@ -153,6 +157,7 @@ def test_order_free_exemption_commit_rate():
         assert defers < max(commits // 10, 5), (alg, commits, defers)
 
 
+@pytest.mark.slow
 def test_stock_quantity_rule():
     """S_QUANTITY stays in (0, 101): the new_order_8 replenish rule."""
     cfg = tpcc_cfg(cc_alg="TPU_BATCH", perc_payment=0.0, num_wh=1,
@@ -176,3 +181,34 @@ def test_ring_append_wraps():
     assert int(t.row_cnt) == 15
     vals = np.sort(np.asarray(t.columns["A"])[:8])
     np.testing.assert_array_equal(vals, np.arange(7, 15))
+
+
+def test_lastname_index_matches_closed_form():
+    """The CUSTOMER_LAST probe path (hash index + postings walk,
+    index_hash.cpp:68-100) resolves exactly the customer the arithmetic
+    closed form picks when per-lastname counts are uniform — the index is
+    the measured path (default on), the closed form the oracle."""
+    cfg = tpcc_cfg()                      # cpd=120 -> names=120, uniform
+    assert cfg.tpcc_by_last_index
+    wl_idx = get_workload(cfg)
+    wl_arith = get_workload(cfg.replace(tpcc_by_last_index=False))
+    rng = jax.random.PRNGKey(11)
+    q1 = wl_idx.generate(rng, 256)
+    q2 = wl_arith.generate(rng, 256)
+    for f in ("txn_type", "w_id", "d_id", "c_id", "c_w_id", "c_d_id"):
+        assert (np.asarray(getattr(q1, f)) ==
+                np.asarray(getattr(q2, f))).all(), f
+
+
+def test_lastname_index_irregular_counts():
+    """cust_per_dist=1500 with 1000 lastnames: lastnames < 500 have two
+    customers, the rest one — the index returns the true middle of the
+    actual run (closed-form arithmetic assumes uniform counts and cannot;
+    this is the case that justifies the index machinery)."""
+    cfg = tpcc_cfg(cust_per_dist=1500)
+    wl = get_workload(cfg)
+    L = jnp.asarray([0, 499, 500, 999], jnp.int32)
+    mid = np.asarray(wl._lastname_middle(
+        jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int32), L))
+    # count 2 -> postings [L, L+1000], middle idx 1; count 1 -> [L]
+    assert mid.tolist() == [1000, 1499, 500, 999]
