@@ -22,8 +22,9 @@ from deneva_tpu.cc.calvin import validate_calvin, validate_tpu_batch
 from deneva_tpu.cc.maat import validate_maat
 from deneva_tpu.cc.nocc import validate_nocc
 from deneva_tpu.cc.occ import validate_occ
-from deneva_tpu.cc.timestamp import (init_mvcc_state, init_to_state,
-                                     validate_mvcc, validate_timestamp)
+from deneva_tpu.cc.timestamp import (commit_to_state, init_mvcc_state,
+                                     init_to_state, validate_mvcc,
+                                     validate_timestamp)
 from deneva_tpu.cc.twopl import validate_no_wait, validate_wait_die
 
 
@@ -45,6 +46,10 @@ class CCBackend:
     # ordering; the executor applies them order-exactly).  Lock/ts-based
     # baselines keep the reference's row-level conflicts.
     exempt_order_free: bool = False
+    # distributed VOTE protocol hook: apply cross-epoch state for the
+    # GLOBALLY decided commit set (local validation's state output is
+    # discarded at prepare time).  None = stateless backend.
+    commit_state: Any = None
 
 
 _NO_STATE = lambda cfg: ()  # noqa: E731
@@ -57,8 +62,9 @@ _REGISTRY: dict[CCAlg, CCBackend] = {
                               fresh_ts_on_restart=False),
     CCAlg.OCC: CCBackend(CCAlg.OCC, validate_occ, _NO_STATE),
     CCAlg.TIMESTAMP: CCBackend(CCAlg.TIMESTAMP, validate_timestamp,
-                               init_to_state),
-    CCAlg.MVCC: CCBackend(CCAlg.MVCC, validate_mvcc, init_mvcc_state),
+                               init_to_state, commit_state=commit_to_state),
+    CCAlg.MVCC: CCBackend(CCAlg.MVCC, validate_mvcc, init_mvcc_state,
+                          commit_state=commit_to_state),
     CCAlg.MAAT: CCBackend(CCAlg.MAAT, validate_maat, _NO_STATE),
     CCAlg.CALVIN: CCBackend(CCAlg.CALVIN, validate_calvin, _NO_STATE,
                             chained=True, exempt_order_free=True),

@@ -66,6 +66,13 @@ class AccessBatch:
     ts: jax.Array          # int32[B] timestamp (T/O priority; WAIT_DIE age)
     rank: jax.Array        # int32[B] arrival/sequence rank (lock/queue order)
     active: jax.Array      # bool[B]
+    # bool[B] | None: txn is GLOBALLY read-only.  None (default) = derive
+    # from valid & is_write.  The distributed VOTE protocol masks valid
+    # down to locally-owned accesses, which would make a cross-partition
+    # rw-txn look read-only to a node owning only its reads and skip
+    # read validation (MVCC's ro fast path) — the unmasked plan's mask
+    # rides here so every node classifies identically.
+    ro_hint: jax.Array | None = None
 
     @property
     def shape(self) -> tuple[int, int]:
@@ -75,7 +82,7 @@ class AccessBatch:
 jax.tree_util.register_dataclass(
     AccessBatch,
     data_fields=["table_ids", "keys", "is_read", "is_write", "valid",
-                 "ts", "rank", "active"],
+                 "ts", "rank", "active", "ro_hint"],
     meta_fields=[],
 )
 
@@ -132,7 +139,7 @@ def build_conflict_incidence(cfg, be, batch: AccessBatch,
     distributed server step so their conflict semantics cannot diverge."""
     if not be.needs_incidence:
         return None
-    if not be.exempt_order_free:
+    if not be.exempt_order_free or not cfg.escrow_order_free:
         order_free = None
     return build_incidence(batch, cfg.conflict_buckets, cfg.conflict_exact,
                            order_free=order_free)

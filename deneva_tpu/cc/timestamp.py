@@ -112,6 +112,16 @@ def init_mvcc_state(cfg) -> MVCCState:
                      pos=jnp.zeros((k,), jnp.int32))
 
 
+def _readonly(batch: AccessBatch) -> jax.Array:
+    """bool[B]: read-only txns.  Prefers the GLOBAL ``ro_hint`` (set by
+    the distributed VOTE prepare, whose valid mask covers only locally
+    owned accesses) over the local derivation."""
+    if batch.ro_hint is not None:
+        return batch.ro_hint
+    v = batch.valid & batch.active[:, None]
+    return ~(v & batch.is_write).any(axis=1)
+
+
 def _watermark_aborts(state, batch: AccessBatch, inc: Incidence,
                       mvcc: bool) -> jax.Array:
     """bool[B]: txn violates a cross-epoch watermark."""
@@ -133,8 +143,7 @@ def _watermark_aborts(state, batch: AccessBatch, inc: Incidence,
     write_bad = v & batch.is_write & ((rts_at > ts) | (wts_at > ts))
     bad = (read_bad | write_bad).any(axis=1)
     if mvcc:
-        ro = ~(v & batch.is_write).any(axis=1)         # read-only: snapshot
-        bad = bad & ~ro
+        bad = bad & ~_readonly(batch)       # read-only: snapshot
     return bad
 
 
@@ -172,8 +181,7 @@ def _validate_to(cfg, state, batch, inc, mvcc: bool):
     wm_abort = _watermark_aborts(state, batch, inc, mvcc)
     live = batch.active & ~wm_abort
     if mvcc:
-        v = batch.valid & batch.active[:, None]
-        ro = ~(v & batch.is_write).any(axis=1)
+        ro = _readonly(batch)
     else:
         ro = jnp.zeros(batch.active.shape, bool)
     # read-only MVCC txns leave the conflict graph entirely
@@ -193,6 +201,16 @@ def _validate_to(cfg, state, batch, inc, mvcc: bool):
                 defer=und | lose, order=order,
                 level=jnp.zeros_like(batch.rank))
     return v, _commit_watermarks(state, batch, inc, commit)
+
+
+def commit_to_state(cfg, state, batch: AccessBatch, inc: Incidence,
+                    commit: jax.Array):
+    """Post-decision watermark application for the distributed VOTE
+    protocol: local validation's state output is discarded and the
+    watermarks advance only for *globally* committed txns (the
+    reference's row managers likewise update ts state on the 2PC commit
+    path, not at prepare)."""
+    return _commit_watermarks(state, batch, inc, commit)
 
 
 def validate_timestamp(cfg, state, batch: AccessBatch, inc: Incidence):

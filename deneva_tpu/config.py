@@ -189,6 +189,14 @@ class Config:
     sweep_rounds: int = 24         # serialization-sweep fixpoint iterations (chain depth cap)
     exec_subrounds: int = 4        # chained-execution levels per epoch (CALVIN/TPU_BATCH)
     mvcc_his_len: int = 4          # in-state version history depth (HIS_RECYCLE_LEN analogue)
+    escrow_order_free: bool = True  # honor workload order_free (escrow/
+    #                                 commutative) declarations in the
+    #                                 deterministic backends' conflict
+    #                                 graphs; False = ablation: TPU_BATCH/
+    #                                 CALVIN see the full RW-sets like the
+    #                                 lock/ts baselines (separates the
+    #                                 algorithm win from the annotation win
+    #                                 in TPC-C/PPS numbers)
     seq_batch_timer_us: float = 5000.0  # Calvin epoch cadence (config.h:348)
 
     # ---- device mesh ----
@@ -208,6 +216,21 @@ class Config:
 
     # ---- deployment (harness): in-process engine vs multi-process cluster
     deploy: str = "inproc"         # inproc | cluster
+    dist_protocol: str = "auto"    # cluster coordination for non-deterministic
+    #                                backends (reference 2PC,
+    #                                system/txn.cpp:498-606):
+    #                                auto   — deterministic backends use the
+    #                                         merged-batch sequencer exchange;
+    #                                         lock/ts/occ backends use VOTE
+    #                                vote   — batched 2PC: each server
+    #                                         validates its partition's
+    #                                         accesses locally and the epoch
+    #                                         vote exchange is the prepare
+    #                                         round (commit = every owner
+    #                                         voted yes)
+    #                                merged — every server validates the full
+    #                                         merged batch with global state
+    #                                         (round-1 behavior)
 
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
@@ -290,6 +313,17 @@ class Config:
                f"bad tport_type {self.tport_type!r}")
         _check(self.deploy in ("inproc", "cluster"),
                f"bad deploy {self.deploy!r}")
+        _check(self.dist_protocol in ("auto", "vote", "merged"),
+               f"bad dist_protocol {self.dist_protocol!r}")
+        if self.dist_protocol == "vote":
+            _check(self.cc_alg not in (CCAlg.CALVIN, CCAlg.TPU_BATCH),
+                   "deterministic backends coordinate via the merged-batch "
+                   "sequencer exchange, not 2PC votes")
+            _check(self.cc_alg != CCAlg.MAAT,
+                   "distributed MAAT needs the reference's timestamp-range "
+                   "negotiation; merged mode preserves its semantics")
+            _check(not self.ycsb_abort_mode,
+                   "forced-abort sentinel is a merged-mode debug oracle")
         _check(self.repl_type in ("AP", "AA"),
                f"bad repl_type {self.repl_type!r}")
         if self.workload == WorkloadKind.PPS:

@@ -61,10 +61,17 @@ def run_point(cfg: Config, out_dir: str, quiet: bool = True) -> str:
 
 
 def run_experiment(name: str, quick: bool = False,
-                   out_root: str = "results", quiet: bool = False
-                   ) -> list[dict]:
-    """Run every point of a named experiment; returns parsed result rows."""
+                   out_root: str = "results", quiet: bool = False,
+                   bench: bool = False) -> list[dict]:
+    """Run every point of a named experiment; returns parsed result rows.
+
+    ``bench``: full problem sizes with short measurement windows
+    (1.5 s warmup + 4 s measured) — the single-chip tunnel tier; the
+    reference's 60+60 s windows exist to amortize its thread-level noise,
+    which the chunked device scan does not have."""
     cfgs = get_experiment(name, quick=quick)
+    if bench:
+        cfgs = [c.replace(warmup_secs=1.5, done_secs=4.0) for c in cfgs]
     out_dir = os.path.join(out_root, name)
     if not quiet:
         print(f"[{name}] {len(cfgs)} points -> {out_dir}", flush=True)
@@ -84,6 +91,7 @@ def main(argv: list[str]) -> int:
         return 2
     name = argv[0]
     quick = "--quick" in argv
+    bench = "--bench" in argv
     out_root = "results"
     if "--out" in argv:
         i = argv.index("--out")
@@ -91,7 +99,7 @@ def main(argv: list[str]) -> int:
             print("error: --out needs a directory argument")
             return 2
         out_root = argv[i + 1]
-    rows = run_experiment(name, quick=quick, out_root=out_root)
+    rows = run_experiment(name, quick=quick, out_root=out_root, bench=bench)
     for row in rows:
         tput = row.get("tput", float("nan"))
         print(f"{row['file']}: tput={tput:.1f} "

@@ -67,9 +67,17 @@ def _client_main(cfg: Config, endpoints: str, platform: str | None, q) -> None:
 
 def run_cluster(cfg: Config, platform: str | None = "cpu",
                 run_id: str | None = None,
-                timeout_s: float | None = None) -> dict[int, tuple[str, str]]:
+                timeout_s: float | None = None,
+                client_platform: str | None = None
+                ) -> dict[int, tuple[str, str]]:
     """Spawn node_cnt servers + client_node_cnt clients; returns
-    {node_id: (kind, summary_line)}.  Raises on any node error."""
+    {node_id: (kind, summary_line)}.  Raises on any node error.
+
+    ``platform`` selects the servers' JAX platform; ``client_platform``
+    (default: same) the clients'.  On a single-client TPU tunnel the
+    supported accelerated shape is ONE server on the TPU platform with
+    clients on CPU (node_cnt=1, platform="tpu-ish", client_platform="cpu")
+    — the deployment BASELINE.md's cluster-mode numbers measure."""
     from deneva_tpu.config import WorkloadKind
     from deneva_tpu.runtime.native import ipc_endpoints
 
@@ -116,11 +124,12 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
             args=(cfg.replace(node_id=s, part_cnt=n_srv), endpoints,
                   platform, q),
             daemon=True))
+    cl_platform = client_platform if client_platform is not None else platform
     for c in range(n_cl):
         procs.append(ctx.Process(
             target=_client_main,
             args=(cfg.replace(node_id=n_srv + c, part_cnt=n_srv), endpoints,
-                  platform, q),
+                  cl_platform, q),
             daemon=True))
     for r in range(n_repl):
         procs.append(ctx.Process(

@@ -28,7 +28,7 @@ _lib: C.CDLL | None = None
 RTYPE = {
     "INIT_DONE": 1, "CL_QRY_BATCH": 2, "CL_RSP": 3, "RDONE": 4,
     "EPOCH_BLOB": 5, "LOG_MSG": 6, "LOG_RSP": 7, "PING": 8, "PONG": 9,
-    "SHUTDOWN": 10, "MEASURE": 11,
+    "SHUTDOWN": 10, "MEASURE": 11, "VOTE": 12,
 }
 RTYPE_NAME = {v: k for k, v in RTYPE.items()}
 
@@ -124,7 +124,10 @@ class NativeTransport:
         self.n_nodes = n_nodes
         self._recv_buf = np.empty(1 << 20, np.uint8)
 
-    def start(self, timeout_ms: int = 10000) -> None:
+    def start(self, timeout_ms: int = 120000) -> None:
+        # generous default: a TPU-backed peer jit-compiles its loader
+        # BEFORE starting its transport (~30-40 s over the tunnel), and
+        # CPU peers must keep dialing until it shows up
         if self._lib.dt_start(self._h, timeout_ms) != 0:
             raise RuntimeError(f"node {self.node_id}: mesh setup failed")
 

@@ -126,6 +126,90 @@ def make_dist_step(cfg: Config, wl, be):
     return step
 
 
+def make_vote_steps(cfg: Config, wl, be):
+    """Batched 2PC (VOTE protocol) jits for non-deterministic backends.
+
+    The reference coordinates a multi-partition txn with per-txn
+    prepare/ack round trips (`system/txn.cpp:498-606`); here the whole
+    epoch prepares at once:
+
+    * ``vote(db, cc_state, query, active, ts)`` — each server validates
+      ONLY the accesses it owns (the workload plan's ``owner`` map masks
+      the rest invalid) against its LOCAL cross-epoch state, yielding its
+      per-txn prepare votes.  Soundness: every conflicting access pair
+      shares a key, the key's single owner sees both sides, and every
+      backend's serialization order in vote mode is a *globally shared*
+      total order (rank for locks/OCC, birth-ts for T/O) — so the union
+      of locally-conflict-free commit sets is serializable in that order.
+      (MAAT's locally-derived order is not shared — config rejects it;
+      the reference negotiates its ranges through 2PC payloads instead.)
+    * ``apply(...)`` — after the vote exchange decides (commit = every
+      owner voted yes, abort = any owner voted abort, else wait), execute
+      the decided set locally and advance cross-epoch CC state for
+      GLOBAL commits only (`CCBackend.commit_state` — the reference
+      updates row ts-state on the 2PC commit path, not at prepare).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deneva_tpu.cc import AccessBatch, build_conflict_incidence
+
+    b = max(1, cfg.epoch_batch // cfg.node_cnt) * cfg.node_cnt
+    me = cfg.node_id
+
+    def local_batch(db, query, active, ts):
+        rank = jnp.arange(b, dtype=jnp.int32)
+        planned = wl.plan(db, query)
+        owned = planned["valid"] & (planned["owner"] == jnp.int32(me))
+        # ro_hint: GLOBAL read-only classification from the unmasked plan
+        # — without it a cross-partition rw-txn would look read-only to
+        # the node owning only its reads and skip MVCC read validation
+        ro = ~(planned["valid"] & planned["is_write"]).any(axis=1)
+        batch = AccessBatch(
+            table_ids=planned["table_ids"], keys=planned["keys"],
+            is_read=planned["is_read"], is_write=planned["is_write"],
+            valid=owned, ts=ts, rank=rank, active=active, ro_hint=ro)
+        return batch, planned
+
+    def global_order(batch):
+        # must be identical on every node: locks/OCC serialize in merged
+        # rank order; the T/O family in birth-ts order, with GLOBALLY
+        # read-only MVCC txns at the snapshot point (batch.ro_hint comes
+        # from the unmasked plan so every node agrees)
+        if cfg.cc_alg == CCAlg.TIMESTAMP:
+            return batch.ts
+        if cfg.cc_alg == CCAlg.MVCC:
+            return jnp.where(batch.ro_hint, 0, batch.ts)
+        return batch.rank
+
+    @jax.jit
+    def vote(db, cc_state, query, active, ts):
+        batch, planned = local_batch(db, query, active, ts)
+        inc = build_conflict_incidence(cfg, be, batch,
+                                       planned.get("order_free"))
+        verdict, _ = be.validate(cfg, cc_state, batch, inc)
+        return verdict.commit, verdict.abort, verdict.defer
+
+    @jax.jit
+    def apply(db, cc_state, stats, query, active, ts, commit, abort, defer):
+        batch, planned = local_batch(db, query, active, ts)
+        commit = commit & active
+        abort = abort & active
+        defer = defer & active
+        if be.commit_state is not None:
+            inc = build_conflict_incidence(cfg, be, batch,
+                                           planned.get("order_free"))
+            cc_state = be.commit_state(cfg, cc_state, batch, inc, commit)
+        db = wl.execute(db, query, commit, global_order(batch), stats)
+        stats = dict(stats)
+        stats["total_txn_commit_cnt"] += commit.sum(dtype=jnp.uint32)
+        stats["total_txn_abort_cnt"] += abort.sum(dtype=jnp.uint32)
+        stats["defer_cnt"] += defer.sum(dtype=jnp.uint32)
+        return db, cc_state, stats
+
+    return vote, apply
+
+
 class _RetryQueue:
     """Aborted-txn restart queue with exponential backoff
     (`system/abort_queue.cpp:26-50`); deferred txns re-enter with zero
@@ -199,7 +283,18 @@ class ServerNode:
         self.b_merged = self.b_loc * self.n_srv
         self.wl = get_workload(cfg)
         self.be = get_backend(cfg.cc_alg)
-        self.step = make_dist_step(cfg, self.wl, self.be)
+        from deneva_tpu.ops import forwarding_applies
+        deterministic = self.be.chained or forwarding_applies(self.be,
+                                                              self.wl)
+        self.vote_mode = cfg.dist_protocol == "vote" or (
+            cfg.dist_protocol == "auto" and self.n_srv > 1
+            and not deterministic and cfg.cc_alg != CCAlg.MAAT
+            and not cfg.ycsb_abort_mode)
+        if self.vote_mode:
+            self.vote_step, self.apply_step = make_vote_steps(
+                cfg, self.wl, self.be)
+        else:
+            self.step = make_dist_step(cfg, self.wl, self.be)
         self.db = self.wl.load()
         self.cc_state = self.be.init_state(cfg)
         self.dev_stats = init_device_stats()
@@ -229,6 +324,8 @@ class ServerNode:
         self.pending: deque[tuple[int, wire.QueryBlock]] = deque()
         self.retry = _RetryQueue(cfg.backoff)
         self.blob_buf: dict[int, dict] = {}
+        self.vote_buf: dict[int, dict] = {}
+        self._uniq_aborts = 0
         self.stop_epoch: int | None = None
         self.measure_epoch: int | None = None
         self.stats = Stats()
@@ -247,6 +344,9 @@ class ServerNode:
         elif rtype == "EPOCH_BLOB":
             epoch, blk, ts = wire.decode_epoch_blob(payload)
             self.blob_buf.setdefault(epoch, {})[src] = (blk, ts)
+        elif rtype == "VOTE":
+            epoch, c, a = wire.decode_vote(payload)
+            self.vote_buf.setdefault(epoch, {})[src] = (c, a)
         elif rtype == "SHUTDOWN":
             self.stop_epoch = wire.decode_shutdown(payload)
         elif rtype == "MEASURE":
@@ -350,6 +450,62 @@ class ServerNode:
             c, _, tags = self._held_rsp.popleft()
             self.tp.send(c, "CL_RSP", wire.encode_cl_rsp(tags))
 
+    # -- batched 2PC round (VOTE protocol; see make_vote_steps) ----------
+    def _vote_epoch(self, epoch: int, query, active_np, active_j, ts_j, tl
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Local prepare -> vote exchange -> global decision -> apply.
+        The vote exchange is the epoch-batched analogue of the
+        reference's per-txn RPREPARE/RACK_PREP round trip — one extra
+        network round per epoch, amortized over the whole batch."""
+        import jax.numpy as jnp
+
+        vc, va, vd = self.vote_step(self.db, self.cc_state, query,
+                                    active_j, ts_j)
+        vc, va, vd = np.asarray(vc), np.asarray(va), np.asarray(vd)
+        if tl:
+            tl.mark("prepare")
+        msg = wire.encode_vote(epoch, vc, va)
+        for p in range(self.n_srv):
+            if p != self.me:
+                self.tp.send(p, "VOTE", msg)
+        self.tp.flush()
+        t0 = time.monotonic()
+        while len(self.vote_buf.get(epoch, {})) < self.n_srv - 1:
+            self._drain(timeout_us=5_000)
+            have = self.vote_buf.get(epoch, {})
+            if len(have) >= self.n_srv - 1:
+                break
+            dead = [p for p in range(self.n_srv)
+                    if p != self.me and p not in have
+                    and not self.tp.peer_alive(p)]
+            if dead:
+                self._drain(timeout_us=50_000)
+                have = self.vote_buf.get(epoch, {})
+                dead = [p for p in dead if p not in have]
+            if dead and len(have) < self.n_srv - 1:
+                raise RuntimeError(
+                    f"server {self.me}: peer server(s) {dead} died "
+                    f"waiting for epoch {epoch} votes")
+            if time.monotonic() - t0 > 60:
+                raise TimeoutError(
+                    f"server {self.me}: epoch {epoch} vote wait: have "
+                    f"{sorted(have)}")
+        self._ph["idle"] += time.monotonic() - t0
+        if tl:
+            tl.mark("votes")
+        commit_g, abort_g = vc.copy(), va.copy()
+        for c, a in self.vote_buf.pop(epoch, {}).values():
+            commit_g &= c
+            abort_g |= a
+        commit_g &= active_np & ~abort_g      # any-abort wins
+        abort_g &= active_np
+        defer_g = active_np & ~commit_g & ~abort_g   # someone waits
+        self.db, self.cc_state, self.dev_stats = self.apply_step(
+            self.db, self.cc_state, self.dev_stats, query, active_j, ts_j,
+            jnp.asarray(commit_g), jnp.asarray(abort_g),
+            jnp.asarray(defer_g))
+        return commit_g, abort_g, defer_g
+
     # -- one global epoch ------------------------------------------------
     def run(self, progress=None) -> Stats:
         import jax
@@ -363,10 +519,19 @@ class ServerNode:
             np.zeros((b, self._width), np.int32),
             np.zeros((b, self._width), np.int8),
             np.zeros((b, self._n_scalars), np.int32))
-        out = self.step(self.db, self.cc_state, self.dev_stats,
-                        jnp.int32(0), jnp.zeros(b, bool),
-                        jnp.zeros(b, jnp.int32), warm_q)
-        jax.block_until_ready(out[3])
+        if self.vote_mode:
+            wa, wt = jnp.zeros(b, bool), jnp.zeros(b, jnp.int32)
+            vc, va, vd = self.vote_step(self.db, self.cc_state, warm_q,
+                                        wa, wt)
+            out = self.apply_step(self.db, self.cc_state, self.dev_stats,
+                                  warm_q, wa, wt, vc & False, va & False,
+                                  vd & False)
+            jax.block_until_ready(out[2]["total_txn_commit_cnt"])
+        else:
+            out = self.step(self.db, self.cc_state, self.dev_stats,
+                            jnp.int32(0), jnp.zeros(b, bool),
+                            jnp.zeros(b, jnp.int32), warm_q)
+            jax.block_until_ready(out[3])
         self.barrier()
         t_start = time.monotonic()
         prog_next = t_start + cfg.prog_timer_secs
@@ -397,6 +562,7 @@ class ServerNode:
                 measured = {k: np.asarray(v) for k, v in
                             jax.device_get(self.dev_stats).items()}
                 self._t_meas = now
+                self._uniq_meas = self._uniq_aborts
             block, abort_cnt, birth_ts = self._contribution(epoch)
             if tl:
                 tl.mark("admit")
@@ -454,15 +620,20 @@ class ServerNode:
                 ts_np[s * self.b_loc: s * self.b_loc + len(ts_s)] = ts_s
             query = self.wl.from_wire(merged.keys, merged.types,
                                       merged.scalars)
+            active_j = jnp.asarray(active_np)
+            ts_j = jnp.asarray(ts_np.astype(np.int32))
             t_step = time.monotonic()
-            self.db, self.cc_state, self.dev_stats, commit, abort, defer = \
-                self.step(self.db, self.cc_state, self.dev_stats,
-                          jnp.int32(epoch), jnp.asarray(active_np),
-                          jnp.asarray(ts_np.astype(np.int32)), query)
-            commit = np.asarray(commit)
+            if self.vote_mode:
+                commit, abort, defer = self._vote_epoch(
+                    epoch, query, active_np, active_j, ts_j, tl)
+            else:
+                (self.db, self.cc_state, self.dev_stats, commit, abort,
+                 defer) = self.step(self.db, self.cc_state, self.dev_stats,
+                                    jnp.int32(epoch), active_j, ts_j, query)
+                commit = np.asarray(commit)
+                abort = np.asarray(abort)
+                defer = np.asarray(defer)
             self._ph["process"] += time.monotonic() - t_step
-            abort = np.asarray(abort)
-            defer = np.asarray(defer)
             if tl:
                 tl.mark("step")
             # respond for my slice; restart my aborted/deferred slice
@@ -496,6 +667,9 @@ class ServerNode:
                         # group commit: hold until epoch is durable
                         self._held_rsp.append(rsp)
             self._flush_held_rsp()
+            # exact unique-txn aborts (stats.h:60-61): first abort of a
+            # txn is the one whose retry counter is still zero
+            self._uniq_aborts += int((abort[mine] & (abort_cnt == 0)).sum())
             restart = (abort | defer)[mine]
             if restart.any():
                 idx = np.where(restart)[0]
@@ -555,8 +729,11 @@ class ServerNode:
         st.set("total_runtime", end - self._t_meas)
         st.set("epoch_cnt", float(epoch + 1))
         for k in ("total_txn_commit_cnt", "total_txn_abort_cnt",
-                  "unique_txn_abort_cnt", "defer_cnt", "write_cnt"):
+                  "defer_cnt", "write_cnt"):
             st.set(k, float(final[k] - measured[k]))
+        # exact first-abort count, tracked host-side in the retry path
+        st.set("unique_txn_abort_cnt",
+               float(self._uniq_aborts - getattr(self, "_uniq_meas", 0)))
         commits = final["total_txn_commit_cnt"] - measured["total_txn_commit_cnt"]
         aborts = final["total_txn_abort_cnt"] - measured["total_txn_abort_cnt"]
         st.set("abort_rate",

@@ -114,6 +114,37 @@ def decode_cl_rsp(buf: bytes) -> np.ndarray:
     return np.frombuffer(buf, np.int64, count=n, offset=_RSP.size)
 
 
+# ---- VOTE (batched 2PC prepare, reference RPREPARE/RACK_PREP,
+# `system/txn.cpp:498-606`): one server's per-txn verdict over the merged
+# epoch batch for the accesses it owns.  Three packed bitsets; commit =
+# every owner voted commit, abort = any owner voted abort. -------------
+
+_VOTE = struct.Struct("<qI")        # epoch, n_txns
+
+
+def encode_vote(epoch: int, commit: np.ndarray, abort: np.ndarray) -> bytes:
+    """Two bitsets suffice: the global wait (defer) set is the complement
+    ``active & ~commit & ~abort`` — a local defer vote is exactly a
+    not-commit-not-abort vote, so shipping it would be redundant."""
+    n = len(commit)
+    return (_VOTE.pack(epoch, n)
+            + np.packbits(commit.astype(bool)).tobytes()
+            + np.packbits(abort.astype(bool)).tobytes())
+
+
+def decode_vote(buf: bytes) -> tuple[int, np.ndarray, np.ndarray]:
+    epoch, n = _VOTE.unpack_from(buf)
+    nb = (n + 7) // 8
+    off = _VOTE.size
+    out = []
+    for _ in range(2):
+        bits = np.unpackbits(np.frombuffer(buf, np.uint8, count=nb,
+                                           offset=off))[:n].astype(bool)
+        out.append(bits)
+        off += nb
+    return epoch, out[0], out[1]
+
+
 # ---- SHUTDOWN ----------------------------------------------------------
 
 def encode_shutdown(stop_epoch: int) -> bytes:

@@ -221,6 +221,8 @@ class PPSWorkload:
         is_write = jnp.zeros((n, A), bool)
         valid = jnp.zeros((n, A), bool)
         order_free = jnp.zeros((n, A), bool)
+        owner = jnp.zeros((n, A), jnp.int32)
+        p_nodes = jnp.int32(self.n_pt)
 
         # access 0: anchor row
         a_tid = jnp.where(anchor_is_part, TID["PARTS"],
@@ -235,6 +237,7 @@ class PPSWorkload:
         is_read = is_read.at[:, 0].set(True)
         is_write = is_write.at[:, 0].set(a_write)
         valid = valid.at[:, 0].set(True)
+        owner = owner.at[:, 0].set(a_key % p_nodes)
         # UPDATEPART is a pure escrow add (PART_AMOUNT += 100, no read
         # used): order_free — adds commute, while GETPART's accumulator
         # READ stays ordered against every add (base.build_incidence)
@@ -255,6 +258,10 @@ class PPSWorkload:
         keys = keys.at[:, 1:1 + per].set(map_key)
         is_read = is_read.at[:, 1:1 + per].set(wmask)
         valid = valid.at[:, 1:1 + per].set(wmask)
+        # USES/SUPPLIES replicate; their immutable reads are validated at
+        # the walk anchor's owner (one participant, never a conflict)
+        anchor = jnp.where(by_prod, q.product_key, q.supplier_key)
+        owner = owner.at[:, 1:1 + per].set((anchor % p_nodes)[:, None])
 
         # accesses 1+per..1+2*per: resolved part rows
         pw = (t == ORDERPRODUCT)[:, None] & wmask
@@ -267,9 +274,11 @@ class PPSWorkload:
         # (PART_AMOUNT -= 1; the declared read is vestigial): add-add
         # pairs need no ordering, GETPARTBY* reads of the same parts do
         order_free = order_free.at[:, 1 + per:1 + 2 * per].set(pw)
+        owner = owner.at[:, 1 + per:1 + 2 * per].set(part_keys % p_nodes)
 
         return dict(table_ids=tables, keys=keys, is_read=is_read,
-                    is_write=is_write, valid=valid, order_free=order_free)
+                    is_write=is_write, valid=valid, order_free=order_free,
+                    owner=owner)
 
     # -- execution ------------------------------------------------------
     # UPDATE* txns rewrite mapping fields read in the same txn (recon),

@@ -476,9 +476,10 @@ class TPCCWorkload:
         is_write = jnp.zeros((n, A), bool)
         valid = jnp.zeros((n, A), bool)
         order_free = jnp.zeros((n, A), bool)
+        owner = jnp.zeros((n, A), jnp.int32)
 
-        def put(a, tid, key, r, w, v, of=False):
-            nonlocal tables, keys, is_read, is_write, valid, order_free
+        def put(a, tid, key, r, w, v, of=False, wh=None):
+            nonlocal tables, keys, is_read, is_write, valid, order_free, owner
             tables = tables.at[:, a].set(tid)
             keys = keys.at[:, a].set(key)
             is_read = is_read.at[:, a].set(r)
@@ -486,6 +487,10 @@ class TPCCWorkload:
             valid = valid.at[:, a].set(v)
             if of is not False:
                 order_free = order_free.at[:, a].set(of)
+            if wh is not None:
+                # access owner = the row's warehouse's node (wh_to_part,
+                # benchmarks/tpcc_helper.cpp) — the VOTE participant map
+                owner = owner.at[:, a].set(wh % jnp.int32(self.n_parts))
 
         # The warehouse/district/customer accesses are ``order_free``
         # (escrow/commutative semantics): every write on them is a
@@ -505,23 +510,27 @@ class TPCCWorkload:
         # 0: warehouse — payment updates W_YTD (run_payment_0), neworder
         #    reads W_TAX (new_order_0)
         wh_write = is_pay & cfg.wh_update
-        put(0, TID["WAREHOUSE"], q.w_id, one, wh_write, one, of=one)
+        put(0, TID["WAREHOUSE"], q.w_id, one, wh_write, one, of=one,
+            wh=q.w_id)
         # 1: district — payment D_YTD += (run_payment_2/3); neworder
         #    D_NEXT_O_ID++ (new_order_2)
         put(1, TID["DISTRICT"], self.dist_key(q.w_id, q.d_id), one, one, one,
-            of=one)
+            of=one, wh=q.w_id)
         # 2: customer — payment balance update at (c_w,c_d); neworder
         #    reads C_DISCOUNT at home (new_order_4)
         ck = jnp.where(is_pay, self.cust_key(q.c_w_id, q.c_d_id, q.c_id),
                        self.cust_key(q.w_id, q.d_id, q.c_id))
-        put(2, TID["CUSTOMER"], ck, one, is_pay, one, of=one)
+        put(2, TID["CUSTOMER"], ck, one, is_pay, one, of=one,
+            wh=jnp.where(is_pay, q.c_w_id, q.w_id))
         # 3..3+I: stock rows (new_order_8); ITEM reads excluded (immutable)
         sk = self.stock_key(q.supply_w, q.items)
         iv = q.item_valid & ~is_pay[:, None]
         for j in range(self.ipt):
-            put(3 + j, TID["STOCK"], sk[:, j], iv[:, j], iv[:, j], iv[:, j])
+            put(3 + j, TID["STOCK"], sk[:, j], iv[:, j], iv[:, j], iv[:, j],
+                wh=q.supply_w[:, j])
         return dict(table_ids=tables, keys=keys, is_read=is_read,
-                    is_write=is_write, valid=valid, order_free=order_free)
+                    is_write=is_write, valid=valid, order_free=order_free,
+                    owner=owner)
 
     # -- execution ------------------------------------------------------
     # NewOrder's stock update is a true RMW (the new quantity depends on

@@ -228,6 +228,9 @@ class YCSBWorkload:
             is_read=~q.is_write,
             is_write=q.is_write,
             valid=jnp.ones(shape, bool),
+            # access owner under modulo striping (GET_NODE_ID,
+            # system/global.h:294) — the VOTE protocol's participant map
+            owner=q.keys % jnp.int32(max(self.n_parts, 1)),
         )
 
     # -- multi-chip execution (partition-parallel forwarding) ----------

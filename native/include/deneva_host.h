@@ -50,6 +50,8 @@ enum dt_rtype {
   DT_PING = 8,        /* NETWORK_TEST ping */
   DT_PONG = 9,        /* NETWORK_TEST pong */
   DT_SHUTDOWN = 10,   /* orderly teardown */
+  DT_MEASURE = 11,    /* epoch-aligned measure-window start */
+  DT_VOTE = 12,       /* batched 2PC prepare votes (RPREPARE/RACK_PREP) */
 };
 
 /* Stats slot indices for dt_stats(). */

@@ -379,3 +379,29 @@ def test_isolation_monotone_commit_counts():
         v, _, _ = run("NO_WAIT", txns, cfg=_iso_cfg(lvl))
         counts.append(int(np.asarray(v.commit).sum()))
     assert counts == sorted(counts)
+
+
+# ---- distributed VOTE prepare classification ---------------------------
+
+def test_mvcc_ro_hint_overrides_local_view():
+    """VOTE-mode soundness: a cross-partition rw-txn whose writes live on
+    another node must NOT take the read-only fast path locally — the
+    global ro_hint (from the unmasked plan) forces read validation, so a
+    recycled-version read still aborts (the review-found hole)."""
+    import dataclasses
+    be = get_backend("MVCC")
+    st = be.init_state(CFG)
+    for wts in (10, 20, 30, 40):
+        v, st, _ = run("MVCC", [[(5, "w")]], ts=[wts], state=st)
+    # locally: only the read of key 5 is owned (the write of key 6 is
+    # masked invalid, as the vote prepare does for remote accesses)
+    batch = make_batch([[(5, "r")]], ts=[5])
+    batch = dataclasses.replace(batch,
+                                ro_hint=jnp.zeros(CFG.epoch_batch, bool))
+    inc = build_incidence(batch, CFG.conflict_buckets, CFG.conflict_exact)
+    v, _ = be.validate(CFG, st, batch, inc)
+    assert np.asarray(v.abort)[0]          # recycled version -> abort
+    # without the hint the same local view looks read-only and commits
+    batch2 = make_batch([[(5, "r")]], ts=[5])
+    v2, _ = be.validate(CFG, st, batch2, inc)
+    assert np.asarray(v2.commit)[0]

@@ -212,3 +212,19 @@ def test_lastname_index_irregular_counts():
         jnp.zeros(4, jnp.int32), jnp.zeros(4, jnp.int32), L))
     # count 2 -> postings [L, L+1000], middle idx 1; count 1 -> [L]
     assert mid.tolist() == [1000, 1499, 500, 999]
+
+
+@pytest.mark.slow
+def test_escrow_ablation_flag():
+    """--escrow_order_free=false makes the deterministic backends see the
+    full RW-sets (no commutativity exemption): still correct, strictly
+    more chaining — the ablation that separates algorithm win from
+    annotation win in BASELINE.md."""
+    cfg = tpcc_cfg(cc_alg="TPU_BATCH", num_wh=2)
+    st_on = run_epochs(cfg, n=15).stats
+    st_off = run_epochs(cfg.replace(escrow_order_free=False), n=15).stats
+    on_c = int(st_on["total_txn_commit_cnt"])
+    off_c = int(st_off["total_txn_commit_cnt"])
+    assert on_c > 0 and off_c > 0
+    # 2 warehouses, payments serialize on warehouse rows: ablation defers
+    assert off_c <= on_c

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Round-2 TPU measurement campaign: sequential (single-client tunnel).
+cd /root/repo
+set -x
+python tools/measure_cluster_tpu.py
+for exp in isolation_levels operating_points escrow_ablation ycsb_skew \
+           ycsb_writes pps_scaling tpcc_scaling ycsb_inflight modes; do
+  timeout 5400 python -m deneva_tpu.harness.run $exp --bench
+done
+echo CAMPAIGN_DONE

@@ -1,0 +1,77 @@
+"""Cluster-mode measurement on the real chip (VERDICT round-1 next #4).
+
+Boots the REAL distributed runtime — native transport mesh, client open
+loop, per-epoch EPOCH_BLOB exchange, deterministic merged validation —
+with the single server process owning the TPU (it inherits the box's
+default JAX platform) and clients pinned to CPU.  This is the one
+accelerated deployment the single-client TPU tunnel admits; multi-server
+scaling shape is measured separately on CPU (`cluster_scaling`).
+
+Writes one results/cluster_tpu/<stem>.out per config (same format as
+harness.run points, parseable by deneva_tpu.harness.parse).
+
+Run from the repo root: python tools/measure_cluster_tpu.py
+(the parent process must not import jax — it only launches node
+processes).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deneva_tpu.config import Config  # noqa: E402
+from deneva_tpu.harness.parse import cfg_header, outfile_name  # noqa: E402
+
+
+def main() -> int:
+    from deneva_tpu.runtime.launch import run_cluster
+
+    base = dict(
+        deploy="cluster", node_cnt=1, client_node_cnt=2,
+        workload="YCSB", zipf_theta=0.9, read_perc=0.5, write_perc=0.5,
+        req_per_query=10, max_accesses=16, synth_table_size=1 << 23,
+        conflict_buckets=8192, warmup_secs=2.0, done_secs=5.0)
+    points = [
+        dict(cc_alg="TPU_BATCH", epoch_batch=4096, max_txn_in_flight=16384),
+        dict(cc_alg="TPU_BATCH", epoch_batch=16384, max_txn_in_flight=65536),
+        dict(cc_alg="CALVIN", epoch_batch=4096, max_txn_in_flight=16384),
+    ]
+    out_dir = os.path.join("results", "cluster_tpu")
+    os.makedirs(out_dir, exist_ok=True)
+    rc = 0
+    for p in points:
+        cfg = Config.from_args(
+            [f"--{k}={v}" for k, v in {**base, **p}.items()])
+        path = os.path.join(out_dir, outfile_name(cfg))
+        t0 = time.monotonic()
+        try:
+            # platform=None -> the server inherits the box default (the
+            # tunneled TPU); clients are forced onto CPU
+            out = run_cluster(cfg, platform=None, client_platform="cpu")
+            body = "".join(f"# node {nid} ({kind}): {line}\n"
+                           for nid, (kind, line) in sorted(out.items())
+                           if nid != 0)
+            body += out[0][1] + "\n"
+            ok = "ok"
+        except Exception:
+            body = "# run failed\n" + "".join(
+                "# " + ln + "\n"
+                for ln in traceback.format_exc().splitlines())
+            ok = "FAILED"
+            rc = 1
+        with open(path, "w") as f:
+            f.write(cfg_header(cfg))
+            f.write(f"# wall_secs={time.monotonic() - t0:.1f}\n")
+            f.write(body)
+        print(f"{outfile_name(cfg)}: {ok} ({time.monotonic() - t0:.1f}s)",
+              flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
