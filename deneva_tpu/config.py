@@ -315,6 +315,14 @@ class Config:
                f"bad deploy {self.deploy!r}")
         _check(self.dist_protocol in ("auto", "vote", "merged"),
                f"bad dist_protocol {self.dist_protocol!r}")
+        if (self.logging or self.replica_cnt) and self.node_cnt > 1 \
+                and self.cc_alg not in (CCAlg.CALVIN, CCAlg.TPU_BATCH):
+            _check(self.dist_protocol == "merged",
+                   "deterministic replay (logging/replication) requires "
+                   "deterministic decisions: the VOTE protocol's "
+                   "partitioned local validation cannot be replayed from "
+                   "the command log alone — set --dist_protocol=merged "
+                   "or use a deterministic backend")
         if self.dist_protocol == "vote":
             _check(self.cc_alg not in (CCAlg.CALVIN, CCAlg.TPU_BATCH),
                    "deterministic backends coordinate via the merged-batch "

@@ -148,6 +148,10 @@ def run_simulation(cfg: Config, chunk: int = 50,
               "total_txn_abort_cnt", "unique_txn_abort_cnt", "defer_cnt",
               "write_cnt"):
         st.set(k, float(after[k] - before[k]))
+    for i, nm in enumerate(getattr(wl, "txn_type_names", ())):
+        for fam in ("commit", "abort"):
+            key = f"{fam}_by_type"
+            st.set(f"{nm}_{fam}_cnt", float(after[key][i] - before[key][i]))
     commits = after["total_txn_commit_cnt"] - before["total_txn_commit_cnt"]
     aborts = after["total_txn_abort_cnt"] - before["total_txn_abort_cnt"]
     sec_per_epoch = elapsed / max(epochs, 1)

@@ -71,7 +71,7 @@ jax.tree_util.register_dataclass(
     meta_fields=[])
 
 
-def init_device_stats() -> dict:
+def init_device_stats(n_txn_types: int = 1) -> dict:
     z = lambda: jnp.zeros((), jnp.uint32)  # noqa: E731
     return {
         "generated_cnt": z(), "admitted_cnt": z(),
@@ -79,7 +79,24 @@ def init_device_stats() -> dict:
         "unique_txn_abort_cnt": z(),
         "defer_cnt": z(), "write_cnt": z(), "read_checksum": z(),
         "latency_hist": jnp.zeros((LAT_BUCKETS,), jnp.uint32),
+        # per-txn-kind commit/abort breakdown (reference Stats_thd's
+        # per-type counter families); names come from
+        # Workload.txn_type_names at summary time
+        "commit_by_type": jnp.zeros((n_txn_types,), jnp.uint32),
+        "abort_by_type": jnp.zeros((n_txn_types,), jnp.uint32),
     }
+
+
+def count_by_type(stats: dict, wl, queries, commit, abort) -> None:
+    """Fold per-type commit/abort one-hots into the device stats (cheap
+    dense compare-and-sum, same shape trick as the latency histogram)."""
+    tt = wl.txn_type_of(queries)
+    n = stats["commit_by_type"].shape[0]
+    onehot = tt[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :]
+    stats["commit_by_type"] = stats["commit_by_type"] + \
+        (onehot & commit[:, None]).sum(axis=0, dtype=jnp.uint32)
+    stats["abort_by_type"] = stats["abort_by_type"] + \
+        (onehot & abort[:, None]).sum(axis=0, dtype=jnp.uint32)
 
 
 class Engine:
@@ -104,7 +121,8 @@ class Engine:
         return EngineState(
             db=db, cc_state=self.backend.init_state(cfg), pool=pool,
             rng=jax.random.PRNGKey(cfg.seed if seed is None else seed),
-            epoch=jnp.zeros((), jnp.int32), stats=init_device_stats())
+            epoch=jnp.zeros((), jnp.int32),
+            stats=init_device_stats(len(self.workload.txn_type_names)))
 
     # ------------------------------------------------------------------
     def step(self, state: EngineState) -> EngineState:
@@ -218,6 +236,8 @@ class Engine:
         # bumped per abort — is zero exactly at a txn's first abort
         stats["unique_txn_abort_cnt"] += (
             aborts & active & (pre_abort_cnt == 0)).sum(dtype=jnp.uint32)
+        count_by_type(stats, wl, queries, exec_commit & active,
+                      aborts & active)
         stats["defer_cnt"] += (verdict.defer & active).sum(dtype=jnp.uint32)
         # histogram as a one-hot reduction: a 64-bucket scatter-add over
         # the batch serializes on bucket contention on TPU (~4.5 ms at

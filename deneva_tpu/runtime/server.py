@@ -121,6 +121,8 @@ def make_dist_step(cfg: Config, wl, be):
         stats["total_txn_commit_cnt"] += commit.sum(dtype=jnp.uint32)
         stats["total_txn_abort_cnt"] += abort.sum(dtype=jnp.uint32)
         stats["defer_cnt"] += defer.sum(dtype=jnp.uint32)
+        from deneva_tpu.engine.step import count_by_type
+        count_by_type(stats, wl, query, commit, abort)
         return db, cc_state, stats, done, abort & ~done, defer
 
     return step
@@ -152,7 +154,8 @@ def make_vote_steps(cfg: Config, wl, be):
     import jax
     import jax.numpy as jnp
 
-    from deneva_tpu.cc import AccessBatch, build_conflict_incidence
+    from deneva_tpu.cc import (AccessBatch, Incidence,
+                               build_conflict_incidence)
 
     b = max(1, cfg.epoch_batch // cfg.node_cnt) * cfg.node_cnt
     me = cfg.node_id
@@ -197,14 +200,23 @@ def make_vote_steps(cfg: Config, wl, be):
         abort = abort & active
         defer = defer & active
         if be.commit_state is not None:
-            inc = build_conflict_incidence(cfg, be, batch,
-                                           planned.get("order_free"))
+            # commit_state consumes only the per-access bucket ids —
+            # build just those, not the full incidence matrices the
+            # prepare phase already paid for
+            from deneva_tpu.ops import bucket_hash, combine_key
+            ident = combine_key(batch.table_ids, batch.keys)
+            inc = Incidence(
+                r1=None, w1=None, u1=None, pr1=None, r2=None, w2=None,
+                u2=None, pr2=None,
+                bucket1=bucket_hash(ident, cfg.conflict_buckets, family=0))
             cc_state = be.commit_state(cfg, cc_state, batch, inc, commit)
         db = wl.execute(db, query, commit, global_order(batch), stats)
         stats = dict(stats)
         stats["total_txn_commit_cnt"] += commit.sum(dtype=jnp.uint32)
         stats["total_txn_abort_cnt"] += abort.sum(dtype=jnp.uint32)
         stats["defer_cnt"] += defer.sum(dtype=jnp.uint32)
+        from deneva_tpu.engine.step import count_by_type
+        count_by_type(stats, wl, query, commit, abort)
         return db, cc_state, stats
 
     return vote, apply
@@ -297,7 +309,8 @@ class ServerNode:
             self.step = make_dist_step(cfg, self.wl, self.be)
         self.db = self.wl.load()
         self.cc_state = self.be.init_state(cfg)
-        self.dev_stats = init_device_stats()
+        self.dev_stats = init_device_stats(
+            len(getattr(self.wl, "txn_type_names", ("txn",))))
 
         self.tp = NativeTransport(self.me, endpoints,
                                   self.n_srv + self.n_cl + self.n_repl,
@@ -490,7 +503,11 @@ class ServerNode:
                 raise TimeoutError(
                     f"server {self.me}: epoch {epoch} vote wait: have "
                     f"{sorted(have)}")
-        self._ph["idle"] += time.monotonic() - t0
+        wait = time.monotonic() - t0
+        self._ph["idle"] += wait
+        # the caller's process-time span covers this whole round: carve
+        # the network wait back out so idle + process partition wall time
+        self._ph["process"] -= wait
         if tl:
             tl.mark("votes")
         commit_g, abort_g = vc.copy(), va.copy()
@@ -731,6 +748,11 @@ class ServerNode:
         for k in ("total_txn_commit_cnt", "total_txn_abort_cnt",
                   "defer_cnt", "write_cnt"):
             st.set(k, float(final[k] - measured[k]))
+        for i, nm in enumerate(getattr(self.wl, "txn_type_names", ())):
+            for fam in ("commit", "abort"):
+                key = f"{fam}_by_type"
+                st.set(f"{nm}_{fam}_cnt",
+                       float(final[key][i] - measured[key][i]))
         # exact first-abort count, tracked host-side in the retry path
         st.set("unique_txn_abort_cnt",
                float(self._uniq_aborts - getattr(self, "_uniq_meas", 0)))

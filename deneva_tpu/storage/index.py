@@ -59,41 +59,40 @@ class HashIndex:
     def build(cls, keys: np.ndarray, slots: np.ndarray, miss_slot: int,
               load_factor: float = 0.5) -> "HashIndex":
         """Host-side vectorized build (loader path, SURVEY §2.5 parallel
-        loaders — here one numpy pass per probe round)."""
+        loaders).
+
+        Batch linear-probe placement: sort entries by home bucket, then
+        ``pos = max(home, prev_pos + 1)`` via a running maximum — the
+        exact table sequential insertion in home-bucket order would
+        build, with no per-probe-round loop (the round-by-round claim
+        scheme this replaces went quadratic on large dense key sets:
+        one cell resolved per round per cluster)."""
         keys = np.asarray(keys, np.int32)
         slots = np.asarray(slots, np.int32)
         assert keys.ndim == 1 and keys.shape == slots.shape
         assert np.all(keys >= 0), "negative keys are reserved"
+        if len(np.unique(keys)) != len(keys):
+            raise ValueError("duplicate keys in unique HashIndex")
         cap = 1
         while cap < max(8, int(len(keys) / load_factor)):
             cap *= 2
+        while True:
+            h = _hash_np(keys, cap).astype(np.int64)
+            order = np.argsort(h, kind="stable")
+            hs = h[order]
+            lane = np.arange(len(hs), dtype=np.int64)
+            pos = np.maximum.accumulate(hs - lane) + lane
+            if len(pos) == 0 or pos.max() < cap:
+                break
+            cap *= 2        # a tail cluster ran past the table: grow
         tab_k = np.full(cap, _EMPTY, np.int32)
         tab_s = np.zeros(cap, np.int32)
-        idx = _hash_np(keys, cap)
-        pending = np.arange(len(keys))
-        max_probe = 0
-        while len(pending):
-            max_probe += 1
-            pos = idx[pending]
-            # last-writer-wins claim; winners are those that read back own id
-            claim = np.full(cap, -1, np.int64)
-            claim[pos] = pending
-            won = claim[pos] == pending
-            # among winners, the cell must actually be free
-            free = tab_k[pos] == _EMPTY
-            place = won & free
-            placed = pending[place]
-            tab_k[idx[placed]] = keys[placed]
-            tab_s[idx[placed]] = slots[placed]
-            dup = tab_k[pos] == keys[pending]  # same key already present
-            if np.any(dup & ~place):
-                raise ValueError("duplicate keys in unique HashIndex")
-            pending = pending[~place]
-            idx[pending] = (idx[pending] + 1) & (cap - 1)
-            if max_probe > cap:
-                raise RuntimeError("hash build failed to converge")
+        tab_k[pos] = keys[order]
+        tab_s[pos] = slots[order]
+        max_probe = int((pos - hs).max()) + 1 if len(pos) else 1
         return cls(keys=jnp.asarray(tab_k), slots=jnp.asarray(tab_s),
-                   cap=cap, max_probe=max(8, max_probe), miss_slot=miss_slot)
+                   cap=cap, max_probe=max(8, max_probe),
+                   miss_slot=miss_slot)
 
     def lookup(self, q: jax.Array) -> jax.Array:
         """Vectorized fixed-depth probe; misses -> miss_slot."""
@@ -212,12 +211,27 @@ class SortedIndex:
 
 
 def _hash_np(k: np.ndarray, cap: int) -> np.ndarray:
-    return ((k.astype(np.uint32) * _MULT) >> np.uint32(16)).astype(np.int64) & (cap - 1)
+    # full-width avalanche (lowbias32-style), then mask: a bare
+    # multiply-shift keeps only 16 useful bits, which collapses any
+    # table larger than 2^16 cells into its head (catastrophic probe
+    # clustering on large key sets)
+    x = k.astype(np.uint32) * _MULT
+    x ^= x >> np.uint32(16)
+    x *= np.uint32(0x7FEB352D)
+    x ^= x >> np.uint32(15)
+    x *= np.uint32(0x846CA68B)
+    x ^= x >> np.uint32(16)
+    return x.astype(np.int64) & (cap - 1)
 
 
 def _hash_jnp(k: jax.Array, cap: int) -> jax.Array:
-    h = (k.astype(jnp.uint32) * jnp.uint32(2654435761)) >> jnp.uint32(16)
-    return (h & jnp.uint32(cap - 1)).astype(jnp.int32)
+    x = k.astype(jnp.uint32) * jnp.uint32(2654435761)
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    return (x & jnp.uint32(cap - 1)).astype(jnp.int32)
 
 
 jax.tree_util.register_dataclass(

@@ -82,6 +82,14 @@ jax.tree_util.register_dataclass(
 
 
 class PPSWorkload:
+    txn_type_names = ("pps_getpart", "pps_getproduct", "pps_getsupplier",
+                      "pps_getpartbyproduct", "pps_getpartbysupplier",
+                      "pps_orderproduct", "pps_updateproductpart",
+                      "pps_updatepart")
+
+    def txn_type_of(self, q: PPSQuery) -> jax.Array:
+        return q.txn_type
+
     def __init__(self, cfg: Config):
         self.cfg = cfg
         self.catalog = parse_schema(PPS_SCHEMA)

@@ -85,6 +85,49 @@ TPCC_SCHEMA = "".join(
     f"TABLE={t}\n" + "".join(f"\t8,{ct},{cn}\n" for cn, ct in cols)
     for t, cols in _SCHEMA_COLS.items())
 
+# TPCC_FULL_SCHEMA extras (reference `benchmarks/TPCC_full_schema.txt`):
+# the columns the short schema drops.  Strings materialize as fingerprint
+# words (storage/table.py); loader fills them deterministically, and the
+# full-schema execution deltas below keep S_YTD/S_ORDER_CNT/OL_* live.
+_FULL_EXTRA = {
+    "WAREHOUSE": [("W_NAME", "string", 10), ("W_STREET_1", "string", 20),
+                  ("W_STREET_2", "string", 20), ("W_CITY", "string", 20),
+                  ("W_STATE", "string", 2), ("W_ZIP", "string", 9)],
+    "DISTRICT": [("D_NAME", "string", 10), ("D_STREET_1", "string", 20),
+                 ("D_STREET_2", "string", 20), ("D_CITY", "string", 20),
+                 ("D_STATE", "string", 2), ("D_ZIP", "string", 9)],
+    "CUSTOMER": [("C_FIRST", "string", 16), ("C_MIDDLE", "string", 2),
+                 ("C_STREET_1", "string", 20), ("C_STREET_2", "string", 20),
+                 ("C_CITY", "string", 20), ("C_STATE", "string", 2),
+                 ("C_ZIP", "string", 9), ("C_PHONE", "string", 16),
+                 ("C_SINCE", "int64_t", 8), ("C_CREDIT", "string", 2),
+                 ("C_CREDIT_LIM", "int64_t", 8),
+                 ("C_DELIVERY_CNT", "uint64_t", 8),
+                 ("C_DATA", "string", 500)],
+    "HISTORY": [("H_DATE", "int64_t", 8), ("H_DATA", "string", 24)],
+    "ORDER": [("O_CARRIER_ID", "int64_t", 8)],
+    "ORDER-LINE": [("OL_SUPPLY_W_ID", "int64_t", 8),
+                   ("OL_DELIVERY_D", "int64_t", 8),
+                   ("OL_AMOUNT", "double", 8),
+                   ("OL_DIST_INFO", "string", 24)],
+    "ITEM": [("I_NAME", "string", 24), ("I_DATA", "string", 50)],
+    "STOCK": [(f"S_DIST_{i:02d}", "string", 24) for i in range(1, 11)]
+             + [("S_YTD", "int64_t", 8), ("S_ORDER_CNT", "int64_t", 8),
+                ("S_DATA", "string", 50)],
+}
+
+
+def tpcc_schema(full: bool) -> str:
+    if not full:
+        return TPCC_SCHEMA
+    out = []
+    for t, cols in _SCHEMA_COLS.items():
+        out.append(f"TABLE={t}\n")
+        out.extend(f"\t8,{ct},{cn}\n" for cn, ct in cols)
+        out.extend(f"\t{sz},{ct},{cn}\n"
+                   for cn, ct, sz in _FULL_EXTRA.get(t, ()))
+    return "".join(out)
+
 # table ids for CC access identity (order matters: stable across runs)
 TID = {name: i for i, name in enumerate(_SCHEMA_COLS)}
 
@@ -136,9 +179,15 @@ def _nurand(key: jax.Array, A: int, n: int, shape) -> jax.Array:
 class TPCCWorkload:
     """Payment + NewOrder over 9 device tables."""
 
+    txn_type_names = ("tpcc_payment", "tpcc_new_order")
+
+    def txn_type_of(self, q: "TPCCQuery") -> jax.Array:
+        return q.txn_type
+
     def __init__(self, cfg: Config):
         self.cfg = cfg
-        self.catalog = parse_schema(TPCC_SCHEMA)
+        self.full_schema = cfg.tpcc_full_schema
+        self.catalog = parse_schema(tpcc_schema(self.full_schema))
         self.n_wh = cfg.num_wh
         self.n_dist = 10                     # DIST_PER_WARE (tpcc_const.h)
         self.cust_per_dist = cfg.cust_per_dist
@@ -347,6 +396,30 @@ class TPCCWorkload:
         tab("NEW-ORDER", cap, ring=True)
         # lines wrap no earlier than their orders (<= ipt lines per order)
         tab("ORDER-LINE", cap * self.ipt, ring=True)
+
+        if self.full_schema:
+            # TPCC_FULL_SCHEMA: fill the extra columns of the fixed
+            # tables with deterministic per-row hashes (the reference
+            # loader draws random strings, tpcc_wl.cpp init_*; ours must
+            # be recomputable for consistency checks)
+            counts = {"WAREHOUSE": self.n_wh_loc,
+                      "DISTRICT": self.n_districts_loc,
+                      "CUSTOMER": self.n_cust_loc, "ITEM": self.max_items,
+                      "STOCK": self.n_stock_loc}
+            for t, extras in _FULL_EXTRA.items():
+                n = counts.get(t)
+                if n is None:          # ring tables fill at insert time
+                    continue
+                cols = dict(db[t].columns)
+                ids = jnp.arange(n, dtype=jnp.int32).astype(jnp.uint32)
+                for j, (cn, _ct, _sz) in enumerate(extras):
+                    if cn in ("S_YTD", "S_ORDER_CNT", "C_DELIVERY_CNT"):
+                        continue       # spec-initialized counters: zero
+                    v = ids * jnp.uint32(2654435761) \
+                        + jnp.uint32(0x9E3779B9) * jnp.uint32(j + 1)
+                    cols[cn] = cols[cn].at[:n].set(
+                        v.astype(cols[cn].dtype))
+                db[t] = db[t]._replace(columns=cols)
 
         D = cfg.device_parts
         if D > 1:
@@ -567,10 +640,17 @@ class TPCCWorkload:
         db["CUSTOMER"] = db["CUSTOMER"].scatter_add(
             ck, {"C_BALANCE": -amt, "C_YTD_PAYMENT": amt,
                  "C_PAYMENT_CNT": m.astype(jnp.int32)}, mask=m)
-        hist, _ = db["HISTORY"].append(
-            {"H_C_ID": q.c_id, "H_C_D_ID": q.c_d_id, "H_C_W_ID": q.c_w_id,
-             "H_D_ID": q.d_id, "H_W_ID": q.w_id, "H_AMOUNT": q.h_amount},
-            m & self.wh_owned(q.w_id), anchor=q.w_id)
+        hist_row = {"H_C_ID": q.c_id, "H_C_D_ID": q.c_d_id,
+                    "H_C_W_ID": q.c_w_id, "H_D_ID": q.d_id,
+                    "H_W_ID": q.w_id, "H_AMOUNT": q.h_amount}
+        if self.full_schema:
+            n = q.w_id.shape[0]
+            hist_row["H_DATE"] = jnp.full((n,), 2013, jnp.int32)
+            hist_row["H_DATA"] = (q.c_id.astype(jnp.uint32)
+                                  * jnp.uint32(0x9E3779B9))
+        hist, _ = db["HISTORY"].append(hist_row,
+                                       m & self.wh_owned(q.w_id),
+                                       anchor=q.w_id)
         db["HISTORY"] = hist
         # W_YTD + D_YTD + 3 customer cols + HISTORY row per payment
         stats["write_cnt"] = stats["write_cnt"] + \
@@ -645,34 +725,50 @@ class TPCCWorkload:
                               stock.capacity)
         stock = stock.scatter(sk, {"S_QUANTITY": new_q}, mask=win)
         remote = (q.supply_w != q.w_id[:, None]).reshape(-1)
-        db["STOCK"] = stock.scatter_add(
-            sk, {"S_REMOTE_CNT": (iv & remote).astype(jnp.int32)},
-            mask=iv & remote)
+        adds = {"S_REMOTE_CNT": (iv & remote).astype(jnp.int32)}
+        if self.full_schema:
+            # full-spec stock bookkeeping (TPC-C §2.4.2.2: s_ytd +=
+            # quantity, s_order_cnt++) — commutative scatter-adds
+            adds["S_YTD"] = jnp.where(iv, qty, 0)
+            adds["S_ORDER_CNT"] = iv.astype(jnp.int32)
+        db["STOCK"] = stock.scatter_add(sk, adds, mask=iv)
 
         # inserts: ORDER, NEW-ORDER, ORDER-LINE (new_order_1 / _3 / _9) —
         # at the home warehouse's owner node only
         m_ins = m & owned
         all_local = jnp.all(~q.item_valid | (q.supply_w == q.w_id[:, None]),
                             axis=1)
-        db["ORDER"], _ = db["ORDER"].append(
-            {"O_ID": o_id, "O_C_ID": q.c_id, "O_D_ID": q.d_id,
-             "O_W_ID": q.w_id, "O_ENTRY_D": jnp.full((n,), 2013),
-             "O_OL_CNT": q.ol_cnt,
-             "O_ALL_LOCAL": all_local.astype(jnp.int32)}, m_ins,
-            anchor=q.w_id)
+        order_row = {"O_ID": o_id, "O_C_ID": q.c_id, "O_D_ID": q.d_id,
+                     "O_W_ID": q.w_id, "O_ENTRY_D": jnp.full((n,), 2013),
+                     "O_OL_CNT": q.ol_cnt,
+                     "O_ALL_LOCAL": all_local.astype(jnp.int32)}
+        if self.full_schema:
+            order_row["O_CARRIER_ID"] = jnp.zeros((n,), jnp.int32)
+        db["ORDER"], _ = db["ORDER"].append(order_row, m_ins,
+                                            anchor=q.w_id)
         db["NEW-ORDER"], _ = db["NEW-ORDER"].append(
             {"NO_O_ID": o_id, "NO_D_ID": q.d_id, "NO_W_ID": q.w_id}, m_ins,
             anchor=q.w_id)
         ol_m = (q.item_valid & m_ins[:, None]).reshape(-1)
         bcast = lambda x: jnp.broadcast_to(x[:, None], (n, I)).reshape(-1)  # noqa: E731
-        db["ORDER-LINE"], _ = db["ORDER-LINE"].append(
-            {"OL_O_ID": bcast(o_id), "OL_D_ID": bcast(q.d_id),
-             "OL_W_ID": bcast(q.w_id),
-             "OL_NUMBER": jnp.broadcast_to(jnp.arange(I)[None], (n, I)
-                                           ).reshape(-1),
-             "OL_I_ID": q.items.reshape(-1),
-             "OL_QUANTITY": q.quantity.reshape(-1)}, ol_m,
-            anchor=bcast(q.w_id))
+        ol_row = {"OL_O_ID": bcast(o_id), "OL_D_ID": bcast(q.d_id),
+                  "OL_W_ID": bcast(q.w_id),
+                  "OL_NUMBER": jnp.broadcast_to(jnp.arange(I)[None], (n, I)
+                                                ).reshape(-1),
+                  "OL_I_ID": q.items.reshape(-1),
+                  "OL_QUANTITY": q.quantity.reshape(-1)}
+        if self.full_schema:
+            price = jnp.take(db["ITEM"].columns["I_PRICE"],
+                             jnp.clip(q.items, 0, self.max_items - 1),
+                             axis=0).reshape(-1)
+            ol_row["OL_SUPPLY_W_ID"] = q.supply_w.reshape(-1)
+            ol_row["OL_DELIVERY_D"] = jnp.zeros((n * I,), jnp.int32)
+            ol_row["OL_AMOUNT"] = (q.quantity.reshape(-1) * price
+                                   ).astype(jnp.float32)
+            ol_row["OL_DIST_INFO"] = (q.items.reshape(-1).astype(jnp.uint32)
+                                      * jnp.uint32(2654435761))
+        db["ORDER-LINE"], _ = db["ORDER-LINE"].append(ol_row, ol_m,
+                                                      anchor=bcast(q.w_id))
 
         stats["write_cnt"] = stats["write_cnt"] + \
             (iv.sum() + m.sum() * 2).astype(jnp.uint32)

@@ -105,6 +105,11 @@ class YCSBWorkload:
     # writes overwrite a field with f(key, order) — independent of any
     # read — so the single-pass forwarding executor applies (ops/forward)
     blind_writes = True
+    # per-type statistics (reference Stats_thd per-txn-kind counters)
+    txn_type_names = ("ycsb_ro", "ycsb_rw")
+
+    def txn_type_of(self, q: "YCSBQuery") -> jax.Array:
+        return q.is_write.any(axis=1).astype(jnp.int32)
 
     def __init__(self, cfg: Config):
         self.cfg = cfg

@@ -302,3 +302,18 @@ def test_ycsb_abort_mode_forces_deterministic_aborts():
     # determinism preserved
     s2, _ = run_epochs(cfg)
     assert int(s2["total_txn_abort_cnt"]) == int(stats["total_txn_abort_cnt"])
+
+
+def test_per_type_counters_partition_totals():
+    """commit_by_type / abort_by_type partition the totals exactly
+    (reference Stats_thd per-txn-kind counter families)."""
+    cfg = small_cfg(cc_alg="OCC", zipf_theta=0.9, synth_table_size=512,
+                    txn_write_perc=0.7)
+    stats, _ = run_epochs(cfg, n=25)
+    assert stats["commit_by_type"].shape == (2,)   # ycsb_ro, ycsb_rw
+    assert stats["commit_by_type"].sum() == stats["total_txn_commit_cnt"]
+    assert stats["abort_by_type"].sum() == stats["total_txn_abort_cnt"]
+    # read-only txns exist at txn_write_perc<1 and never abort under OCC's
+    # reader-first sweep at rank order... they CAN abort (reader later);
+    # just require both types to have committed
+    assert (stats["commit_by_type"] > 0).all()

@@ -1,0 +1,60 @@
+"""Trimmed round-2 sweep continuation (single-chip tunnel time budget).
+
+Runs the highest-value subsets of the remaining paper sweeps at full
+problem sizes with bench windows; full grids stay available via
+``python -m deneva_tpu.harness.run <exp> --bench``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+from deneva_tpu.config import CCAlg  # noqa: E402
+from deneva_tpu.harness.experiments import (ALL_ALGS, get_experiment,  # noqa: E402
+                                            paper_base)
+from deneva_tpu.harness.run import run_point  # noqa: E402
+
+
+def bench(cfgs):
+    return [c.replace(warmup_secs=1.5, done_secs=4.0) for c in cfgs]
+
+
+def main() -> int:
+    jobs: list[tuple[str, list]] = []
+
+    if "escrow" in sys.argv:
+        jobs.append(("escrow_ablation", bench(
+            get_experiment("escrow_ablation", quick=False))))
+    if "skew" in sys.argv:
+        jobs.append(("ycsb_skew", bench(
+            get_experiment("ycsb_skew", quick=False))))
+    if "writes" in sys.argv:
+        base = paper_base(False).replace(zipf_theta=0.6)
+        cfgs = [base.replace(read_perc=1 - w, write_perc=w,
+                             cc_alg=CCAlg(a))
+                for w in (0.0, 0.5, 1.0) for a in ALL_ALGS]
+        jobs.append(("ycsb_writes", bench(cfgs)))
+    if "tpcc" in sys.argv:
+        base = paper_base(False).replace(workload="TPCC", max_accesses=32)
+        cfgs = [base.replace(num_wh=wh, perc_payment=0.5, cc_alg=CCAlg(a))
+                for wh in (4, 16, 64) for a in ALL_ALGS]
+        jobs.append(("tpcc_scaling", bench(cfgs)))
+    if "pps" in sys.argv:
+        jobs.append(("pps_scaling", bench(
+            get_experiment("pps_scaling", quick=False))))
+    if "modes" in sys.argv:
+        jobs.append(("modes", bench(get_experiment("modes", quick=False))))
+
+    for name, cfgs in jobs:
+        out_dir = f"results/{name}"
+        print(f"[{name}] {len(cfgs)} points -> {out_dir}", flush=True)
+        for cfg in cfgs:
+            run_point(cfg, out_dir, quiet=False)
+    print("CAMPAIGN_B_DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
