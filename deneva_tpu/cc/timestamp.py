@@ -68,7 +68,19 @@ import jax
 import jax.numpy as jnp
 
 from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, get_overlap
-from deneva_tpu.ops import earlier_edges, greedy_first_fit
+from deneva_tpu.ops import (bucket_hash, combine_key, earlier_edges,
+                            greedy_first_fit)
+
+
+def _wm_bucket(cfg, batch: AccessBatch) -> jax.Array:
+    """Per-access bucket ids in the WATERMARK hash space.  Decoupled from
+    the incidence bucket space: watermark tables are O(K) memory, so they
+    run much wider (``watermark_buckets``) than the O(B*K) incidence
+    matrices can afford — per-bucket max-aggregation stays a sound
+    over-approximation of the reference's per-row ts state, with false
+    sharing driven toward zero."""
+    ident = combine_key(batch.table_ids, batch.keys)
+    return bucket_hash(ident, cfg.watermark_buckets, family=0)
 
 
 @dataclass
@@ -99,13 +111,13 @@ jax.tree_util.register_dataclass(
 
 
 def init_to_state(cfg) -> TOState:
-    k = cfg.conflict_buckets
+    k = cfg.watermark_buckets
     return TOState(rts=jnp.zeros((k,), jnp.int32),
                    wts=jnp.zeros((k,), jnp.int32))
 
 
 def init_mvcc_state(cfg) -> MVCCState:
-    k, h = cfg.conflict_buckets, cfg.mvcc_his_len
+    k, h = cfg.watermark_buckets, cfg.mvcc_his_len
     return MVCCState(rts=jnp.zeros((k,), jnp.int32),
                      wts=jnp.zeros((k,), jnp.int32),
                      his=jnp.zeros((k, h), jnp.int32),
@@ -122,18 +134,19 @@ def _readonly(batch: AccessBatch) -> jax.Array:
     return ~(v & batch.is_write).any(axis=1)
 
 
-def _watermark_aborts(state, batch: AccessBatch, inc: Incidence,
+def _watermark_aborts(cfg, state, batch: AccessBatch,
                       mvcc: bool) -> jax.Array:
     """bool[B]: txn violates a cross-epoch watermark."""
+    wm = _wm_bucket(cfg, batch)
     v = batch.valid & batch.active[:, None]
-    wts_at = jnp.take(state.wts, inc.bucket1)          # [B, A]
-    rts_at = jnp.take(state.rts, inc.bucket1)
+    wts_at = jnp.take(state.wts, wm)                   # [B, A]
+    rts_at = jnp.take(state.rts, wm)
     ts = batch.ts[:, None]
     if mvcc:
         # pure reads serve the retained version at their ts; only reads
         # older than the bounded history (version recycled,
         # row_mvcc.cpp:303-321) or RMW reads (must read latest) abort
-        his_min = jnp.take(state.his.min(axis=1), inc.bucket1)
+        his_min = jnp.take(state.his.min(axis=1), wm)
         pure = batch.is_read & ~batch.is_write
         rmw = batch.is_read & batch.is_write
         read_bad = v & ((pure & (wts_at > ts) & (ts < his_min))
@@ -153,13 +166,13 @@ def _rw_later_reader_edges(cfg, batch: AccessBatch, inc: Incidence):
     return earlier_edges(rw, batch.ts, batch.active)   # j earlier by ts
 
 
-def _commit_watermarks(state, batch: AccessBatch, inc: Incidence,
+def _commit_watermarks(cfg, state, batch: AccessBatch,
                        commit: jax.Array):
     v = batch.valid & commit[:, None]
     ts = jnp.broadcast_to(batch.ts[:, None], batch.keys.shape)
     r_ts = jnp.where(v & batch.is_read, ts, 0)
     w_ts = jnp.where(v & batch.is_write, ts, 0)
-    flat = inc.bucket1.reshape(-1)
+    flat = _wm_bucket(cfg, batch).reshape(-1)
     rts = state.rts.at[flat].max(r_ts.reshape(-1))
     wts = state.wts.at[flat].max(w_ts.reshape(-1))
     if not isinstance(state, MVCCState):
@@ -178,7 +191,7 @@ def _commit_watermarks(state, batch: AccessBatch, inc: Incidence,
 
 
 def _validate_to(cfg, state, batch, inc, mvcc: bool):
-    wm_abort = _watermark_aborts(state, batch, inc, mvcc)
+    wm_abort = _watermark_aborts(cfg, state, batch, mvcc)
     live = batch.active & ~wm_abort
     if mvcc:
         ro = _readonly(batch)
@@ -200,17 +213,17 @@ def _validate_to(cfg, state, batch, inc, mvcc: bool):
     v = Verdict(commit=commit, abort=batch.active & wm_abort,
                 defer=und | lose, order=order,
                 level=jnp.zeros_like(batch.rank))
-    return v, _commit_watermarks(state, batch, inc, commit)
+    return v, _commit_watermarks(cfg, state, batch, commit)
 
 
-def commit_to_state(cfg, state, batch: AccessBatch, inc: Incidence,
-                    commit: jax.Array):
+def commit_to_state(cfg, state, batch: AccessBatch, inc, commit: jax.Array):
     """Post-decision watermark application for the distributed VOTE
     protocol: local validation's state output is discarded and the
     watermarks advance only for *globally* committed txns (the
     reference's row managers likewise update ts state on the 2PC commit
-    path, not at prepare)."""
-    return _commit_watermarks(state, batch, inc, commit)
+    path, not at prepare).  ``inc`` is unused (watermark buckets are
+    self-hashed) and kept for the hook signature."""
+    return _commit_watermarks(cfg, state, batch, commit)
 
 
 def validate_timestamp(cfg, state, batch: AccessBatch, inc: Incidence):

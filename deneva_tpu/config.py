@@ -184,6 +184,15 @@ class Config:
     epoch_batch: int = 2048        # txns validated per epoch (Calvin SEQ_BATCH analogue)
     conflict_buckets: int = 8192   # hashed key-bucket width of incidence matrices
     conflict_exact: bool = True    # dual-hash AND to squeeze out false conflicts
+    watermark_buckets: int = 1 << 20  # hashed width of the T/O family's
+    #                                   cross-epoch rts/wts tables.  These
+    #                                   are O(K) memory (not O(B*K) like
+    #                                   incidence matrices), so they can be
+    #                                   wide enough that false bucket
+    #                                   sharing stops inflating abort
+    #                                   rates (the reference tracks
+    #                                   per-ROW ts state; 1M buckets at
+    #                                   4 B each is 4 MB)
     max_accesses: int = 16         # padded RW-set width per txn (covers req_per_query)
     defer_rounds_max: int = 8      # WAIT_DIE-style defer budget before forced abort
     sweep_rounds: int = 24         # serialization-sweep fixpoint iterations (chain depth cap)

@@ -154,8 +154,7 @@ def make_vote_steps(cfg: Config, wl, be):
     import jax
     import jax.numpy as jnp
 
-    from deneva_tpu.cc import (AccessBatch, Incidence,
-                               build_conflict_incidence)
+    from deneva_tpu.cc import AccessBatch, build_conflict_incidence
 
     b = max(1, cfg.epoch_batch // cfg.node_cnt) * cfg.node_cnt
     me = cfg.node_id
@@ -200,16 +199,9 @@ def make_vote_steps(cfg: Config, wl, be):
         abort = abort & active
         defer = defer & active
         if be.commit_state is not None:
-            # commit_state consumes only the per-access bucket ids —
-            # build just those, not the full incidence matrices the
-            # prepare phase already paid for
-            from deneva_tpu.ops import bucket_hash, combine_key
-            ident = combine_key(batch.table_ids, batch.keys)
-            inc = Incidence(
-                r1=None, w1=None, u1=None, pr1=None, r2=None, w2=None,
-                u2=None, pr2=None,
-                bucket1=bucket_hash(ident, cfg.conflict_buckets, family=0))
-            cc_state = be.commit_state(cfg, cc_state, batch, inc, commit)
+            # watermark buckets are self-hashed from the batch (see
+            # cc/timestamp._wm_bucket) — no incidence rebuild needed here
+            cc_state = be.commit_state(cfg, cc_state, batch, None, commit)
         db = wl.execute(db, query, commit, global_order(batch), stats)
         stats = dict(stats)
         stats["total_txn_commit_cnt"] += commit.sum(dtype=jnp.uint32)

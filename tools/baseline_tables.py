@@ -1,0 +1,76 @@
+"""Render the measured-results tables for BASELINE.md from results/.
+
+Usage: python tools/baseline_tables.py > /tmp/tables.md
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deneva_tpu.harness.parse import load_results  # noqa: E402
+
+
+def pivot(exp: str, x: str, y: str = "tput", series: str = "cc_alg",
+          fmt: str = "{:,.0f}") -> str:
+    rows = load_results(f"results/{exp}")
+    table: dict = {}
+    xs = set()
+    for r in rows:
+        if y not in r or x not in r:
+            continue
+        s = r.get(series, "?")
+        table.setdefault(s, {})[r[x]] = r[y]
+        xs.add(r[x])
+    if not table:
+        return f"(no data for {exp})\n"
+    xs = sorted(xs)
+    out = [f"| {series} \\ {x} | " + " | ".join(str(v) for v in xs) + " |",
+           "|" + "---|" * (len(xs) + 1)]
+    for s in sorted(table, key=str):
+        cells = [fmt.format(table[s][v]) if v in table[s] else "-"
+                 for v in xs]
+        out.append(f"| {s} | " + " | ".join(cells) + " |")
+    return "\n".join(out) + "\n"
+
+
+def listing(exp: str, fields=("tput", "abort_rate")) -> str:
+    rows = load_results(f"results/{exp}")
+    out = []
+    for r in sorted(rows, key=lambda r: r["file"]):
+        vals = "  ".join(f"{f}={r.get(f, 0):,.3g}" for f in fields
+                         if f in r)
+        out.append(f"  {r['file'][:-4]:62s} {vals}")
+    return "\n".join(out) + "\n"
+
+
+def main() -> int:
+    print("### ycsb_skew (tput, txn/s)\n")
+    print(pivot("ycsb_skew", "zipf_theta"))
+    print("\n### ycsb_skew (abort rate)\n")
+    print(pivot("ycsb_skew", "zipf_theta", y="abort_rate", fmt="{:.3f}"))
+    print("\n### ycsb_writes (tput vs write fraction)\n")
+    print(pivot("ycsb_writes", "write_perc"))
+    print("\n### tpcc_scaling (tput vs warehouses, 50% payment)\n")
+    print(pivot("tpcc_scaling", "num_wh"))
+    print("\n### pps_scaling\n")
+    print(listing("pps_scaling"))
+    print("\n### operating_points (zipf 0.9)\n")
+    print(pivot("operating_points", "epoch_batch"))
+    print("\n### escrow_ablation\n")
+    print(listing("escrow_ablation"))
+    print("\n### isolation_levels (NO_WAIT)\n")
+    print(pivot("isolation_levels", "isolation_level", series="cc_alg"))
+    print("\n### modes\n")
+    print(pivot("modes", "mode", series="cc_alg"))
+    print("\n### cluster_scaling (CPU, multi-process)\n")
+    print(pivot("cluster_scaling", "node_cnt"))
+    print("\n### cluster_tpu (1 TPU server + CPU clients)\n")
+    print(listing("cluster_tpu"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Final round-2 rerun set: points invalidated mid-campaign.
+
+* TIMESTAMP / MVCC sweep points re-measured with the widened watermark
+  tables (the first pass ran before the false-sharing fix);
+* the escrow-ablation points that failed during a TPU worker restart.
+"""
+
+from __future__ import annotations
+
+import sys
+
+sys.path.insert(0, "/root/repo")
+
+from deneva_tpu.config import CCAlg  # noqa: E402
+from deneva_tpu.harness.experiments import get_experiment, paper_base  # noqa: E402
+from deneva_tpu.harness.run import run_point  # noqa: E402
+
+
+def bench(cfgs):
+    return [c.replace(warmup_secs=1.5, done_secs=4.0) for c in cfgs]
+
+
+def main() -> int:
+    jobs = []
+    to_algs = (CCAlg.TIMESTAMP, CCAlg.MVCC)
+    jobs.append(("ycsb_skew", bench(
+        [c for c in get_experiment("ycsb_skew", quick=False)
+         if c.cc_alg in to_algs])))
+    jobs.append(("operating_points", bench(
+        [c for c in get_experiment("operating_points", quick=False)
+         if c.cc_alg in to_algs])))
+    base = paper_base(False)
+    tpcc = base.replace(workload="TPCC", max_accesses=32, num_wh=64,
+                        epoch_batch=2048, exec_subrounds=2)
+    jobs.append(("escrow_ablation", bench([
+        tpcc.replace(cc_alg=CCAlg.TPU_BATCH, escrow_order_free=False),
+        tpcc.replace(cc_alg=CCAlg.CALVIN, escrow_order_free=False),
+    ])))
+    pps = base.replace(workload="PPS", max_accesses=32, epoch_batch=1024,
+                       exec_subrounds=4)
+    jobs.append(("escrow_ablation", bench([
+        pps.replace(cc_alg=CCAlg.CALVIN, escrow_order_free=True),
+    ])))
+    for name, cfgs in jobs:
+        print(f"[{name}] {len(cfgs)} points", flush=True)
+        for cfg in cfgs:
+            run_point(cfg, f"results/{name}", quiet=False)
+    print("CAMPAIGN_C_DONE", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
