@@ -69,7 +69,10 @@ def child(platform: str) -> None:
                         max_txn_in_flight=65536 // scale,
                         sim_full_row=True,
                         synth_table_size=(1 << 21) // scale)
-    host_occ = _host_occ_tput()
+    # host OCC is measured by the PARENT before any JAX runtime exists
+    # (its thread pool skews a host-CPU benchmark by 2-4x) and arrives
+    # via environment
+    host_occ = float(os.environ.get("DENEVA_HOST_OCC_TPUT", "0") or 0)
     print(json.dumps({
         "metric": "ycsb_zipf0.9_committed_txns_per_sec",
         "value": round(tpu_tput, 1),
@@ -102,8 +105,10 @@ def _host_occ_tput() -> float:
 
 
 def main() -> None:
+    host_occ = _host_occ_tput()    # quiet host, before any JAX runtime
     for platform in ("tpu", "cpu"):
         env = dict(os.environ)
+        env["DENEVA_HOST_OCC_TPUT"] = str(host_occ)
         if platform == "cpu":
             env["PYTHONPATH"] = ""          # skip axon sitecustomize
             env["JAX_PLATFORMS"] = "cpu"

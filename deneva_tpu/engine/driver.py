@@ -30,7 +30,7 @@ def _counters(state: EngineState) -> dict:
     return {k: np.asarray(v) for k, v in host.items()}
 
 
-def _sync(state: EngineState) -> int:
+def _sync(state: EngineState) -> tuple[int, int]:
     """Real device->host transfer as the pacing barrier.
 
     `jax.block_until_ready` on a donated scan output can return before
@@ -39,8 +39,15 @@ def _sync(state: EngineState) -> int:
     enqueue an unbounded backlog — which wedges the single-client tunnel
     and, past ~50 s of queued work, kills the worker.  A scalar transfer
     cannot complete early, so it both paces the loop and surfaces any
-    execution error at the call site."""
-    return int(jax.device_get(state.stats["total_txn_commit_cnt"]))
+    execution error at the call site.
+
+    Returns (commit_cnt, next_seq) from ONE transfer: a tunnel round trip
+    costs tens of ms, so the seq-wrap guard must ride the pacing fetch
+    rather than pay its own (a second round trip per ~1 s chunk measured
+    ~15 % off the headline)."""
+    c, s = jax.device_get((state.stats["total_txn_commit_cnt"],
+                           state.pool.next_seq))
+    return int(c), int(s)
 
 
 def run_simulation(cfg: Config, chunk: int = 50,
@@ -61,35 +68,13 @@ def run_simulation(cfg: Config, chunk: int = 50,
     else:
         run_n = eng.jit_run
 
-    # compile once (excluded from both windows, like the reference's setup
-    # barrier, system/thread.cpp:62-84)
-    state = run_n(state, chunk)
-    _sync(state)
-    # adaptive chunking: size each device call to ~1 s — large enough
-    # that the per-call sync round-trip (tens of ms on a tunneled chip)
-    # stays in the noise, small enough that no single execution
-    # approaches the tunnel's multi-second RPC limits
-    t1 = time.monotonic()
-    state = run_n(state, chunk)
-    _sync(state)
-    per_chunk = max(time.monotonic() - t1, 1e-4)
-    target = max(1, min(int(chunk * 1.0 / per_chunk), 20_000))
     ckpt_bound = cfg.checkpoint_every_epochs \
         if cfg.checkpoint_path and cfg.checkpoint_every_epochs else 0
-    if ckpt_bound:
-        # chunks quantize the checkpoint cadence: never run a chunk
-        # longer than the configured checkpoint interval
-        target = min(target, ckpt_bound)
-    if target > chunk * 2 or target < chunk // 2 \
-            or (ckpt_bound and chunk > ckpt_bound):
-        chunk = target
-        state = run_n(state, chunk)     # one more compile, new n
-        _sync(state)
-
     ckpt_due = [cfg.checkpoint_every_epochs]
     run_t0 = time.monotonic()
     prog_next = [run_t0 + cfg.prog_timer_secs]
     epochs_total = [0]      # cumulative across warmup+measure windows
+    seq_per_chunk = [(eng.pool.g + eng.pool.b) * chunk]
 
     def prog_tick(state):
         # [prog] line every prog_timer_secs (reference PROG_TIMER,
@@ -103,37 +88,79 @@ def run_simulation(cfg: Config, chunk: int = 50,
                              {"epoch_cnt": float(epochs_total[0])}),
               flush=True)
 
-    # int32 seq/ts wrap guard (see pool.py docstring): next_seq advances
-    # (G + B) per epoch; refuse to run a chunk that could cross 2^31
-    seq_per_chunk = (eng.pool.g + eng.pool.b) * chunk
-
-    def _guard_seq(state):
-        head = int(jax.device_get(state.pool.next_seq))
-        if head > 2**31 - 2 * seq_per_chunk:
+    def _guard_seq(head: int):
+        # int32 seq/ts wrap guard (see pool.py docstring): next_seq
+        # advances (G + B) per epoch; refuse to run another chunk that
+        # could cross 2^31 (checked post-chunk with a 2-chunk margin;
+        # `head < 0` catches a wrap that somehow slipped past).  The head
+        # value rides _sync's transfer — an extra per-chunk round trip
+        # measured ~15 % off the headline on the tunneled chip.
+        if head < 0 or head > 2**31 - 2 * seq_per_chunk[0]:
             raise RuntimeError(
                 f"int32 txn-sequence space nearly exhausted (next_seq="
                 f"{head}); shorten the run window or shrink epoch_batch "
                 "(seq advances epoch_batch+gen_chunk per epoch)")
 
+    def _after_chunk(state):
+        """Shared per-chunk bookkeeping: pacing sync + wrap guard +
+        progress + checkpoint cadence."""
+        _guard_seq(_sync(state)[1])
+        epochs_total[0] += chunk
+        prog_tick(state)
+        if ckpt_bound:
+            ckpt_due[0] -= chunk
+            if ckpt_due[0] <= 0:
+                from deneva_tpu.engine.checkpoint import save_state
+                save_state(cfg.checkpoint_path, state)
+                ckpt_due[0] = ckpt_bound
+
+    def _retarget(state, epochs_per_sec: float, spread: int):
+        """ONE resize rule for both calibrations: aim each device call at
+        ~1 s of work, capped by the 20k ceiling (tunnel RPC safety) and
+        the checkpoint interval; recompile only when the current chunk is
+        off by more than ``spread``x."""
+        nonlocal chunk
+        target = max(1, min(int(epochs_per_sec), 20_000))
+        if ckpt_bound:
+            target = min(target, ckpt_bound)
+        if target > chunk * spread or target < chunk // spread \
+                or (ckpt_bound and chunk > ckpt_bound):
+            chunk = target
+            seq_per_chunk[0] = (eng.pool.g + eng.pool.b) * chunk
+            state = run_n(state, chunk)     # one compile at the new n
+            _after_chunk(state)
+        return state
+
+    # compile once (excluded from both windows, like the reference's setup
+    # barrier, system/thread.cpp:62-84)
+    state = run_n(state, chunk)
+    _sync(state)
+    # adaptive chunking: size each device call to ~1 s — large enough
+    # that the per-call sync round-trip (tens of ms on a tunneled chip)
+    # stays in the noise, small enough that no single execution
+    # approaches the tunnel's multi-second RPC limits
+    t1 = time.monotonic()
+    state = run_n(state, chunk)
+    _sync(state)
+    per_chunk = max(time.monotonic() - t1, 1e-4)
+    state = _retarget(state, chunk / per_chunk, spread=2)
+
     def run_window(state, secs):
         t0 = time.monotonic()
-        epochs = 0
+        ep0 = epochs_total[0]
         while time.monotonic() - t0 < secs:
-            _guard_seq(state)
             state = run_n(state, chunk)
-            _sync(state)
-            epochs += chunk
-            epochs_total[0] += chunk
-            prog_tick(state)
-            if cfg.checkpoint_path and cfg.checkpoint_every_epochs:
-                ckpt_due[0] -= chunk
-                if ckpt_due[0] <= 0:
-                    from deneva_tpu.engine.checkpoint import save_state
-                    save_state(cfg.checkpoint_path, state)
-                    ckpt_due[0] = cfg.checkpoint_every_epochs
-        return state, epochs, time.monotonic() - t0
+            _after_chunk(state)
+        return state, epochs_total[0] - ep0, time.monotonic() - t0
 
-    state, _, _ = run_window(state, cfg.warmup_secs)
+    state, ep_w, el_w = run_window(state, cfg.warmup_secs)
+    # re-calibrate against STEADY-STATE epoch time: early epochs can be
+    # far cheaper than saturated ones (e.g. T/O at high contention — hot
+    # retry keys serialize the watermark scatters), and an optimistic
+    # chunk would run one multi-minute device call in the measure window
+    # (unsafe past ~50 s on the tunneled chip)
+    if ep_w:
+        state = _retarget(state, ep_w / max(el_w, 1e-4), spread=3)
     before = _counters(state)
     t_start = time.monotonic()
     state, epochs, elapsed = run_window(state, cfg.done_secs)

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Final round-2 measurement chain (sequential: single-client TPU tunnel).
+cd /root/repo
+set -x
+python tools/campaign_r2c.py                  # post-fix T/O reruns + escrow reruns
+python tools/measure_cluster_tpu.py           # cluster-mode on the chip
+python bench.py > /tmp/bench_final.json 2>/tmp/bench_final.err
+python tools/campaign_r2b.py writes
+python tools/campaign_r2b.py tpcc
+python tools/campaign_r2b.py pps modes
+echo CAMPAIGN_FINAL_DONE
