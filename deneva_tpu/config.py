@@ -170,6 +170,12 @@ class Config:
     warmup_secs: float = 2.0       # reference: 60s; scaled for CI-speed runs
     done_secs: float = 5.0         # measured window; reference: 60s
     prog_timer_secs: float = 10.0
+    chunk_target_secs: float = 1.0  # driver aims each device scan at this
+    #                                 much work: the per-chunk pacing round
+    #                                 trip (tens of ms on a tunneled chip)
+    #                                 amortizes over it, but one call must
+    #                                 stay far below the tunnel's ~50 s
+    #                                 execution kill (keep <= ~3)
 
     # ---- logging (reference config.h:145-149) ----
     logging: bool = False

@@ -116,11 +116,12 @@ def run_simulation(cfg: Config, chunk: int = 50,
 
     def _retarget(state, epochs_per_sec: float, spread: int):
         """ONE resize rule for both calibrations: aim each device call at
-        ~1 s of work, capped by the 20k ceiling (tunnel RPC safety) and
-        the checkpoint interval; recompile only when the current chunk is
-        off by more than ``spread``x."""
+        ``chunk_target_secs`` of work, capped by the 20k ceiling (tunnel
+        RPC safety) and the checkpoint interval; recompile only when the
+        current chunk is off by more than ``spread``x."""
         nonlocal chunk
-        target = max(1, min(int(epochs_per_sec), 20_000))
+        target = max(1, min(int(epochs_per_sec * cfg.chunk_target_secs),
+                            20_000))
         if ckpt_bound:
             target = min(target, ckpt_bound)
         if target > chunk * spread or target < chunk // spread \
@@ -131,17 +132,21 @@ def run_simulation(cfg: Config, chunk: int = 50,
             _after_chunk(state)
         return state
 
+    # pre-flight wrap check (a resumed checkpoint may sit near int32 seq
+    # exhaustion, e.g. after an epoch_batch change): refuse before the
+    # first unguarded calibration chunk, not after
+    _guard_seq(int(jax.device_get(state.pool.next_seq)))
     # compile once (excluded from both windows, like the reference's setup
     # barrier, system/thread.cpp:62-84)
     state = run_n(state, chunk)
-    _sync(state)
-    # adaptive chunking: size each device call to ~1 s — large enough
-    # that the per-call sync round-trip (tens of ms on a tunneled chip)
-    # stays in the noise, small enough that no single execution
-    # approaches the tunnel's multi-second RPC limits
+    _guard_seq(_sync(state)[1])
+    # adaptive chunking: size each device call to ~chunk_target_secs —
+    # large enough that the per-call sync round-trip (tens of ms on a
+    # tunneled chip) stays in the noise, small enough that no single
+    # execution approaches the tunnel's multi-second RPC limits
     t1 = time.monotonic()
     state = run_n(state, chunk)
-    _sync(state)
+    _guard_seq(_sync(state)[1])
     per_chunk = max(time.monotonic() - t1, 1e-4)
     state = _retarget(state, chunk / per_chunk, spread=2)
 

@@ -1,7 +1,7 @@
 #!/bin/bash
 # Final round-2 measurement chain (sequential: single-client TPU tunnel).
 cd /root/repo
-set -x
+set -ex
 python tools/campaign_r2c.py                  # post-fix T/O reruns + escrow reruns
 python tools/measure_cluster_tpu.py           # cluster-mode on the chip
 python bench.py > /tmp/bench_final.json 2>/tmp/bench_final.err

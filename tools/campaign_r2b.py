@@ -43,6 +43,11 @@ def main() -> int:
         cfgs = [base.replace(num_wh=wh, perc_payment=0.5, cc_alg=CCAlg(a))
                 for wh in (4, 64) for a in ALL_ALGS]
         jobs.append(("tpcc_scaling", bench(cfgs)))
+    if "tpcc16" in sys.argv:    # grid midpoint (run post-campaign)
+        base = paper_base(False).replace(workload="TPCC", max_accesses=32)
+        jobs.append(("tpcc_scaling", bench(
+            [base.replace(num_wh=16, perc_payment=0.5, cc_alg=CCAlg(a))
+             for a in ALL_ALGS])))
     if "pps" in sys.argv:
         jobs.append(("pps_scaling", bench(
             get_experiment("pps_scaling", quick=False))))
