@@ -57,6 +57,10 @@ def main() -> int:
     print(pivot("tpcc_scaling", "num_wh"))
     print("\n### pps_scaling\n")
     print(listing("pps_scaling"))
+    print("\n### ycsb_hot (HOT skew: tput vs hot-set access fraction)\n")
+    print(pivot("ycsb_hot", "access_perc"))
+    print("\n### ycsb_inflight (tput vs MAX_TXN_IN_FLIGHT)\n")
+    print(pivot("ycsb_inflight", "max_txn_in_flight"))
     print("\n### operating_points (zipf 0.9)\n")
     print(pivot("operating_points", "epoch_batch"))
     print("\n### escrow_ablation\n")

@@ -1,0 +1,9 @@
+#!/bin/bash
+cd /root/repo
+set -x
+# no -e: the two sweeps are independent — a timeout in one must not
+# skip the other (campaign_final.sh is -e because its stages feed each
+# other)
+timeout 3600 python -m deneva_tpu.harness.run ycsb_hot --bench
+timeout 3600 python -m deneva_tpu.harness.run ycsb_inflight --bench
+echo TAIL_DONE
