@@ -162,6 +162,13 @@ class Config:
     # ---- txn / client driving (reference config.h:21-22, 84-90) ----
     max_txn_in_flight: int = 10000
     load_rate: int = 0             # 0 = LOAD_MAX (saturate), else fixed txn/s
+    client_batch_size: int = 1024  # txns per CL_QRY_BATCH message: the
+    #                                Python client's per-message overhead
+    #                                (~3 ms: tag ring + codec + send) is
+    #                                the cluster-mode supply ceiling, so
+    #                                it must amortize over large batches
+    #                                (reference clients batch too,
+    #                                message.h:243-340)
     abort_penalty_us: float = 25.0      # base restart backoff (config.h:113)
     abort_penalty_max_us: float = 5000.0
     backoff: bool = True

@@ -27,7 +27,6 @@ from deneva_tpu.runtime.native import NativeTransport
 from deneva_tpu.stats import Stats
 
 TAG_RING = 1 << 20            # outstanding-tag ring per client
-QRY_CHUNK = 64                # txns per CL_QRY_BATCH message
 
 
 class ClientNode:
@@ -49,9 +48,12 @@ class ClientNode:
         if cfg.net_delay_us:
             self.tp.set_delay_us(int(cfg.net_delay_us))
         self.inflight = np.zeros(self.n_srv, np.int64)
+        self.chunk = cfg.client_batch_size
         # reference: inflight cap is per server pair (client_txn.cpp:25);
-        # floored at one send chunk or the client could never send at all
-        self.cap = max(QRY_CHUNK,
+        # sends SLICE down to the remaining budget (never the reverse —
+        # flooring the cap up to the batch size would let a big batch
+        # override max_txn_in_flight), floored at one minimal send
+        self.cap = max(64,
                        cfg.max_txn_in_flight // max(cfg.client_node_cnt, 1))
         self.send_us = np.zeros(TAG_RING, np.int64)   # tag -> send time
         self.next_tag = 0
@@ -66,11 +68,11 @@ class ClientNode:
         n_pregen = 64
         self.ring: list[wire.QueryBlock] = []
         for i in range(n_pregen):
-            q = self.wl.generate(jax.random.fold_in(rng, i), QRY_CHUNK)
+            q = self.wl.generate(jax.random.fold_in(rng, i), self.chunk)
             keys, types, scalars = self.wl.to_wire(q)
             self.ring.append(wire.QueryBlock(
                 keys=keys, types=types, scalars=scalars,
-                tags=np.zeros(QRY_CHUNK, np.int64)))
+                tags=np.zeros(self.chunk, np.int64)))
         self.ring_pos = 0
 
     # ------------------------------------------------------------------
@@ -114,21 +116,30 @@ class ClientNode:
             progressed = False
             for _ in range(self.n_srv):
                 srv = (srv + 1) % self.n_srv
-                if self.inflight[srv] + QRY_CHUNK > self.cap:
+                # slice each send to the smaller of the batch size, the
+                # server's remaining inflight budget and the rate budget
+                n = min(self.chunk, self.cap - int(self.inflight[srv]))
+                if n < 64:                      # not worth a message yet
                     continue
-                if rate and sent_total >= rate * (time.monotonic() - t_start):
-                    break
+                if rate:
+                    budget = int(rate * (time.monotonic() - t_start)) \
+                        - sent_total
+                    if budget <= 0:
+                        break
+                    n = min(n, budget)
                 blk = self.ring[self.ring_pos]
                 self.ring_pos = (self.ring_pos + 1) % len(self.ring)
+                if n < self.chunk:
+                    blk = blk.slice(0, n)
                 now = time.monotonic_ns() // 1000
-                tags = (np.arange(QRY_CHUNK, dtype=np.int64) + self.next_tag) \
-                    % TAG_RING
+                tags = (np.arange(n, dtype=np.int64)
+                        + self.next_tag) % TAG_RING
                 self.next_tag = int(tags[-1]) + 1
                 self.send_us[tags] = now
                 out = wire.QueryBlock(blk.keys, blk.types, blk.scalars, tags)
                 self.tp.send(srv, "CL_QRY_BATCH", wire.encode_qry_block(out))
-                self.inflight[srv] += QRY_CHUNK
-                sent_total += QRY_CHUNK
+                self.inflight[srv] += n
+                sent_total += n
                 progressed = True
             self._drain(lat, timeout_us=0 if progressed else 2_000)
         # drain trailing responses so server-side commits are counted

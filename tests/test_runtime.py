@@ -161,11 +161,11 @@ def test_client_load_rate_throttles():
     cfg = small_cfg(node_cnt=1, client_node_cnt=1, load_rate=2000,
                     warmup_secs=0.3, done_secs=2.0)
     out = boot(cfg)
-    from deneva_tpu.runtime.client import QRY_CHUNK
     cl = parse_summary(out[1][1])
     # ~2000 txn/s over the ~3s client lifetime, chunked sends => bound
-    # generously above budget but far below the >30k/s saturated rate
-    assert cl["sent_cnt"] <= 2000 * cl["total_runtime"] + 2 * QRY_CHUNK
+    # generously above budget (one batch of slack) but far below the
+    # saturated rate
+    assert cl["sent_cnt"] <= 2000 * cl["total_runtime"]         + 2 * cfg.client_batch_size
 
 
 @pytest.mark.slow
