@@ -211,3 +211,17 @@ def test_sanitizer_stress(target):
                           timeout=600)
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
     assert "stress ok" in proc.stdout
+
+
+def test_vote_wire_roundtrip():
+    """VOTE codec (batched 2PC prepare): two packed bitsets survive the
+    encode/decode round trip at non-multiple-of-8 sizes."""
+    from deneva_tpu.runtime import wire
+
+    rng = np.random.default_rng(3)
+    for n in (1, 7, 64, 1000):
+        commit = rng.random(n) < 0.5
+        abort = ~commit & (rng.random(n) < 0.3)
+        epoch, c, a = wire.decode_vote(wire.encode_vote(117, commit, abort))
+        assert epoch == 117 and len(c) == n
+        assert (c == commit).all() and (a == abort).all()

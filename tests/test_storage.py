@@ -163,3 +163,29 @@ def test_sorted_index_range_between():
     np.testing.assert_array_equal(np.asarray(ok)[0],
                                   [True, True, True, False, False, False, False, False])
     np.testing.assert_array_equal(np.asarray(s)[0][:3], [2, 3, 4])
+
+
+def test_mc_layout_roundtrip_and_geometry():
+    """to_mc_layout permutes rows owner-major: block d holds exactly the
+    anchors ≡ d (mod D) in anchor order, data is preserved, and pad rows
+    are zero (the block-local trash)."""
+    from deneva_tpu.storage.table import (fill_columns, mc_block_geometry,
+                                          to_mc_layout)
+
+    schema = parse_schema("TABLE=T\n\t8,int64_t,V\n")
+    cap, R, D = 24 * 5, 5, 4            # 24 anchors x 5 rows, 4 blocks
+    tab = DeviceTable.create(schema.table("T"), cap)
+    vals = np.arange(cap, dtype=np.int32) * 7 + 3
+    tab = fill_columns(tab, cap, {"V": vals})
+    mc = to_mc_layout(tab, D, anchor_rows=R)
+    local_rows, lb = mc_block_geometry(cap, R, D)
+    assert local_rows == (24 // D) * R and mc.mc_parts == D
+    col = np.asarray(mc.columns["V"])
+    assert col.shape[0] == D * lb
+    for d in range(D):
+        block = col[d * lb:(d + 1) * lb]
+        anchors = [d + D * j for j in range(24 // D)]
+        expect = np.concatenate(
+            [vals[a * R:(a + 1) * R] for a in anchors])
+        assert (block[:local_rows] == expect).all(), d
+        assert (block[local_rows:] == 0).all(), d   # block trash/pad
