@@ -66,8 +66,19 @@ _REGISTRY: dict[CCAlg, CCBackend] = {
     CCAlg.MVCC: CCBackend(CCAlg.MVCC, validate_mvcc, init_mvcc_state,
                           commit_state=commit_to_state),
     CCAlg.MAAT: CCBackend(CCAlg.MAAT, validate_maat, _NO_STATE),
+    # forward=True: on blind-write workloads (YCSB) the forwarding
+    # executor is the closed form of the reference Calvin's RFWD dirty-
+    # read forwarding — the whole batch commits whatever the chain depth,
+    # exactly like the reference's scheduler grinding a hot-key queue
+    # serially WITHIN the batch (it never defers a chain to the next
+    # epoch).  The chained sub-round path remains for non-blind
+    # workloads (TPC-C/PPS), where its level budget models the lock
+    # queues.  Round-2 weak #3 (CALVIN collapsing at high skew) was this
+    # missing equivalence: the level budget denied what the reference
+    # merely serializes.
     CCAlg.CALVIN: CCBackend(CCAlg.CALVIN, validate_calvin, _NO_STATE,
-                            chained=True, exempt_order_free=True),
+                            chained=True, forward=True,
+                            exempt_order_free=True),
     CCAlg.TPU_BATCH: CCBackend(CCAlg.TPU_BATCH, validate_tpu_batch, _NO_STATE,
                                chained=True, forward=True,
                                exempt_order_free=True),

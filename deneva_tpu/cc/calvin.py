@@ -20,8 +20,20 @@ preserved rank keeps them at the head — deterministic order is preserved,
 they just commit in a later batch (the reference's epochs likewise bound
 batch extent in time, `config.h:348`).
 
-TPU_BATCH = the same deterministic chained executor, minus the fiction of
-a separate sequencer node: ranks are pool arrival order, and the conflict
+On blind-write workloads (YCSB) both backends take the single-pass
+forwarding executor instead of sub-rounds (`cc.__init__` registry,
+``forward=True``): a reader of a key with an earlier in-batch writer
+receives that writer's value arithmetically (ops/forward), which is the
+*closed form* of RFWD — the reference's scheduler likewise executes a
+hot-key chain serially WITHIN the batch and commits all of it, whatever
+its depth.  This is what makes the deterministic backends flat under
+skew (the paper's signature Calvin result); the sub-round level budget
+applies only where writes depend on reads (TPC-C/PPS), and execution
+runs only the levels that actually occur (`lax.while_loop`, not a fixed
+unroll), so raising ``exec_subrounds`` costs nothing at low contention.
+
+TPU_BATCH = the same deterministic executor, minus the fiction of a
+separate sequencer node: ranks are pool arrival order, and the conflict
 matrix is dual-hash exact.  It commits *everything* (cycle-free by
 construction since edges follow rank), so throughput is bounded by chain
 depth rather than abort rate — the design SURVEY §7 stage 8 targets.  The

@@ -47,13 +47,18 @@ MVCC (multi-version) differences:
 * RMW accesses (read & write of one key) must read latest: ``wts[k] > ts``
   still aborts — serving an old version to a read-modify-write would
   corrupt the newer committed value.
-* Value fidelity caveat (documented divergence): an old-version read
-  *commits with the correct serialization claim*, but the executed gather
-  returns the current snapshot value, not the historical bytes — version
-  *decisions* are tracked (the CC-observable behavior: commit/abort/order
-  match `row_mvcc.cpp`), version *payloads* are not materialized (device
-  memory economics, SURVEY §7).  Affects only the read-checksum statistic;
-  writes never depend on old-version reads (RMWs read latest, above).
+* Old-version *payloads* are materialized per row: the workload's
+  version-value ring (`storage.table.VersionRing`, wired in
+  `workloads/ycsb.py`) records the bytes each committed write overwrote,
+  and a committed stale read gathers the version current at its ts —
+  matching `row_mvcc.cpp:172-196` value-for-value (oracle:
+  `tests/test_cc.py::test_mvcc_serves_historical_bytes`).  The bucket
+  boundary ring here makes the retention DECISION; its commit rule
+  (``ts >= min(ring)``) guarantees the per-row ring still holds the
+  needed version (at most H-1 boundaries, hence at most H-1 per-row
+  overwrites, can exceed a servable ts).  TPC-C/PPS remain
+  decision-faithful without value rings (their executors read many
+  columns; documented narrow divergence).
 
 Timestamps are epoch-fresh on restart exactly as the reference re-stamps
 restarted txns (`system/worker_thread.cpp:492-508`); deferred (waiting)

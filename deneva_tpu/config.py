@@ -238,6 +238,23 @@ class Config:
 
     # ---- deployment (harness): in-process engine vs multi-process cluster
     deploy: str = "inproc"         # inproc | cluster
+    pipeline_epochs: int = 8       # cluster merged mode: epochs fused into ONE
+    #                                device dispatch (lax.scan group).  The
+    #                                host<->device round trips (merged-batch
+    #                                feed up, commit masks down) amortize over
+    #                                the whole group instead of being paid per
+    #                                epoch — the round-2 measured 430 ms/epoch
+    #                                on the tunneled chip was >99% this
+    #                                per-epoch transfer overhead.  1 = the
+    #                                round-1 synchronous loop.
+    pipeline_groups: int = 2       # cluster merged mode: dispatch groups kept
+    #                                in flight before blocking on the oldest
+    #                                group's commit masks (double buffering:
+    #                                epoch e+1's admission/exchange/codec work
+    #                                overlaps epoch e's device step — the
+    #                                reference's sequencer-vs-worker thread
+    #                                decoupling, system/calvin_thread.cpp:102).
+    #                                1 = retire synchronously.
     dist_protocol: str = "auto"    # cluster coordination for non-deterministic
     #                                backends (reference 2PC,
     #                                system/txn.cpp:498-606):
@@ -335,6 +352,11 @@ class Config:
                f"bad tport_type {self.tport_type!r}")
         _check(self.deploy in ("inproc", "cluster"),
                f"bad deploy {self.deploy!r}")
+        _check(self.pipeline_epochs >= 1 and self.pipeline_groups >= 1,
+               "pipeline_epochs/pipeline_groups must be >= 1")
+        _check(self.client_batch_size >= 64,
+               "client_batch_size must be >= 64 (the client skips sends "
+               "smaller than one minimal message, client.py)")
         _check(self.dist_protocol in ("auto", "vote", "merged"),
                f"bad dist_protocol {self.dist_protocol!r}")
         if (self.logging or self.replica_cnt) and self.node_cnt > 1 \

@@ -21,12 +21,20 @@ import os
 import jax
 import numpy as np
 
+# Bump whenever the EngineState pytree LAYOUT changes (new/renamed state
+# fields, cc_state reshapes, db companion tables) so a stale checkpoint
+# fails with a clear message instead of an opaque tree/shape error.
+# History: 1 = round-2 (TOState->MVCCState, watermark_buckets split);
+#          2 = round-3 (MVCC per-row VersionRing joins the db pytree).
+SCHEMA_VERSION = 2
+
 
 def save_state(path: str, state) -> None:
     """Dump a state pytree (EngineState or any pytree of arrays)."""
     leaves_p = jax.tree_util.tree_flatten_with_path(state)[0]
     payload = {f"leaf_{i:04d}": np.asarray(jax.device_get(v))
                for i, (_, v) in enumerate(leaves_p)}
+    payload["__schema__"] = np.int64(SCHEMA_VERSION)
     payload["__paths__"] = np.array(
         [jax.tree_util.keystr(p) for p, _ in leaves_p])
     buf = io.BytesIO()
@@ -42,6 +50,13 @@ def load_state(path: str, template):
     """Rebuild a state pytree from ``path`` using ``template`` (a freshly
     initialized state of the same config) for structure and placement."""
     with np.load(path, allow_pickle=False) as z:
+        saved_schema = int(z["__schema__"]) if "__schema__" in z else 0
+        if saved_schema != SCHEMA_VERSION:
+            raise ValueError(
+                f"incompatible checkpoint: schema v{saved_schema} "
+                f"(this build writes v{SCHEMA_VERSION}) — the engine "
+                "state layout changed between builds; re-run from "
+                "scratch (checkpoints are not migrated)")
         paths = list(z["__paths__"])
         leaves_t, treedef = jax.tree_util.tree_flatten_with_path(template)
         if len(paths) != len(leaves_t):

@@ -167,7 +167,12 @@ class TxnPool:
                 pool.queries
         big = jnp.iinfo(jnp.int32).max
         key = jnp.where(runnable, pool.seq, big)
-        slots = jnp.argsort(key)[: self.b].astype(jnp.int32)
+        # top_k beats a full argsort 8x at large pools (measured 5 ms vs
+        # 40 ms at P=100k on v5e — the round-2 ycsb_inflight TIF=100k
+        # regression); -key selects the B smallest seqs, descending
+        # top_k order = ascending seq, ties index-stable like argsort
+        _, slots = jax.lax.top_k(-key, self.b)
+        slots = slots.astype(jnp.int32)
         active = jnp.take(runnable, slots)
         queries = jax.tree.map(lambda l: jnp.take(l, slots, axis=0),
                                pool.queries)

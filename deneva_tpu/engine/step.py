@@ -99,6 +99,39 @@ def count_by_type(stats: dict, wl, queries, commit, abort) -> None:
         (onehot & abort[:, None]).sum(axis=0, dtype=jnp.uint32)
 
 
+def _run_levels(cfg, wl, db, queries, exec_commit, verdict, stats):
+    """Chained sub-round execution to the DYNAMIC depth of this epoch.
+
+    Level-l txns read state that includes all writes of levels < l (the
+    deterministic lock-queue order).  A `lax.while_loop` runs exactly
+    ``max committed level + 1`` passes instead of unrolling the full
+    ``exec_subrounds`` budget — at low contention most epochs execute 1-2
+    levels, so a generous budget (deep-chain admission) no longer costs
+    idle full-batch passes on shallow epochs.
+    """
+    lv_max = jnp.max(jnp.where(exec_commit, verdict.level, 0))
+
+    def cond(carry):
+        lvl, _, _ = carry
+        return lvl <= lv_max
+
+    def body(carry):
+        lvl, db, stats = carry
+        m = exec_commit & (verdict.level == lvl)
+        # level_exec: each level's committed set is write-conflict-free
+        # by construction (true conflicts are a subset of the hashed
+        # over-approximation), so executors skip the last_writer
+        # scatter-max tournament
+        stats = dict(stats)
+        db = wl.execute(db, queries, m, verdict.order, stats,
+                        level_exec=True)
+        return lvl + 1, db, stats
+
+    _, db, stats = jax.lax.while_loop(
+        cond, body, (jnp.zeros((), jnp.int32), db, stats))
+    return db, stats
+
+
 class Engine:
     """Binds (config, workload, cc backend) into jitted step/scan fns."""
 
@@ -208,14 +241,8 @@ class Engine:
                                 verdict.order, verdict.level, stats,
                                 chained=be.chained and cfg.mode == Mode.NORMAL)
             elif be.chained and cfg.mode == Mode.NORMAL:
-                for lvl in range(cfg.exec_subrounds):
-                    m = exec_commit & (verdict.level == lvl)
-                    # level_exec: each level's committed set is
-                    # write-conflict-free by construction (true conflicts
-                    # are a subset of the hashed over-approximation), so
-                    # executors skip the last_writer scatter-max tournament
-                    db = wl.execute(db, queries, m, verdict.order, stats,
-                                    level_exec=True)
+                db, stats = _run_levels(cfg, wl, db, queries, exec_commit,
+                                        verdict, stats)
             else:
                 db = wl.execute(db, queries, exec_commit, verdict.order,
                                 stats)

@@ -26,7 +26,11 @@ from deneva_tpu.runtime import wire
 from deneva_tpu.runtime.native import NativeTransport
 from deneva_tpu.stats import Stats
 
-TAG_RING = 1 << 20            # outstanding-tag ring per client
+TAG_RING = 1 << 22            # outstanding-tag ring per client: must
+#                               exceed the per-client inflight cap or tag
+#                               reuse corrupts latency matching (the
+#                               pipelined server holds pipeline_epochs *
+#                               pipeline_groups * epoch_batch txns open)
 
 
 class ClientNode:

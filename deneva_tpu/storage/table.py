@@ -181,6 +181,73 @@ class DeviceTable:
         return DeviceTable(**d)
 
 
+@dataclass
+class VersionRing:
+    """Per-row bounded version history for ONE column (reference
+    `row_mvcc.{h,cpp}`: HIS_RECYCLE_LEN-deep write history per row,
+    `row_mvcc.cpp:172-196,303-321`).
+
+    Entry semantics: slot ``(r, i)`` records that a committed write with
+    timestamp ``wts[r, i]`` OVERWROTE the value ``old[r, i]`` — i.e. the
+    stored bytes are the version that was current in ``[prev_wts, wts)``.
+    A reader at timestamp t therefore takes the ``old`` of the OLDEST
+    entry with ``wts > t`` (the first overwrite after its read point); if
+    no entry is newer than t, the live table value is correct.  Rows never
+    written keep all-zero entries, which serve every reader from the live
+    table — the load-time base version needs no materialization.
+
+    Retention/GC is the bucket boundary ring in `cc/timestamp.MVCCState`:
+    a read COMMITS only when ``ts >= min(bucket boundaries)``, and at most
+    H-1 distinct epoch boundaries (hence at most H-1 per-row overwrites)
+    can exceed such a ts, so the needed entry is always retained here.
+    The decision ring is a hashed over-approximation (may abort a
+    servable read, never serves a wrong one); this ring is exact per row.
+    """
+
+    wts: jax.Array   # int32[R, H]   timestamp of the overwriting write
+    old: jax.Array   # dtype[R, H, *extra] bytes the write replaced
+    pos: jax.Array   # int32[R]      next ring slot per row
+
+    @classmethod
+    def create(cls, nrows: int, depth: int, dtype, extra: tuple = ()
+               ) -> "VersionRing":
+        return cls(wts=jnp.zeros((nrows, depth), jnp.int32),
+                   old=jnp.zeros((nrows, depth, *extra), dtype=dtype),
+                   pos=jnp.zeros((nrows,), jnp.int32))
+
+    def select(self, slots: jax.Array, ts: jax.Array, current: jax.Array
+               ) -> jax.Array:
+        """Version-correct read values: ``slots``/``ts`` broadcast over the
+        access shape; ``current`` is the live-table gather result."""
+        big = jnp.int32(jnp.iinfo(jnp.int32).max)
+        vw = jnp.take(self.wts, slots, axis=0)           # [..., H]
+        newer = vw > ts[..., None]
+        idx = jnp.argmin(jnp.where(newer, vw, big), axis=-1)
+        vo = jnp.take(self.old, slots, axis=0)           # [..., H, *extra]
+        ix = idx.reshape(idx.shape + (1,) * (vo.ndim - idx.ndim))
+        sel = jnp.take_along_axis(vo, ix, axis=idx.ndim).squeeze(idx.ndim)
+        has = newer.any(axis=-1)
+        has = has.reshape(has.shape + (1,) * (current.ndim - has.ndim))
+        return jnp.where(has, sel, current)
+
+    def push(self, slots: jax.Array, wts: jax.Array, old_vals: jax.Array,
+             mask: jax.Array) -> "VersionRing":
+        """Record committed overwrites (flat lanes; masked lanes land on
+        the trash row).  Callers pre-resolve duplicate slots (one winner
+        per row per epoch), so each row advances at most one ring slot."""
+        trash = jnp.int32(self.pos.shape[0] - 1)
+        sl = jnp.where(mask, slots, trash)
+        p = jnp.take(self.pos, sl)
+        return VersionRing(
+            wts=self.wts.at[sl, p].set(wts.astype(jnp.int32)),
+            old=self.old.at[sl, p].set(old_vals.astype(self.old.dtype)),
+            pos=self.pos.at[sl].set((p + 1) % self.wts.shape[1]))
+
+
+jax.tree_util.register_dataclass(
+    VersionRing, data_fields=["wts", "old", "pos"], meta_fields=[])
+
+
 def mc_block_geometry(capacity: int, anchor_rows: int, d_parts: int
                       ) -> tuple[int, int]:
     """(data rows per block, padded rows per block) of the stacked layout.

@@ -211,6 +211,12 @@ class PPSWorkload:
                         product_key=jnp.asarray(scalars[:, 2]),
                         supplier_key=jnp.asarray(scalars[:, 3]))
 
+    def from_wire_dev(self, keys, types, scalars) -> PPSQuery:
+        """Traceable from_wire (cluster dispatch jit)."""
+        return PPSQuery(txn_type=scalars[:, 0], part_key=scalars[:, 1],
+                        product_key=scalars[:, 2],
+                        supplier_key=scalars[:, 3])
+
     # -- RW-set planning with on-device recon ---------------------------
     def plan(self, db, q: PPSQuery) -> dict:
         n = q.txn_type.shape[0]

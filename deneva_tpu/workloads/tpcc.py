@@ -31,9 +31,13 @@ TPU shape — the reference's request-at-a-time state machines
 By-last-name lookup (CUSTOMER_LAST_IDX, a nonunique hash index in the
 reference): the loader assigns customer ``c`` the lastname id ``c % 1000``
 (the reference's loader uses `Lastname(c_id % 1000)` for the first 1000 and
-random beyond, `tpcc_wl.cpp` init_cust), so "find middle customer with
-lastname L in (w,d)" is pure arithmetic: ``c_id = L + 1000*(cust_per_dist
-// 1000 // 2)``.  The index is its own closed form — no probe needed.
+random beyond, `tpcc_wl.cpp` init_cust).  With ``tpcc_by_last_index``
+(default) the lookup resolves through a REAL nonunique HashIndex — bucket
+probe + postings walk to the middle matching customer
+(`_build_lastname_index`, the analogue of `index_hash.cpp:68-100`); the
+closed-form arithmetic bypass (``c_id = L + 1000*(cust_per_dist // 1000
+// 2)``) remains as the ablation path and the oracle the index probe is
+tested against.
 """
 
 from __future__ import annotations
@@ -535,6 +539,21 @@ class TPCCWorkload:
             item_valid=jnp.asarray(types[:, :I] != 0),
             supply_w=jnp.asarray(keys[:, I:2 * I]),
             quantity=jnp.asarray(keys[:, 2 * I:3 * I]))
+
+    def from_wire_dev(self, keys, types, scalars) -> TPCCQuery:
+        """Traceable from_wire (cluster dispatch jit): the float32
+        h_amount rides the wire as raw int32 bits, so the host's
+        ``.view(np.float32)`` becomes a device bitcast."""
+        import jax
+        I = self.ipt
+        return TPCCQuery(
+            txn_type=scalars[:, 0], w_id=scalars[:, 1], d_id=scalars[:, 2],
+            c_id=scalars[:, 3], c_w_id=scalars[:, 4], c_d_id=scalars[:, 5],
+            h_amount=jax.lax.bitcast_convert_type(scalars[:, 6],
+                                                  jnp.float32),
+            ol_cnt=scalars[:, 7],
+            items=keys[:, :I], item_valid=types[:, :I] != 0,
+            supply_w=keys[:, I:2 * I], quantity=keys[:, 2 * I:3 * I])
 
     # -- RW-set planning (tpcc_txn.cpp state machines, declared up front)
     def plan(self, db, q: TPCCQuery) -> dict:

@@ -27,11 +27,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deneva_tpu.config import Config
+from deneva_tpu.config import CCAlg, Config
 from deneva_tpu.ops import HotSet, Zipfian, forward_plan, last_writer
 from deneva_tpu.storage.catalog import parse_schema
 from deneva_tpu.storage.index import DenseIndex, SortedIndex
-from deneva_tpu.storage.table import DeviceTable, to_mc_layout
+from deneva_tpu.storage.table import DeviceTable, VersionRing, to_mc_layout
 
 # benchmarks/YCSB_schema.txt: MAIN_TABLE, 10 x 100-byte string fields
 YCSB_SCHEMA = "TABLE=MAIN_TABLE\n" + "".join(
@@ -39,6 +39,7 @@ YCSB_SCHEMA = "TABLE=MAIN_TABLE\n" + "".join(
 
 TABLE = "MAIN_TABLE"
 TABLE_ID = 0
+VER_TABLE = "MAIN_TABLE.F0.ver"   # MVCC per-row version-value ring
 
 
 @dataclass
@@ -190,7 +191,19 @@ class YCSBWorkload:
             # exactly the keys ≡ d (mod D) — the reference's strided node
             # partition (ycsb_wl.cpp:70-74) across CHIPS
             tab = to_mc_layout(tab, self.cfg.device_parts)
-        return {TABLE: tab}
+        db = {TABLE: tab}
+        if self.cfg.cc_alg == CCAlg.MVCC and self.cfg.device_parts == 1:
+            # per-row version-value ring (row_mvcc.cpp:172-196): stale
+            # reads of read-write txns return HISTORICAL bytes of the
+            # queried field, not the live snapshot.  Paired with the
+            # bucket boundary ring in cc/timestamp.MVCCState, which makes
+            # the retention DECISION (see VersionRing docstring for why
+            # its commit rule bounds this ring's needed depth).
+            f0 = tab.columns["F0"]
+            db[VER_TABLE] = VersionRing.create(
+                f0.shape[0], self.cfg.mvcc_his_len, f0.dtype,
+                tuple(f0.shape[1:]))
+        return db
 
     # -- query generation (ycsb_query.cpp:303-376) ---------------------
     def generate(self, rng: jax.Array, n: int) -> YCSBQuery:
@@ -223,6 +236,13 @@ class YCSBWorkload:
                   scalars: np.ndarray) -> YCSBQuery:
         return YCSBQuery(keys=jnp.asarray(keys, jnp.int32),
                          is_write=jnp.asarray(types == 2))
+
+    def from_wire_dev(self, keys, types, scalars) -> YCSBQuery:
+        """Traceable from_wire: runs INSIDE the cluster dispatch jit so
+        the wire columns cross the tunnel flat (layout-padding-free) and
+        decode on device."""
+        return YCSBQuery(keys=keys.astype(jnp.int32),
+                         is_write=types == jnp.int8(2))
 
     # -- RW-set planning ------------------------------------------------
     def plan(self, db, q: YCSBQuery) -> dict:
@@ -326,8 +346,19 @@ class YCSBWorkload:
         # reads: gather F0, fold into checksum (keeps the load alive);
         # through .gather so the multi-chip McTableView can interpose
         rmask = act & ~q.is_write
-        vals = tab.gather(jnp.where(rmask, slots, tab.capacity),
-                          ("F0",))["F0"]
+        rslots = jnp.where(rmask, slots, tab.capacity)
+        vals = tab.gather(rslots, ("F0",))["F0"]
+        ver: VersionRing | None = db.get(VER_TABLE)
+        if ver is not None:
+            # MVCC stale reads serve HISTORICAL bytes (row_mvcc.cpp:
+            # 172-196).  Verdict.order is the serialization ts, with
+            # read-only txns forced to 0 (they serialize AT the epoch
+            # snapshot, so the live gather already gave them the right
+            # version — exclude them by reading "at +inf").
+            big = jnp.int32(jnp.iinfo(jnp.int32).max)
+            ver_ts = jnp.where(order > 0, order, big)
+            vals = ver.select(rslots, jnp.broadcast_to(
+                ver_ts[:, None], rslots.shape), vals)
         rm = rmask[..., None] if full else rmask
         stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
             jnp.where(rm, vals, 0), dtype=jnp.uint32)
@@ -346,6 +377,13 @@ class YCSBWorkload:
         wvals = _field_bytes(q.keys.reshape(-1), worder, self.cfg.tup_size) \
             if full else _field_fingerprint(q.keys.reshape(-1), worder)
         db = dict(db)
+        if ver is not None:
+            # record the bytes each winning write OVERWRITES, stamped
+            # with the writer's commit ts (one winner per row per epoch,
+            # so each row advances at most one ring slot)
+            wsl = jnp.where(win, wslots, tab.capacity)
+            old_cur = jnp.take(tab.columns["F0"], wsl, axis=0)
+            db[VER_TABLE] = ver.push(wsl, worder, old_cur, win)
         db[TABLE] = tab.scatter(wslots, {"F0": wvals}, mask=win)
         stats["write_cnt"] = stats["write_cnt"] + wmask.sum(dtype=jnp.uint32)
         return db

@@ -172,18 +172,21 @@ def test_client_load_rate_throttles():
 def test_wait_die_preserves_birth_ts_across_restarts():
     """WAIT_DIE starvation-freedom: a restarted txn must keep its birth
     timestamp (reference preserves them, worker_thread.cpp:492-508);
-    fresh-ts backends must get re-stamped.  Driven directly through the
-    server's admission path."""
+    fresh-ts backends re-stamp ABORTED restarts only — deferred waiters
+    keep their birth ts like the in-process pool and the reference's
+    parked requests.  Driven directly through the server's admission
+    path."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     from deneva_tpu.runtime import wire
     from deneva_tpu.runtime.native import ipc_endpoints
     from deneva_tpu.runtime.server import ServerNode
 
-    def probe(alg):
+    def probe(alg, aborted):
         cfg = small_cfg(node_cnt=1, part_cnt=1, client_node_cnt=0,
                         cc_alg=alg)
-        node = ServerNode(cfg, ipc_endpoints(1, f"tspin_{alg}"), "cpu")
+        node = ServerNode(cfg, ipc_endpoints(1, f"tspin_{alg}_{aborted}"),
+                          "cpu")
         try:
             blk = wire.QueryBlock(
                 keys=np.zeros((4, 4), np.int32),
@@ -191,16 +194,20 @@ def test_wait_die_preserves_birth_ts_across_restarts():
                 scalars=np.zeros((4, 0), np.int32),
                 tags=np.arange(4, dtype=np.int64))
             birth = np.array([7, 9, 11, 13], np.int64)
-            node.retry.push(blk, np.zeros(4, np.int32), birth, epoch=0)
+            node.retry.push(blk, np.full(4, int(aborted), np.int32), birth,
+                            epoch=0, aborted=np.full(4, aborted, bool))
             _, _, ts = node._contribution(epoch=5)
             return birth, ts
         finally:
             node.close()
 
-    birth, ts = probe(CCAlg.WAIT_DIE)   # fresh_ts_on_restart=False
+    birth, ts = probe(CCAlg.WAIT_DIE, aborted=True)  # fresh_ts=False
     assert (ts[:4] == birth).all(), "WAIT_DIE restart lost its birth ts"
-    birth, ts = probe(CCAlg.OCC)        # fresh_ts_on_restart=True
-    assert not (ts[:4] == birth).any(), "OCC restart kept a stale ts"
+    birth, ts = probe(CCAlg.OCC, aborted=True)       # fresh_ts=True
+    assert not (ts[:4] == birth).any(), "OCC abort-restart kept a stale ts"
+    birth, ts = probe(CCAlg.TIMESTAMP, aborted=False)  # deferred waiter
+    assert (ts[:4] == birth).all(), \
+        "a deferred (waiting) txn must keep its birth ts"
 
 
 @pytest.mark.slow

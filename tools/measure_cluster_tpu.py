@@ -37,9 +37,20 @@ def main() -> int:
         req_per_query=10, max_accesses=16, synth_table_size=1 << 23,
         conflict_buckets=8192, warmup_secs=2.0, done_secs=5.0)
     points = [
-        dict(cc_alg="TPU_BATCH", epoch_batch=4096, max_txn_in_flight=16384),
-        dict(cc_alg="TPU_BATCH", epoch_batch=16384, max_txn_in_flight=65536),
-        dict(cc_alg="CALVIN", epoch_batch=4096, max_txn_in_flight=16384),
+        # headline: pipelined epoch groups (C=32 epochs/dispatch, double
+        # buffered) — the round-3 rebuild of the distributed loop.  TIF
+        # covers the full pipeline window (C*K*eb) plus client slack.
+        dict(cc_alg="TPU_BATCH", epoch_batch=16384,
+             max_txn_in_flight=2097152, client_batch_size=16384,
+             pipeline_epochs=32, pipeline_groups=2),
+        # round-2 comparable points (modest pipeline)
+        dict(cc_alg="TPU_BATCH", epoch_batch=4096, max_txn_in_flight=65536,
+             pipeline_epochs=8, pipeline_groups=2, client_batch_size=4096),
+        dict(cc_alg="TPU_BATCH", epoch_batch=16384,
+             max_txn_in_flight=262144, pipeline_epochs=8,
+             pipeline_groups=2, client_batch_size=8192),
+        dict(cc_alg="CALVIN", epoch_batch=4096, max_txn_in_flight=65536,
+             pipeline_epochs=8, pipeline_groups=2, client_batch_size=4096),
     ]
     out_dir = os.path.join("results", "cluster_tpu")
     os.makedirs(out_dir, exist_ok=True)
