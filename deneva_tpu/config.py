@@ -209,6 +209,7 @@ class Config:
     max_accesses: int = 16         # padded RW-set width per txn (covers req_per_query)
     defer_rounds_max: int = 8      # WAIT_DIE-style defer budget before forced abort
     sweep_rounds: int = 24         # serialization-sweep fixpoint iterations (chain depth cap)
+    maat_peel_rounds: int = 16     # MAAT cycle-peel iterations per epoch (leftovers defer)
     exec_subrounds: int = 4        # chained-execution levels per epoch (CALVIN/TPU_BATCH)
     mvcc_his_len: int = 4          # in-state version history depth (HIS_RECYCLE_LEN analogue)
     escrow_order_free: bool = True  # honor workload order_free (escrow/
@@ -316,6 +317,11 @@ class Config:
         _check(self.epoch_batch > 0
                and (self.epoch_batch & (self.epoch_batch - 1)) == 0,
                "epoch_batch must be a power of two (tiling discipline)")
+        if self.cc_alg == CCAlg.MAAT:
+            _check(self.epoch_batch <= 32768,
+                   "MAAT needs epoch_batch <= 32768: its ancestor-count "
+                   "order keys span epoch_batch^2 and must fit int32 "
+                   "(cc/maat.py closure branch)")
         if self.sim_full_row:
             _check(self.workload == WorkloadKind.YCSB,
                    "sim_full_row materializes YCSB payload bytes; TPCC/PPS "

@@ -25,8 +25,9 @@ import numpy as np
 # fields, cc_state reshapes, db companion tables) so a stale checkpoint
 # fails with a clear message instead of an opaque tree/shape error.
 # History: 1 = round-2 (TOState->MVCCState, watermark_buckets split);
-#          2 = round-3 (MVCC per-row VersionRing joins the db pytree).
-SCHEMA_VERSION = 2
+#          2 = round-3 (MVCC per-row VersionRing joins the db pytree);
+#          3 = round-4 (PoolState.defer_cnt for the defer budget).
+SCHEMA_VERSION = 3
 
 
 def save_state(path: str, state) -> None:

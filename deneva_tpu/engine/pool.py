@@ -54,6 +54,9 @@ class PoolState:
     ts: jax.Array           # int32[P]
     seq: jax.Array          # int32[P] arrival sequence (selection priority)
     abort_cnt: jax.Array    # int32[P]
+    defer_cnt: jax.Array    # int32[P] defers since last (re)start — the
+    #                         defer_rounds_max budget counter (reset on
+    #                         admission AND on abort/restart)
     ready_epoch: jax.Array  # int32[P]
     entry_epoch: jax.Array  # int32[P] (latency measurement)
     occupied: jax.Array     # bool[P]
@@ -62,7 +65,8 @@ class PoolState:
 
 jax.tree_util.register_dataclass(
     PoolState,
-    data_fields=["queries", "ts", "seq", "abort_cnt", "ready_epoch",
+    data_fields=["queries", "ts", "seq", "abort_cnt", "defer_cnt",
+                 "ready_epoch",
                  "entry_epoch", "occupied", "next_seq"],
     meta_fields=[])
 
@@ -96,9 +100,14 @@ class TxnPool:
             ts=jnp.zeros((p,), jnp.int32),
             seq=jnp.zeros((p,), jnp.int32),
             abort_cnt=jnp.zeros((p,), jnp.int32),
+            defer_cnt=jnp.zeros((p,), jnp.int32),
             ready_epoch=jnp.zeros((p,), jnp.int32),
             entry_epoch=jnp.zeros((p,), jnp.int32),
             occupied=jnp.zeros((p,), bool),
+            # starts at 1, never 0: ts==0 is reserved as the MVCC
+            # read-only serialization sentinel (cc/timestamp.py order,
+            # ycsb.py ver_ts); the cluster path enforces the same
+            # invariant at its stamping site (server._contribution)
             next_seq=jnp.ones((), jnp.int32))
 
     # ------------------------------------------------------------------
@@ -125,6 +134,7 @@ class TxnPool:
                 ts=jnp.where(take, newseq, pool.ts),
                 seq=jnp.where(take, newseq, pool.seq),
                 abort_cnt=jnp.where(take, 0, pool.abort_cnt),
+                defer_cnt=jnp.where(take, 0, pool.defer_cnt),
                 ready_epoch=jnp.where(take, epoch, pool.ready_epoch),
                 entry_epoch=jnp.where(take, epoch, pool.entry_epoch),
                 occupied=jnp.ones_like(pool.occupied),
@@ -147,6 +157,7 @@ class TxnPool:
             ts=jnp.where(take, newseq, pool.ts),
             seq=jnp.where(take, newseq, pool.seq),
             abort_cnt=jnp.where(take, 0, pool.abort_cnt),
+            defer_cnt=jnp.where(take, 0, pool.defer_cnt),
             ready_epoch=jnp.where(take, epoch, pool.ready_epoch),
             entry_epoch=jnp.where(take, epoch, pool.entry_epoch),
             occupied=pool.occupied | take,
@@ -194,10 +205,13 @@ class TxnPool:
                     self.backoff_cap)
             return jnp.ones_like(ac)
 
+        defer = active & ~commit & ~abort
         if self.full_pool:
             # full-pool fast path: slots is the identity, so every
             # per-slot scatter collapses to a dense elementwise update
             ac = pool.abort_cnt + abort.astype(jnp.int32)
+            # an abort is a restart: the wait budget opens afresh
+            dc = jnp.where(abort, 0, pool.defer_cnt + defer.astype(jnp.int32))
             ready = jnp.where(abort, epoch + 1 + backoff_penalty(ac),
                               pool.ready_epoch)
             ts = pool.ts
@@ -206,11 +220,14 @@ class TxnPool:
                 ts = jnp.where(abort, pool.next_seq - self.b + lane, ts)
             return PoolState(
                 queries=pool.queries, ts=ts, seq=pool.seq, abort_cnt=ac,
-                ready_epoch=ready, entry_epoch=pool.entry_epoch,
+                defer_cnt=dc, ready_epoch=ready,
+                entry_epoch=pool.entry_epoch,
                 occupied=pool.occupied & ~commit, next_seq=pool.next_seq)
 
         occ_sel = jnp.take(pool.occupied, slots) & ~commit
         ac_sel = jnp.take(pool.abort_cnt, slots) + abort.astype(jnp.int32)
+        dc_sel = jnp.where(abort, 0, jnp.take(pool.defer_cnt, slots)
+                           + defer.astype(jnp.int32))
         ready_sel = jnp.where(abort, epoch + 1 + backoff_penalty(ac_sel),
                               jnp.take(pool.ready_epoch, slots))
         ts_sel = jnp.take(pool.ts, slots)
@@ -222,6 +239,7 @@ class TxnPool:
             ts=pool.ts.at[slots].set(ts_sel),
             seq=pool.seq,
             abort_cnt=pool.abort_cnt.at[slots].set(ac_sel),
+            defer_cnt=pool.defer_cnt.at[slots].set(dc_sel),
             ready_epoch=pool.ready_epoch.at[slots].set(ready_sel),
             entry_epoch=pool.entry_epoch,
             occupied=pool.occupied.at[slots].set(occ_sel),

@@ -210,6 +210,19 @@ class Engine:
             inc = build_conflict_incidence(cfg, be, batch,
                                            planned.get("order_free"))
             verdict, cc_state = be.validate(cfg, state.cc_state, batch, inc)
+        # defer budget (defer_rounds_max, WAIT_DIE-style wait timeout): a
+        # txn deferred past the budget force-restarts with fresh ts +
+        # backoff — the liveness backstop for waits that never resolve
+        # on their own (e.g. a MAAT cycle longer than 2^closure_rounds
+        # evading conviction).  Deterministic backends are exempt: their
+        # defers are part of the replicated decision and resolve by
+        # construction (the committed prefix always advances).
+        if not be.chained and cfg.defer_rounds_max > 0:
+            stuck = verdict.defer & active \
+                & (sel(pool.defer_cnt) >= jnp.int32(cfg.defer_rounds_max))
+            verdict = dataclasses.replace(
+                verdict, abort=verdict.abort | stuck,
+                defer=verdict.defer & ~stuck)
         # a forced txn completes-as-aborted only when the CC would not
         # retry it anyway (CC aborts/defers follow their normal path)
         if forced is not None:

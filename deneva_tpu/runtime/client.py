@@ -59,6 +59,23 @@ class ClientNode:
         # override max_txn_in_flight), floored at one minimal send
         self.cap = max(64,
                        cfg.max_txn_in_flight // max(cfg.client_node_cnt, 1))
+        # tag-ring soundness (ADVICE r3): a tag may be reissued only
+        # after its txn left the system.  Tags come from ONE ring shared
+        # across all servers while ``cap`` bounds inflight PER server, so
+        # the bound is cap * n_srv total outstanding; the servers' whole
+        # pipeline window must fit a ring lap too
+        total_cap = self.cap * self.n_srv
+        # epoch_batch is already the CLUSTER-wide merged batch (servers
+        # split it b_loc = epoch_batch/n_srv), so no n_srv factor here
+        window = (cfg.pipeline_epochs * cfg.pipeline_groups
+                  * cfg.epoch_batch)
+        if total_cap >= TAG_RING or window >= TAG_RING:
+            raise ValueError(
+                f"client tag ring ({TAG_RING}) must exceed both the "
+                f"total outstanding cap ({total_cap} = per-server cap * "
+                f"{self.n_srv} servers) and the servers' pipeline window "
+                f"({window}); shrink max_txn_in_flight or the pipeline "
+                "depth")
         self.send_us = np.zeros(TAG_RING, np.int64)   # tag -> send time
         self.next_tag = 0
         self.stats = Stats()

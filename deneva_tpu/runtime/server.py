@@ -307,17 +307,20 @@ class _RetryQueue:
 
     def __init__(self, backoff: bool, cap: int = 64):
         self.items: list[tuple[int, wire.QueryBlock, np.ndarray,
-                               np.ndarray, np.ndarray]] = []
+                               np.ndarray, np.ndarray, np.ndarray]] = []
         self.backoff = backoff
         self.cap = cap
 
     def push(self, block: wire.QueryBlock, abort_cnt: np.ndarray,
              ts: np.ndarray, epoch: int,
-             aborted: np.ndarray | None = None) -> None:
+             aborted: np.ndarray | None = None,
+             defer_cnt: np.ndarray | None = None) -> None:
         if not len(block):
             return
         if aborted is None:
             aborted = abort_cnt > 0
+        if defer_cnt is None:
+            defer_cnt = np.zeros(len(block), np.int32)
         # clamp the exponent, not the power: 2**(cnt-1) overflows int32
         # past cnt=32 and would turn the penalty negative
         exp = np.minimum(np.maximum(abort_cnt - 1, 0),
@@ -329,13 +332,13 @@ class _RetryQueue:
             m = ready == r
             idx = np.where(m)[0]
             self.items.append((int(r), block.take(idx), abort_cnt[m],
-                               ts[idx], aborted[m]))
+                               ts[idx], aborted[m], defer_cnt[m]))
 
     def pop_ready(self, epoch: int, limit: int):
-        take_b, take_c, take_t, take_a, rest = [], [], [], [], []
+        take_b, take_c, take_t, take_a, take_d, rest = [], [], [], [], [], []
         n = 0
         self.items.sort(key=lambda it: it[0])
-        for r, blk, cnt, ts, ab in self.items:
+        for r, blk, cnt, ts, ab, dc in self.items:
             if r <= epoch and n < limit:
                 room = limit - n
                 if len(blk) <= room:
@@ -343,19 +346,21 @@ class _RetryQueue:
                     take_c.append(cnt)
                     take_t.append(ts)
                     take_a.append(ab)
+                    take_d.append(dc)
                     n += len(blk)
                 else:
                     take_b.append(blk.slice(0, room))
                     take_c.append(cnt[:room])
                     take_t.append(ts[:room])
                     take_a.append(ab[:room])
+                    take_d.append(dc[:room])
                     rest.append((r, blk.slice(room, len(blk)), cnt[room:],
-                                 ts[room:], ab[room:]))
+                                 ts[room:], ab[room:], dc[room:]))
                     n = limit
             else:
-                rest.append((r, blk, cnt, ts, ab))
+                rest.append((r, blk, cnt, ts, ab, dc))
         self.items = rest
-        return take_b, take_c, take_t, take_a
+        return take_b, take_c, take_t, take_a, take_d
 
 
 class ServerNode:
@@ -385,6 +390,13 @@ class ServerNode:
             cfg.dist_protocol == "auto" and self.n_srv > 1
             and not deterministic and cfg.cc_alg != CCAlg.MAAT
             and not cfg.ycsb_abort_mode)
+        # cluster analogue of the engine's defer budget (engine/step.py):
+        # a txn deferred past defer_rounds_max force-restarts as an abort
+        # at retirement.  Node-local retry policy like abort backoff —
+        # it never enters the replicated verdict computation.
+        # Deterministic backends are exempt (their defers resolve by
+        # construction).
+        self.defer_budget = 0 if deterministic else cfg.defer_rounds_max
         # pipeline shape: C epochs per device dispatch, K groups in
         # flight.  The VOTE protocol needs a host round trip (prepare ->
         # vote exchange -> decide) inside every epoch, so it cannot fuse
@@ -493,8 +505,9 @@ class ServerNode:
         its starvation-freedom) — and even then only entries whose last
         verdict was an ABORT: deferred (waiting) txns keep their birth ts
         like the in-process pool and the reference's parked requests.
-        Returns (block, abort_cnt, ts)."""
-        blocks, counts, tss, abms = self.retry.pop_ready(epoch, self.b_loc)
+        Returns (block, abort_cnt, ts, defer_cnt)."""
+        blocks, counts, tss, abms, dfcs = self.retry.pop_ready(
+            epoch, self.b_loc)
         if self.be.fresh_ts_on_restart:
             # mark aborted retries for re-stamping (-1 = stamp me below)
             tss = [np.where(ab, np.int64(-1), ts)
@@ -514,11 +527,13 @@ class ServerNode:
                                           packed))
             counts.append(np.zeros(len(use), np.int32))
             tss.append(np.full(len(use), -1, np.int64))   # -1 = stamp me
+            dfcs.append(np.zeros(len(use), np.int32))
             n += len(use)
         if not blocks:
             blocks = [wire.QueryBlock.empty(self._width, self._n_scalars)]
             counts = [np.zeros(0, np.int32)]
             tss = [np.zeros(0, np.int64)]
+            dfcs = [np.zeros(0, np.int32)]
         block = wire.QueryBlock.concat(blocks)
         ts = np.concatenate(tss)
         base = np.int64(epoch + 1) * self.b_merged + self.me * self.b_loc
@@ -531,7 +546,14 @@ class ServerNode:
         # fresh arrivals and (for fresh-ts backends) aborted restarts
         # carry the -1 sentinel; deferred waiters keep their birth ts
         ts = np.where(ts < 0, stamped, ts)
-        return block, np.concatenate(counts), ts
+        if len(ts) and ts.min() < 1:
+            # ts==0 is reserved as the MVCC read-only serialization
+            # sentinel (cc/timestamp.py order, ycsb.py ver_ts): a real
+            # txn stamped 0 would be misrouted to the live snapshot
+            raise RuntimeError(
+                f"birth timestamp below 1 (min={ts.min()}): the ts>=1 "
+                "stamping invariant is broken")
+        return block, np.concatenate(counts), ts, np.concatenate(dfcs)
 
     def _durable_through(self) -> int:
         """Highest epoch that is on disk locally AND acked by every one of
@@ -672,7 +694,7 @@ class ServerNode:
             done, abort, defer = (np.asarray(m)
                                   for m in jax.device_get(group["masks"]))
         self._ph["process"] += time.monotonic() - t0
-        for i, (epoch, block, abort_cnt, birth_ts) in enumerate(
+        for i, (epoch, block, abort_cnt, birth_ts, dfc) in enumerate(
                 group["eps"]):
             n = len(block)
             my_commit = done[i, :n]
@@ -689,15 +711,28 @@ class ServerNode:
                         # group commit: hold until epoch is durable
                         self._held_rsp.append(rsp)
             ab = abort[i, :n]
+            df = defer[i, :n]
+            if self.defer_budget:
+                # defer budget (engine/step.py analogue): past the
+                # budget a wait force-restarts as an abort.  Host-side
+                # conversion, so the DEVICE abort counter does not see
+                # these — [summary] totals can differ from an in-process
+                # run by the (rare) conversion count.
+                stuck = df & (dfc[:n] >= self.defer_budget)
+                ab = ab | stuck
+                df = df & ~stuck
             # exact unique-txn aborts (stats.h:60-61): first abort of a
             # txn is the one whose retry counter is still zero
             self._uniq_aborts += int((ab & (abort_cnt == 0)).sum())
-            restart = ab | defer[i, :n]
+            restart = ab | df
             if restart.any():
                 idx = np.where(restart)[0]
                 # aborts bump the backoff counter; defers restart free
+                # (with their wait budget spent recorded)
                 self.retry.push(block.take(idx), abort_cnt[idx] + ab[idx],
-                                birth_ts[idx], epoch, aborted=ab[idx])
+                                birth_ts[idx], epoch, aborted=ab[idx],
+                                defer_cnt=np.where(
+                                    ab, 0, dfc[:n] + df)[idx])
         self._flush_held_rsp()
         if tl:
             tl.mark("retire")
@@ -788,25 +823,25 @@ class ServerNode:
                         self.tp.send(p, "SHUTDOWN", sd)
                 self.tp.flush()
             # ---- assemble + broadcast contributions for the group -----
-            eps: list[tuple[int, wire.QueryBlock, np.ndarray, np.ndarray]] \
-                = []
+            eps: list[tuple[int, wire.QueryBlock, np.ndarray, np.ndarray,
+                            np.ndarray]] = []
             for i in range(C):
                 e = epoch0 + i
                 if i:
                     self._drain()
-                block, abort_cnt, birth_ts = self._contribution(e)
+                block, abort_cnt, birth_ts, dfc = self._contribution(e)
                 blob = wire.encode_epoch_blob(e, block, birth_ts)
                 for p in range(self.n_srv):
                     if p != self.me:
                         self.tp.send(p, "EPOCH_BLOB", blob)
-                eps.append((e, block, abort_cnt, birth_ts))
+                eps.append((e, block, abort_cnt, birth_ts, dfc))
             self.tp.flush()
             if tl:
                 tl.mark("admit")
             # ---- collect every peer's contributions -------------------
             t0 = time.monotonic()
             merged_parts = []
-            for e, block, _, birth_ts in eps:
+            for e, block, _, birth_ts, _ in eps:
                 self._wait_blobs(e)
                 parts = self.blob_buf.pop(e, {})
                 parts[self.me] = (block, birth_ts)

@@ -354,7 +354,9 @@ class YCSBWorkload:
             # 172-196).  Verdict.order is the serialization ts, with
             # read-only txns forced to 0 (they serialize AT the epoch
             # snapshot, so the live gather already gave them the right
-            # version — exclude them by reading "at +inf").
+            # version — exclude them by reading "at +inf").  Safe because
+            # real txn ts are >= 1 by construction — pool.next_seq starts
+            # at 1 and server._contribution raises on a sub-1 stamp.
             big = jnp.int32(jnp.iinfo(jnp.int32).max)
             ver_ts = jnp.where(order > 0, order, big)
             vals = ver.select(rslots, jnp.broadcast_to(
