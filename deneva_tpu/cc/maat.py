@@ -79,12 +79,20 @@ from deneva_tpu.ops import (earlier_edges, greedy_first_fit,
                             precedence_levels)
 
 
-def validate_maat(cfg, state, batch: AccessBatch, inc: Incidence):
-    b = batch.active.shape[0]
-    # P[i, j] = i must precede j  (i read a key j writes; snapshot read)
+def must_precede(cfg, inc: Incidence, b: int):
+    """P[i, j] = i must precede j (i read a key j writes; snapshot read),
+    minus the RMW self-overlap diagonal.  The ONE edge derivation shared
+    by validate_maat and the distributed verify round
+    (runtime/server.make_vote_steps.check): the verify round must check
+    exactly the edge set the positions were negotiated for."""
     ov = get_overlap(cfg)
     p = ov(inc.r1, inc.w1, inc.r2, inc.w2)
-    p = p & ~jnp.eye(b, dtype=bool)          # RMW self-overlap is not an edge
+    return p & ~jnp.eye(b, dtype=bool)
+
+
+def validate_maat(cfg, state, batch: AccessBatch, inc: Incidence):
+    b = batch.active.shape[0]
+    p = must_precede(cfg, inc, b)
     lane = jnp.arange(b, dtype=jnp.int32)
 
     # -- stage 1: mutual pairs -> lex-first MIS, losers' ranges close ---

@@ -377,9 +377,6 @@ class Config:
             _check(self.cc_alg not in (CCAlg.CALVIN, CCAlg.TPU_BATCH),
                    "deterministic backends coordinate via the merged-batch "
                    "sequencer exchange, not 2PC votes")
-            _check(self.cc_alg != CCAlg.MAAT,
-                   "distributed MAAT needs the reference's timestamp-range "
-                   "negotiation; merged mode preserves its semantics")
             _check(not self.ycsb_abort_mode,
                    "forced-abort sentinel is a merged-mode debug oracle")
         _check(self.repl_type in ("AP", "AA"),

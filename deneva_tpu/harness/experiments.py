@@ -180,8 +180,14 @@ def cluster_scaling(quick: bool) -> list[Config]:
     nodes = (1, 2) if quick else (1, 2, 4)
     algs = ("CALVIN", "TPU_BATCH") if quick else ("NO_WAIT", "CALVIN",
                                                   "TPU_BATCH")
-    return [base.replace(node_cnt=n, part_cnt=n, cc_alg=CCAlg(a))
-            for n in nodes for a in algs]
+    pts = [base.replace(node_cnt=n, part_cnt=n, cc_alg=CCAlg(a))
+           for n in nodes for a in algs]
+    # distributed MAAT (round-4): partition-local validation with
+    # position-bound negotiation on the votes (maat.cpp:176-190)
+    pts += [base.replace(node_cnt=n, part_cnt=n, cc_alg=CCAlg.MAAT,
+                         dist_protocol="vote")
+            for n in ((2,) if quick else (2, 4))]
+    return pts
 
 
 def network_sweep(quick: bool) -> list[Config]:

@@ -28,7 +28,7 @@ _lib: C.CDLL | None = None
 RTYPE = {
     "INIT_DONE": 1, "CL_QRY_BATCH": 2, "CL_RSP": 3, "RDONE": 4,
     "EPOCH_BLOB": 5, "LOG_MSG": 6, "LOG_RSP": 7, "PING": 8, "PONG": 9,
-    "SHUTDOWN": 10, "MEASURE": 11, "VOTE": 12,
+    "SHUTDOWN": 10, "MEASURE": 11, "VOTE": 12, "VOTE2": 13,
 }
 RTYPE_NAME = {v: k for k, v in RTYPE.items()}
 

@@ -226,13 +226,32 @@ def make_vote_steps(cfg: Config, wl, be):
       backend's serialization order in vote mode is a *globally shared*
       total order (rank for locks/OCC, birth-ts for T/O) — so the union
       of locally-conflict-free commit sets is serializable in that order.
-      (MAAT's locally-derived order is not shared — config rejects it;
-      the reference negotiates its ranges through 2PC payloads instead.)
+      (MAAT's locally-derived order is not shared — it negotiates
+      positions through the vote payloads instead, below.)
     * ``apply(...)`` — after the vote exchange decides (commit = every
       owner voted yes, abort = any owner voted abort, else wait), execute
       the decided set locally and advance cross-epoch CC state for
       GLOBAL commits only (`CCBackend.commit_state` — the reference
       updates row ts-state on the 2PC commit path, not at prepare).
+
+    MAAT (round-4): its dynamic serialization order is locally derived,
+    so the vote additionally negotiates POSITIONS, the batch analogue of
+    the reference's timestamp-range negotiation
+    (`concurrency_control/maat.cpp:176-190` intersects `[lower,upper)`
+    bounds shipped on RACK_PREP, `transport/message.cpp:1057-1137`):
+
+    1. prepare: each owner's local validate yields per-txn lower-bound
+       positions (``verdict.order // b`` — its local ancestor count),
+       piggybacked on the VOTE message;
+    2. intersect: every node takes the elementwise MAX of all bounds —
+       the least position satisfying every owner's local constraints
+       (the reference's range intersection, commit point = lower end);
+    3. verify (``check``): each owner re-checks its local must-precede
+       edges against the final positions; a violated edge — exactly the
+       signature of a CROSS-NODE cycle such as distributed write skew,
+       which no single owner can see — aborts its later-positioned
+       endpoint, announced in a second VOTE round.  Survivors' edges all
+       agree with one shared total order, so the union is serializable.
     """
     import jax
     import jax.numpy as jnp
@@ -267,16 +286,41 @@ def make_vote_steps(cfg: Config, wl, be):
             return jnp.where(batch.ro_hint, 0, batch.ts)
         return batch.rank
 
+    maat = cfg.cc_alg == CCAlg.MAAT
+
     @jax.jit
     def vote(db, cc_state, query, active, ts):
         batch, planned = local_batch(db, query, active, ts)
         inc = build_conflict_incidence(cfg, be, batch,
                                        planned.get("order_free"))
         verdict, _ = be.validate(cfg, cc_state, batch, inc)
-        return verdict.commit, verdict.abort, verdict.defer
+        # MAAT lower bound = local serialization position (order packs
+        # position * b + lane; undo the lane)
+        lo = verdict.order // jnp.int32(b)
+        return verdict.commit, verdict.abort, verdict.defer, lo
 
     @jax.jit
-    def apply(db, cc_state, stats, query, active, ts, commit, abort, defer):
+    def check(db, query, cand, ts, order):
+        """MAAT verify round: my local must-precede edges AMONG THE
+        GLOBAL COMMIT CANDIDATES (the AND of round-1 votes) vs the
+        intersected positions; a violated edge aborts its
+        later-positioned endpoint (the range that closed).  Candidates
+        only: at node_cnt=1 each candidate's position is this node's own
+        locally-consistent order, so no edge can violate and vote mode
+        decides exactly like merged mode."""
+        from deneva_tpu.cc.maat import must_precede
+        batch, planned = local_batch(db, query, cand, ts)
+        inc = build_conflict_incidence(cfg, be, batch,
+                                       planned.get("order_free"))
+        p = must_precede(cfg, inc, b)
+        p = p & cand[:, None] & cand[None, :]
+        # order values are distinct (lane tiebreak), so >= means >
+        viol = p & (order[:, None] >= order[None, :])
+        return viol.any(axis=1)
+
+    @jax.jit
+    def apply(db, cc_state, stats, query, active, ts, commit, abort,
+              defer, order):
         batch, planned = local_batch(db, query, active, ts)
         commit = commit & active
         abort = abort & active
@@ -285,7 +329,8 @@ def make_vote_steps(cfg: Config, wl, be):
             # watermark buckets are self-hashed from the batch (see
             # cc/timestamp._wm_bucket) — no incidence rebuild needed here
             cc_state = be.commit_state(cfg, cc_state, batch, None, commit)
-        db = wl.execute(db, query, commit, global_order(batch), stats)
+        db = wl.execute(db, query, commit,
+                        order if maat else global_order(batch), stats)
         stats = dict(stats)
         stats["total_txn_commit_cnt"] += commit.sum(dtype=jnp.uint32)
         stats["total_txn_abort_cnt"] += abort.sum(dtype=jnp.uint32)
@@ -294,7 +339,7 @@ def make_vote_steps(cfg: Config, wl, be):
         count_by_type(stats, wl, query, commit, abort)
         return db, cc_state, stats
 
-    return vote, apply
+    return vote, check, apply
 
 
 class _RetryQueue:
@@ -408,8 +453,9 @@ class ServerNode:
         self._width = _k.shape[1]
         self._n_scalars = _s.shape[1]
         if self.vote_mode:
-            self.vote_step, self.apply_step = make_vote_steps(
-                cfg, self.wl, self.be)
+            self.vote_step, self.check_step, self.apply_step = \
+                make_vote_steps(cfg, self.wl, self.be)
+            self.maat_vote = cfg.cc_alg == CCAlg.MAAT
         else:
             self.group_step = make_dist_group(cfg, self.wl, self.be,
                                               self._width,
@@ -445,6 +491,7 @@ class ServerNode:
         self.retry = _RetryQueue(cfg.backoff)
         self.blob_buf: dict[int, dict] = {}
         self.vote_buf: dict[int, dict] = {}
+        self.vote2_buf: dict[int, dict] = {}
         self._uniq_aborts = 0
         self.stop_epoch: int | None = None
         self.measure_epoch: int | None = None
@@ -461,8 +508,11 @@ class ServerNode:
             epoch, blk, ts = wire.decode_epoch_blob(payload)
             self.blob_buf.setdefault(epoch, {})[src] = (blk, ts)
         elif rtype == "VOTE":
-            epoch, c, a = wire.decode_vote(payload)
-            self.vote_buf.setdefault(epoch, {})[src] = (c, a)
+            epoch, c, a, bnd = wire.decode_vote(payload)
+            self.vote_buf.setdefault(epoch, {})[src] = (c, a, bnd)
+        elif rtype == "VOTE2":
+            epoch, _, a, _b = wire.decode_vote(payload)
+            self.vote2_buf.setdefault(epoch, {})[src] = a
         elif rtype == "SHUTDOWN":
             self.stop_epoch = wire.decode_shutdown(payload)
         elif rtype == "MEASURE":
@@ -591,20 +641,67 @@ class ServerNode:
         network round per epoch, amortized over the whole batch."""
         import jax.numpy as jnp
 
-        vc, va, vd = self.vote_step(self.db, self.cc_state, query,
-                                    active_j, ts_j)
+        vc, va, vd, lo = self.vote_step(self.db, self.cc_state, query,
+                                        active_j, ts_j)
         vc, va, vd = np.asarray(vc), np.asarray(va), np.asarray(vd)
         if tl:
             tl.mark("prepare")
-        msg = wire.encode_vote(epoch, vc, va)
+        msg = wire.encode_vote(epoch, vc, va,
+                               np.asarray(lo) if self.maat_vote else None)
         for p in range(self.n_srv):
             if p != self.me:
                 self.tp.send(p, "VOTE", msg)
         self.tp.flush()
+        self._wait_votes(self.vote_buf, epoch, "votes")
+        if tl:
+            tl.mark("votes")
+        commit_g, abort_g = vc.copy(), va.copy()
+        glo = np.asarray(lo).copy()
+        for c, a, bnd in self.vote_buf.pop(epoch, {}).values():
+            commit_g &= c
+            abort_g |= a
+            if bnd is not None:
+                # range intersection (maat.cpp:176-190): the least
+                # position satisfying every owner's local constraints
+                glo = np.maximum(glo, bnd)
+        order_j = jnp.zeros(len(vc), jnp.int32)
+        if self.maat_vote:
+            # verify round: every owner re-checks its local edges
+            # against the intersected positions — a violation is a
+            # cross-node cycle (e.g. distributed write skew); its
+            # later-positioned endpoint's range closes -> abort
+            b = len(vc)
+            order_np = glo.astype(np.int64) * b + np.arange(b)
+            order_j = jnp.asarray(order_np.astype(np.int32))
+            cand_np = commit_g & active_np & ~abort_g
+            ab2 = np.asarray(self.check_step(self.db, query,
+                                             jnp.asarray(cand_np),
+                                             ts_j, order_j))
+            msg2 = wire.encode_vote(epoch, np.zeros_like(ab2), ab2)
+            for p in range(self.n_srv):
+                if p != self.me:
+                    self.tp.send(p, "VOTE2", msg2)
+            self.tp.flush()
+            self._wait_votes(self.vote2_buf, epoch, "order checks")
+            abort_g |= ab2
+            for a2 in self.vote2_buf.pop(epoch, {}).values():
+                abort_g |= a2
+        commit_g &= active_np & ~abort_g      # any-abort wins
+        abort_g &= active_np
+        defer_g = active_np & ~commit_g & ~abort_g   # someone waits
+        self.db, self.cc_state, self.dev_stats = self.apply_step(
+            self.db, self.cc_state, self.dev_stats, query, active_j, ts_j,
+            jnp.asarray(commit_g), jnp.asarray(abort_g),
+            jnp.asarray(defer_g), order_j)
+        return commit_g, abort_g, defer_g
+
+    def _wait_votes(self, buf: dict, epoch: int, what: str) -> None:
+        """Collect one message per peer server into ``buf[epoch]`` with
+        dead-peer detection; the wait is carved out of process time."""
         t0 = time.monotonic()
-        while len(self.vote_buf.get(epoch, {})) < self.n_srv - 1:
+        while len(buf.get(epoch, {})) < self.n_srv - 1:
             self._drain(timeout_us=5_000)
-            have = self.vote_buf.get(epoch, {})
+            have = buf.get(epoch, {})
             if len(have) >= self.n_srv - 1:
                 break
             dead = [p for p in range(self.n_srv)
@@ -612,35 +709,21 @@ class ServerNode:
                     and not self.tp.peer_alive(p)]
             if dead:
                 self._drain(timeout_us=50_000)
-                have = self.vote_buf.get(epoch, {})
+                have = buf.get(epoch, {})
                 dead = [p for p in dead if p not in have]
             if dead and len(have) < self.n_srv - 1:
                 raise RuntimeError(
                     f"server {self.me}: peer server(s) {dead} died "
-                    f"waiting for epoch {epoch} votes")
+                    f"waiting for epoch {epoch} {what}")
             if time.monotonic() - t0 > 60:
                 raise TimeoutError(
-                    f"server {self.me}: epoch {epoch} vote wait: have "
+                    f"server {self.me}: epoch {epoch} {what} wait: have "
                     f"{sorted(have)}")
         wait = time.monotonic() - t0
         self._ph["idle"] += wait
         # the caller's process-time span covers this whole round: carve
         # the network wait back out so idle + process partition wall time
         self._ph["process"] -= wait
-        if tl:
-            tl.mark("votes")
-        commit_g, abort_g = vc.copy(), va.copy()
-        for c, a in self.vote_buf.pop(epoch, {}).values():
-            commit_g &= c
-            abort_g |= a
-        commit_g &= active_np & ~abort_g      # any-abort wins
-        abort_g &= active_np
-        defer_g = active_np & ~commit_g & ~abort_g   # someone waits
-        self.db, self.cc_state, self.dev_stats = self.apply_step(
-            self.db, self.cc_state, self.dev_stats, query, active_j, ts_j,
-            jnp.asarray(commit_g), jnp.asarray(abort_g),
-            jnp.asarray(defer_g))
-        return commit_g, abort_g, defer_g
 
     # -- blob barrier ----------------------------------------------------
     def _wait_blobs(self, epoch: int) -> None:
@@ -768,11 +851,14 @@ class ServerNode:
                 np.zeros((b, W), np.int32), np.zeros((b, W), np.int8),
                 np.zeros((b, S), np.int32))
             wa, wt = jnp.zeros(b, bool), jnp.zeros(b, jnp.int32)
-            vc, va, vd = self.vote_step(self.db, self.cc_state, warm_q,
-                                        wa, wt)
+            vc, va, vd, _lo = self.vote_step(self.db, self.cc_state,
+                                             warm_q, wa, wt)
+            if self.maat_vote:
+                self.check_step(self.db, warm_q, wa, wt,
+                                jnp.zeros(b, jnp.int32))
             out = self.apply_step(self.db, self.cc_state, self.dev_stats,
                                   warm_q, wa, wt, vc & False, va & False,
-                                  vd & False)
+                                  vd & False, jnp.zeros(b, jnp.int32))
             jax.block_until_ready(out[2]["total_txn_commit_cnt"])
         else:
             warm = jax.device_put((

@@ -117,23 +117,34 @@ def decode_cl_rsp(buf: bytes) -> np.ndarray:
 # ---- VOTE (batched 2PC prepare, reference RPREPARE/RACK_PREP,
 # `system/txn.cpp:498-606`): one server's per-txn verdict over the merged
 # epoch batch for the accesses it owns.  Three packed bitsets; commit =
-# every owner voted commit, abort = any owner voted abort. -------------
+# every owner voted commit, abort = any owner voted abort.  MAAT votes
+# additionally piggyback per-txn LOWER BOUNDS on the serialization
+# position — the batch analogue of the reference shipping `[lower,upper)`
+# timestamp ranges on RACK_PREP and intersecting at the coordinator
+# (`concurrency_control/maat.cpp:176-190`,
+# `transport/message.cpp:1057-1137`); intersection of lower bounds =
+# elementwise max, see server._vote_epoch. -----------------------------
 
-_VOTE = struct.Struct("<qI")        # epoch, n_txns
+_VOTE = struct.Struct("<qIB")       # epoch, n_txns, has_bounds
 
 
-def encode_vote(epoch: int, commit: np.ndarray, abort: np.ndarray) -> bytes:
+def encode_vote(epoch: int, commit: np.ndarray, abort: np.ndarray,
+                bounds: np.ndarray | None = None) -> bytes:
     """Two bitsets suffice: the global wait (defer) set is the complement
     ``active & ~commit & ~abort`` — a local defer vote is exactly a
     not-commit-not-abort vote, so shipping it would be redundant."""
     n = len(commit)
-    return (_VOTE.pack(epoch, n)
+    body = (_VOTE.pack(epoch, n, 0 if bounds is None else 1)
             + np.packbits(commit.astype(bool)).tobytes()
             + np.packbits(abort.astype(bool)).tobytes())
+    if bounds is not None:
+        body += np.ascontiguousarray(bounds, np.int32).tobytes()
+    return body
 
 
-def decode_vote(buf: bytes) -> tuple[int, np.ndarray, np.ndarray]:
-    epoch, n = _VOTE.unpack_from(buf)
+def decode_vote(buf: bytes
+                ) -> tuple[int, np.ndarray, np.ndarray, np.ndarray | None]:
+    epoch, n, has_bounds = _VOTE.unpack_from(buf)
     nb = (n + 7) // 8
     off = _VOTE.size
     out = []
@@ -142,7 +153,9 @@ def decode_vote(buf: bytes) -> tuple[int, np.ndarray, np.ndarray]:
                                            offset=off))[:n].astype(bool)
         out.append(bits)
         off += nb
-    return epoch, out[0], out[1]
+    bounds = np.frombuffer(buf, np.int32, count=n, offset=off) \
+        if has_bounds else None
+    return epoch, out[0], out[1], bounds
 
 
 # ---- SHUTDOWN ----------------------------------------------------------

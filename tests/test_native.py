@@ -214,7 +214,9 @@ def test_sanitizer_stress(target):
 
 
 def test_vote_wire_roundtrip():
-    """VOTE codec (batched 2PC prepare): two packed bitsets survive the
+    """VOTE codec (batched 2PC prepare): two packed bitsets — plus MAAT's
+    optional per-txn position bounds (the RACK_PREP `[lower,upper)` range
+    payload analogue, transport/message.cpp:1057-1137) — survive the
     encode/decode round trip at non-multiple-of-8 sizes."""
     from deneva_tpu.runtime import wire
 
@@ -222,6 +224,13 @@ def test_vote_wire_roundtrip():
     for n in (1, 7, 64, 1000):
         commit = rng.random(n) < 0.5
         abort = ~commit & (rng.random(n) < 0.3)
-        epoch, c, a = wire.decode_vote(wire.encode_vote(117, commit, abort))
+        epoch, c, a, bnd = wire.decode_vote(
+            wire.encode_vote(117, commit, abort))
         assert epoch == 117 and len(c) == n
         assert (c == commit).all() and (a == abort).all()
+        assert bnd is None
+        bounds = rng.integers(0, 1 << 20, n).astype(np.int32)
+        epoch, c, a, bnd = wire.decode_vote(
+            wire.encode_vote(118, commit, abort, bounds))
+        assert epoch == 118 and (c == commit).all() and (a == abort).all()
+        assert bnd is not None and (bnd == bounds).all()
