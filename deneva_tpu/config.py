@@ -210,6 +210,9 @@ class Config:
     defer_rounds_max: int = 8      # WAIT_DIE-style defer budget before forced abort
     sweep_rounds: int = 24         # serialization-sweep fixpoint iterations (chain depth cap)
     maat_peel_rounds: int = 16     # MAAT cycle-peel iterations per epoch (leftovers defer)
+    mc_plan_capacity: float = 2.0  # sharded multi-chip plan: per-chip buffer
+    #                                = factor * N/D lanes (0 = replicate
+    #                                  the full plan per chip, round-3 mode)
     exec_subrounds: int = 4        # chained-execution levels per epoch (CALVIN/TPU_BATCH)
     mvcc_his_len: int = 4          # in-state version history depth (HIS_RECYCLE_LEN analogue)
     escrow_order_free: bool = True  # honor workload order_free (escrow/

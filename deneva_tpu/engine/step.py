@@ -36,8 +36,8 @@ from deneva_tpu.cc import (AccessBatch, build_conflict_incidence,
                            get_backend)
 from deneva_tpu.config import Config, Mode
 from deneva_tpu.engine.pool import PoolState, TxnPool
-from deneva_tpu.ops import (commit_all_verdict, forward_verdict,
-                            forwarding_applies)
+from deneva_tpu.ops import (forward_verdict, forwarding_applies,
+                            mc_forward_verdict)
 
 LAT_BUCKETS = 64
 
@@ -199,9 +199,10 @@ class Engine:
                 batch, active=batch.active & ~forced)
             if cfg.device_parts > 1:
                 # multi-chip: plans are built per-shard inside
-                # wl.execute_mc; only the (trivial) verdict is global
-                verdict = commit_all_verdict(fbatch)
-                mc_batch = fbatch
+                # wl.execute_mc in capacity-bounded owned-lane buffers;
+                # the verdict is global (commit everything except the
+                # deterministic capacity-overflow defers)
+                verdict, mc_batch = mc_forward_verdict(cfg, fbatch)
             else:
                 verdict, fwd = forward_verdict(fbatch)
                 mc_batch = None

@@ -11,8 +11,10 @@ from deneva_tpu.ops.sampling import HotSet, Zipfian, uniform_keys  # noqa: F401
 from deneva_tpu.ops.scatter import last_writer  # noqa: F401
 from deneva_tpu.ops.forward import (ForwardPlan,  # noqa: F401
                                     commit_all_verdict, forward_plan,
-                                    forward_verdict, forwarding_applies,
-                                    last_earlier_writer)
+                                    forward_plan_flat, forward_verdict,
+                                    forwarding_applies,
+                                    last_earlier_writer, mc_forward_verdict,
+                                    mc_pair_cap, mc_plan_defer)
 from deneva_tpu.ops.conflict import (  # noqa: F401
     access_incidence,
     overlap,

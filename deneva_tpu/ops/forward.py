@@ -85,6 +85,85 @@ jax.tree_util.register_dataclass(
     meta_fields=[])
 
 
+def mc_pair_cap(n: int, d_parts: int, factor: float) -> int:
+    """Static per-(source slice, owner) lane capacity for the sharded
+    multi-chip plan's all_to_all exchange: ``factor`` x the even share
+    N/D^2, rounded up to the 128-lane tile.  Returns 0 when sharded
+    planning is off (factor <= 0, one chip, or a slice-indivisible
+    batch) — callers fall back to the replicated full-batch plan."""
+    if factor <= 0 or d_parts <= 1 or n % d_parts:
+        return 0
+    sl = n // d_parts
+    cap = int(factor * sl / d_parts + 127) // 128 * 128
+    cap = max(cap, 128)
+    return 0 if cap >= sl else cap
+
+
+def mc_plan_defer(keys: jax.Array, ts: jax.Array, valid: jax.Array,
+                  d_parts: int, pair_cap: int) -> jax.Array:
+    """bool[B]: txns with a lane past the per-(slice, owner) capacity.
+
+    The sharded plan gives source chip s a balanced N/D input slice and
+    routes lanes to their owner (key % D) in fixed pair_cap-sized
+    all_to_all blocks, so a skewed epoch can overflow a (slice, owner)
+    block.  Overflowing txns DEFER — deterministically, computed from
+    the replicated batch so every chip excludes the identical set (no
+    drops, no ragged routing; the MoE token-capacity pattern with
+    deferral instead of dropping).
+
+    Block priority is txn AGE (birth ts, smallest first), NOT slot
+    order: a deferred txn keeps its ts while every new arrival stamps
+    higher, so a txn that overflowed strictly rises in priority each
+    epoch until it is kept — starvation-free even in full-pool mode,
+    where deferred txns sit in fixed slots and slot-order priority
+    would let fresh hot-key arrivals in earlier slots starve them
+    forever.  The executor's per-slice (owner, ts) stable sort
+    (`ycsb.execute_mc`) keeps exactly the same lanes: removing deferred
+    txns only moves surviving lanes earlier, so every survivor fits.
+    """
+    b, a = keys.shape
+    n = b * a
+    sl = n // d_parts
+    lane = jnp.arange(n, dtype=jnp.int32)
+    vf = valid.reshape(-1)
+    owner = jnp.where(vf, keys.reshape(-1) % d_parts, d_parts)
+    seg = (lane // sl) * (d_parts + 1) + owner
+    tsl = jnp.broadcast_to(ts[:, None], (b, a)).reshape(-1)
+    txn = lane // a
+    sseg, _, stxn = jax.lax.sort((seg, tsl, txn), num_keys=2,
+                                 is_stable=True)
+    head = jnp.concatenate([jnp.ones((1,), bool), sseg[1:] != sseg[:-1]])
+    start = jax.lax.cummax(jnp.where(head, lane, 0))
+    pos = lane - start
+    over = (pos >= pair_cap) & (sseg % (d_parts + 1) != d_parts)
+    # lanes -> txns without a scatter: sort by txn id; every txn has
+    # exactly `a` (padded) lanes, so the sorted lanes reshape to [b, a]
+    _, sov = jax.lax.sort((stxn, over), num_keys=1, is_stable=True)
+    return sov.reshape(b, a).any(axis=1)
+
+
+def mc_forward_verdict(cfg, batch):
+    """Multi-chip forwarding verdict: commit everything except the plan
+    capacity overflow, which defers (replicated decision).  Returns
+    (verdict, exec_batch) with deferred txns already excluded from the
+    execution batch's active set."""
+    import dataclasses
+
+    from deneva_tpu.cc.base import Verdict
+
+    cap = mc_pair_cap(batch.keys.size, cfg.device_parts,
+                      cfg.mc_plan_capacity)
+    if cap == 0:
+        return commit_all_verdict(batch), batch
+    dfr = mc_plan_defer(batch.keys, batch.ts,
+                        batch.valid & batch.active[:, None],
+                        cfg.device_parts, cap) & batch.active
+    z = jnp.zeros_like(batch.active)
+    v = Verdict(commit=batch.active & ~dfr, abort=z, defer=dfr,
+                order=batch.rank, level=jnp.zeros_like(batch.rank))
+    return v, dataclasses.replace(batch, active=batch.active & ~dfr)
+
+
 def commit_all_verdict(batch):
     """Commit-everything Verdict in rank order — the forwarding
     executor's invariant (also used standalone by the multi-chip path,
@@ -142,11 +221,20 @@ def forward_plan(keys: jax.Array, rank: jax.Array,
     keys: int32[B, A]; rank: int32[B] unique, >= 0; is_write/valid: bool[B, A].
     """
     b, a = keys.shape
-    n = b * a
     big = jnp.int32(jnp.iinfo(jnp.int32).max)
     k = jnp.where(valid, keys, big).reshape(-1)     # invalid sorts last
     r = jnp.broadcast_to(rank[:, None], (b, a)).reshape(-1)
     w = (is_write & valid).reshape(-1)
+    return forward_plan_flat(k, r, w, with_perm=with_perm)
+
+
+def forward_plan_flat(k: jax.Array, r: jax.Array, w: jax.Array,
+                      with_perm: bool = False) -> ForwardPlan:
+    """Flat-lane core of `forward_plan`: k int32[N] with invalid lanes
+    already set to INT32_MAX, r int32[N] owning-txn ranks, w bool[N]
+    valid write lanes.  The sharded multi-chip path calls this directly
+    on its compacted owned-lane buffer (`workloads/ycsb.execute_mc`)."""
+    n = k.shape[0]
 
     # one fused sort carries the payload with the keys — materially
     # faster on TPU than argsort + permutation gathers
@@ -156,6 +244,7 @@ def forward_plan(keys: jax.Array, rank: jax.Array,
         sk, sr, sw, perm = jax.lax.sort((k, r, w, lanes), num_keys=2)
     else:
         sk, sr, sw = jax.lax.sort((k, r, w), num_keys=2)
+    big = jnp.int32(jnp.iinfo(jnp.int32).max)
     srd = (sk != big) & ~sw                         # valid reads
     cand = jnp.where(sw, sr, jnp.int32(-1))
 

@@ -143,6 +143,50 @@ def test_ycsb_chained_calvin_partition_parallel():
     assert int(out["write_cnt"]) > 0
 
 
+def test_mc_plan_defer_marks_overflow_txns():
+    """Sharded-plan capacity (VERDICT r3 missing #2): txns whose owned
+    lanes land past a chip's plan buffer defer — a replicated,
+    deterministic decision (the MoE capacity pattern with deferral
+    instead of dropping)."""
+    import jax.numpy as jnp
+
+    from deneva_tpu.ops import mc_plan_defer
+
+    # 4 txns x 2 lanes, every key even -> all owned by chip 0 of D=2.
+    # Flat lanes split into two source slices of 4: slice 0 = txns 0-1,
+    # slice 1 = txns 2-3.  Priority is AGE (smallest ts first), not
+    # slot order: in slice 0 the SECOND txn is older, so capacity 2
+    # keeps it and defers the slot-earlier-but-younger first txn —
+    # the starvation-freedom property (a deferred txn ages upward).
+    keys = jnp.asarray([[0, 2], [4, 6], [8, 10], [12, 14]], jnp.int32)
+    valid = jnp.ones((4, 2), bool)
+    ts = jnp.asarray([9, 1, 2, 8], jnp.int32)
+    dfr = np.asarray(mc_plan_defer(keys, ts, valid, 2, 2))
+    assert list(dfr) == [True, False, False, True]
+    # ample capacity: nobody defers
+    assert not np.asarray(mc_plan_defer(keys, ts, valid, 2, 4)).any()
+
+
+@pytest.mark.slow
+def test_mc_plan_capacity_overflow_defers_and_recovers():
+    """Engine-level: a deliberately tight plan capacity under hot skew
+    forces overflow defers; conservation must hold (no drops) and the
+    oldest-first retry keeps committing (liveness)."""
+    cfg = cfg_for("TPU_BATCH").replace(
+        epoch_batch=4096, max_txn_in_flight=4096, zipf_theta=0.9,
+        synth_table_size=4096, device_parts=8, mc_plan_capacity=0.25)
+    eng = Engine(cfg, get_workload(cfg))
+    place, run = make_sharded_run(eng, make_mesh(8))
+    out = run(place(eng.init_state(seed=4)), 8)
+    stats = {k: np.asarray(v) for k, v in jax.device_get(out.stats).items()}
+    inflight = int(np.asarray(jax.device_get(out.pool.occupied)).sum())
+    assert int(stats["defer_cnt"]) > 0          # capacity actually bound
+    assert int(stats["total_txn_commit_cnt"]) > 0
+    assert int(stats["total_txn_commit_cnt"]) + inflight \
+        == int(stats["admitted_cnt"])           # no drops
+    assert int(stats["total_txn_abort_cnt"]) == 0
+
+
 def test_state_shardings_partition_tables():
     cfg = cfg_for("TIMESTAMP")
     eng = Engine(cfg, get_workload(cfg))
