@@ -56,9 +56,16 @@ MVCC (multi-version) differences:
   boundary ring here makes the retention DECISION; its commit rule
   (``ts >= min(ring)``) guarantees the per-row ring still holds the
   needed version (at most H-1 boundaries, hence at most H-1 per-row
-  overwrites, can exceed a servable ts).  TPC-C/PPS remain
-  decision-faithful without value rings (their executors read many
-  columns; documented narrow divergence).
+  overwrites, can exceed a servable ts).  TPC-C/PPS need NO value
+  rings to be value-exact (round-4, oracle-proven): every gather their
+  executors perform is (a) a load-immutable column (W_TAX / D_TAX /
+  C_DISCOUNT; USES/SUPPLIES mappings), (b) an RMW read, which this
+  module only permits at the latest version (``wts > ts`` aborts), or
+  (c) a read-only txn's gather, whose serialization point IS the epoch
+  snapshot it reads — so the live gather is the correct version in
+  every committed case
+  (`tests/test_tpcc.py::test_mvcc_reads_byte_match_serial_oracle`,
+  `tests/test_pps.py::test_mvcc_getpart_reads_snapshot_values`).
 
 Timestamps are epoch-fresh on restart exactly as the reference re-stamps
 restarted txns (`system/worker_thread.cpp:492-508`); deferred (waiting)

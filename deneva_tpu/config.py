@@ -298,6 +298,13 @@ class Config:
             _check(self.part_cnt == 1,
                    "device_parts (multi-chip) and part_cnt (multi-process) "
                    "partitioning do not compose yet")
+            if self.mc_plan_capacity > 0:
+                _check(self.max_accesses <= 128,
+                       "sharded multi-chip planning needs max_accesses "
+                       "<= 128: a txn's own lanes must fit one capacity "
+                       "block (the 128-lane tile floor of mc_pair_cap) "
+                       "or it could defer forever — raise "
+                       "mc_plan_capacity=0 to use the replicated plan")
             # ownership anchors must deal evenly over the mesh blocks
             # (storage.table.to_mc_layout); each workload's anchor is the
             # reference's node-partition unit across chips

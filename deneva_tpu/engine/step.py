@@ -37,7 +37,7 @@ from deneva_tpu.cc import (AccessBatch, build_conflict_incidence,
 from deneva_tpu.config import Config, Mode
 from deneva_tpu.engine.pool import PoolState, TxnPool
 from deneva_tpu.ops import (forward_verdict, forwarding_applies,
-                            mc_forward_verdict)
+                            mc_defer_verdict)
 
 LAT_BUCKETS = 64
 
@@ -199,10 +199,13 @@ class Engine:
                 batch, active=batch.active & ~forced)
             if cfg.device_parts > 1:
                 # multi-chip: plans are built per-shard inside
-                # wl.execute_mc in capacity-bounded owned-lane buffers;
-                # the verdict is global (commit everything except the
-                # deterministic capacity-overflow defers)
-                verdict, mc_batch = mc_forward_verdict(cfg, fbatch)
+                # wl.execute_mc, which also decides the capacity-
+                # overflow defers shard-locally (O(N/D)) and returns the
+                # replicated mask — the verdict is built after execution
+                # (`mc_defer_verdict`; forwarding implies Mode.NORMAL,
+                # so the execute below always runs)
+                verdict = None
+                mc_batch = fbatch
             else:
                 verdict, fwd = forward_verdict(fbatch)
                 mc_batch = None
@@ -224,22 +227,29 @@ class Engine:
             verdict = dataclasses.replace(
                 verdict, abort=verdict.abort | stuck,
                 defer=verdict.defer & ~stuck)
-        # a forced txn completes-as-aborted only when the CC would not
-        # retry it anyway (CC aborts/defers follow their normal path)
-        if forced is not None:
+        def finalize(verdict, forced):
+            # a forced txn completes-as-aborted only when the CC would
+            # not retry it anyway (CC aborts/defers follow their normal
+            # path); released slots are real commits + forced completions
+            if forced is None:
+                return None, verdict.commit, verdict.commit
             forced = forced & ~(verdict.abort | verdict.defer)
-        exec_commit = verdict.commit if forced is None \
-            else verdict.commit & ~forced
-        # released slots: real commits + forced completions
-        release = verdict.commit if forced is None \
-            else verdict.commit | forced
+            return (forced, verdict.commit & ~forced,
+                    verdict.commit | forced)
 
-        # 5. execute committed txns
+        if verdict is not None:
+            forced, exec_commit, release = finalize(verdict, forced)
+
+        # 5. execute committed txns (the multi-chip forwarding path
+        # produces its verdict here, from the capacity defer mask)
         db = state.db
         if cfg.mode in (Mode.NORMAL, Mode.NOCC):
             if forwarding:
                 if cfg.device_parts > 1:
-                    db = wl.execute_mc(db, mc_batch, stats)
+                    db, mc_dfr = wl.execute_mc(db, mc_batch, stats)
+                    verdict = mc_defer_verdict(fbatch, mc_dfr)
+                    forced, exec_commit, release = finalize(verdict,
+                                                            forced)
                 else:
                     # commit set baked into the plan (fbatch.active);
                     # mask=None is asserted by the executor so the two

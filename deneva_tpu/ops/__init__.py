@@ -13,7 +13,7 @@ from deneva_tpu.ops.forward import (ForwardPlan,  # noqa: F401
                                     commit_all_verdict, forward_plan,
                                     forward_plan_flat, forward_verdict,
                                     forwarding_applies,
-                                    last_earlier_writer, mc_forward_verdict,
+                                    last_earlier_writer, mc_defer_verdict,
                                     mc_pair_cap, mc_plan_defer)
 from deneva_tpu.ops.conflict import (  # noqa: F401
     access_incidence,

@@ -85,16 +85,23 @@ jax.tree_util.register_dataclass(
     meta_fields=[])
 
 
-def mc_pair_cap(n: int, d_parts: int, factor: float) -> int:
+def mc_pair_cap(b: int, a: int, d_parts: int, factor: float) -> int:
     """Static per-(source slice, owner) lane capacity for the sharded
     multi-chip plan's all_to_all exchange: ``factor`` x the even share
     N/D^2, rounded up to the 128-lane tile.  Returns 0 when sharded
-    planning is off (factor <= 0, one chip, or a slice-indivisible
-    batch) — callers fall back to the replicated full-batch plan."""
-    if factor <= 0 or d_parts <= 1 or n % d_parts:
+    planning is off (factor <= 0, one chip, or txn-unaligned slices —
+    slices hold whole txns so per-txn defer bits reduce shard-locally)
+    — callers fall back to the replicated full-batch plan.
+
+    The floor of one 128-lane tile also guarantees a single txn's lanes
+    (<= max_accesses <= 128, checked in Config.validate) always fit one
+    block, so the age-priority liveness argument holds: the oldest txn
+    of a block can never overflow on its own lanes."""
+    if factor <= 0 or d_parts <= 1 or b % d_parts:
         return 0
-    sl = n // d_parts
-    cap = int(factor * sl / d_parts + 127) // 128 * 128
+    import math
+    sl = (b // d_parts) * a
+    cap = (math.ceil(factor * sl / d_parts) + 127) // 128 * 128
     cap = max(cap, 128)
     return 0 if cap >= sl else cap
 
@@ -102,6 +109,13 @@ def mc_pair_cap(n: int, d_parts: int, factor: float) -> int:
 def mc_plan_defer(keys: jax.Array, ts: jax.Array, valid: jax.Array,
                   d_parts: int, pair_cap: int) -> jax.Array:
     """bool[B]: txns with a lane past the per-(slice, owner) capacity.
+
+    REFERENCE implementation of the capacity rule (replicated, O(N log
+    N)) — the production path computes the identical rule shard-locally
+    inside `ycsb.execute_mc` (each chip sorts only its N/D slice and an
+    all_gather shares the per-txn bits), keeping every per-epoch term
+    O(N/D).  This form is kept as the executable spec and for the unit
+    tests.
 
     The sharded plan gives source chip s a balanced N/D input slice and
     routes lanes to their owner (key % D) in fixed pair_cap-sized
@@ -142,26 +156,16 @@ def mc_plan_defer(keys: jax.Array, ts: jax.Array, valid: jax.Array,
     return sov.reshape(b, a).any(axis=1)
 
 
-def mc_forward_verdict(cfg, batch):
-    """Multi-chip forwarding verdict: commit everything except the plan
-    capacity overflow, which defers (replicated decision).  Returns
-    (verdict, exec_batch) with deferred txns already excluded from the
-    execution batch's active set."""
-    import dataclasses
-
+def mc_defer_verdict(batch, dfr):
+    """Multi-chip forwarding verdict from the capacity defer mask
+    `ycsb.execute_mc` computed shard-locally: commit everything active
+    except the deferred txns."""
     from deneva_tpu.cc.base import Verdict
 
-    cap = mc_pair_cap(batch.keys.size, cfg.device_parts,
-                      cfg.mc_plan_capacity)
-    if cap == 0:
-        return commit_all_verdict(batch), batch
-    dfr = mc_plan_defer(batch.keys, batch.ts,
-                        batch.valid & batch.active[:, None],
-                        cfg.device_parts, cap) & batch.active
     z = jnp.zeros_like(batch.active)
-    v = Verdict(commit=batch.active & ~dfr, abort=z, defer=dfr,
-                order=batch.rank, level=jnp.zeros_like(batch.rank))
-    return v, dataclasses.replace(batch, active=batch.active & ~dfr)
+    dfr = dfr & batch.active
+    return Verdict(commit=batch.active & ~dfr, abort=z, defer=dfr,
+                   order=batch.rank, level=jnp.zeros_like(batch.rank))
 
 
 def commit_all_verdict(batch):

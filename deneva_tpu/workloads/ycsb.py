@@ -278,16 +278,19 @@ class YCSBWorkload:
         random-access table passes then all run at N/D scale instead of
         N: the whole epoch divides by ~D/factor rather than only its
         table-access half (the round-3 replicated-plan asymptote was
-        ~2.8x).  Skew safety: the engine already deferred any txn with a
-        lane past its (slice, owner) block capacity
-        (`ops.mc_forward_verdict` — a replicated deterministic decision,
-        the MoE capacity pattern with deferral instead of dropping), so
-        the fixed blocks never lose a lane.  Set ``mc_plan_capacity=0``
-        for the round-3 replicated-plan mode (zero capacity factors,
-        zero defers, full-batch sort per chip).
+        ~2.8x).  Skew safety: a txn with a lane past its (slice, owner)
+        block capacity DEFERS (the MoE capacity pattern with deferral
+        instead of dropping) — computed HERE, shard-locally at O(N/D)
+        against `ops.mc_plan_defer`'s replicated spec: each chip sorts
+        only its own slice, reduces per-txn overflow bits, and one
+        all_gather replicates the identical defer mask to every chip
+        (and to the caller, who builds the epoch verdict from it).  Set
+        ``mc_plan_capacity=0`` for the round-3 replicated-plan mode
+        (zero capacity factors, zero defers, full-batch sort per chip).
 
-        Tables must be in the owner-major layout `load()` produces for
-        ``device_parts > 1``; each local block's last row is its trash.
+        Returns ``(db, defer_mask)``; tables must be in the owner-major
+        layout `load()` produces for ``device_parts > 1``; each local
+        block's last row is its trash.
         """
         from jax.sharding import PartitionSpec as P
 
@@ -301,37 +304,62 @@ class YCSBWorkload:
         tab: DeviceTable = db[TABLE]
         valid = batch.valid & batch.active[:, None]
         big = jnp.int32(jnp.iinfo(jnp.int32).max)
-        pair_cap = mc_pair_cap(valid.size, d_parts,
-                               self.cfg.mc_plan_capacity)
-        sl = valid.size // d_parts
+        b, a = batch.keys.shape
+        pair_cap = mc_pair_cap(b, a, d_parts, self.cfg.mc_plan_capacity)
+        bD = b // d_parts if pair_cap else b
+        sl = bD * a
 
         def body(f0, keys, rank, ts, is_write, valid):
             me = jax.lax.axis_index(AXIS)
             if pair_cap:
-                # my balanced input slice of the replicated flat lanes
-                kf = keys.reshape(-1)
-                rf = jnp.broadcast_to(rank[:, None],
-                                      keys.shape).reshape(-1)
-                tf = jnp.broadcast_to(ts[:, None],
-                                      keys.shape).reshape(-1)
-                wf = (is_write & valid).reshape(-1)
-                vf = valid.reshape(-1)
-                ks = jax.lax.dynamic_slice_in_dim(kf, me * sl, sl)
-                rs = jax.lax.dynamic_slice_in_dim(rf, me * sl, sl)
-                tss = jax.lax.dynamic_slice_in_dim(tf, me * sl, sl)
-                ws = jax.lax.dynamic_slice_in_dim(wf, me * sl, sl)
-                vs = jax.lax.dynamic_slice_in_dim(vf, me * sl, sl)
+                # my balanced slice of WHOLE txns (row-aligned, so the
+                # per-txn defer bits reduce without leaving the shard)
+                k2 = jax.lax.dynamic_slice_in_dim(keys, me * bD, bD)
+                r2 = jax.lax.dynamic_slice_in_dim(rank, me * bD, bD)
+                t2 = jax.lax.dynamic_slice_in_dim(ts, me * bD, bD)
+                w2 = jax.lax.dynamic_slice_in_dim(is_write & valid,
+                                                  me * bD, bD)
+                v2 = jax.lax.dynamic_slice_in_dim(valid, me * bD, bD)
                 # invalid lanes carry the big sentinel so the
                 # post-exchange ownership mask can never admit them
-                ks = jnp.where(vs, ks, big)
-                # stable (owner, ts) sort: each destination's lanes
-                # become one contiguous run, OLDEST txns first — the
-                # defer rule's age priority (`ops.mc_plan_defer`), so
-                # "first pair_cap per block" is the identical lane set
+                ks = jnp.where(v2, k2, big).reshape(-1)
+                rs = jnp.broadcast_to(r2[:, None], (bD, a)).reshape(-1)
+                tss = jnp.broadcast_to(t2[:, None], (bD, a)).reshape(-1)
+                ws = w2.reshape(-1)
+                vs = v2.reshape(-1)
+                lane = jnp.arange(sl, dtype=jnp.int32)
                 owner = jnp.where(vs, ks % d_parts, d_parts)
+                # defer pass (O(N/D) analogue of ops.mc_plan_defer):
+                # age-priority positions per (slice, owner) block;
+                # overflow bits reduce per txn via the sort-by-txn
+                # reshape trick, then one all_gather replicates them
+                so, _, stx = jax.lax.sort((owner, tss, lane // a),
+                                          num_keys=2, is_stable=True)
+                head = jnp.concatenate([jnp.ones((1,), bool),
+                                        so[1:] != so[:-1]])
+                start = jax.lax.cummax(jnp.where(head, lane, 0))
+                over = (lane - start >= pair_cap) & (so != d_parts)
+                _, sov = jax.lax.sort((stx, over), num_keys=1,
+                                      is_stable=True)
+                dfr = sov.reshape(bD, a).any(axis=1)
+                # each sender excludes ITS deferred txns' lanes before
+                # cutting blocks, so no chip ever receives one — the
+                # global mask is just the shards concatenated
+                # (out_specs P(AXIS)); survivors always fit, their
+                # positions only move earlier
+                dfr_lane = jnp.broadcast_to(dfr[:, None],
+                                            (bD, a)).reshape(-1)
+                vs2 = vs & ~dfr_lane
+                ks2 = jnp.where(vs2, ks, big)
+                ws2 = ws & ~dfr_lane
+                # stable (owner, ts) sort: each destination's lanes
+                # become one contiguous run, OLDEST txns first (the
+                # defer rule's age priority, starvation-free)
+                owner2 = jnp.where(vs2, ks2 % d_parts, d_parts)
                 _, _, ck, cr, cw = jax.lax.sort(
-                    (owner, tss, ks, rs, ws), num_keys=2, is_stable=True)
-                cnt = jnp.bincount(owner, length=d_parts + 1)
+                    (owner2, tss, ks2, rs, ws2), num_keys=2,
+                    is_stable=True)
+                cnt = jnp.bincount(owner2, length=d_parts + 1)
                 starts = jnp.cumsum(cnt) - cnt
                 # fixed-size block per destination (dynamic start is
                 # clamped near the tail — stray lanes are masked after
@@ -348,6 +376,7 @@ class YCSBWorkload:
                 bw = bw & mine
                 p = forward_plan_flat(bk, br, bw)
             else:
+                dfr = jnp.zeros((b,), bool)
                 owned = valid & (keys % d_parts == me)
                 p = forward_plan(keys, rank, is_write, owned)
             # f0 here is one owner-major block (to_mc_layout): its last
@@ -355,19 +384,21 @@ class YCSBWorkload:
             trash = jnp.int32(f0.shape[0] - 1)
             slots = jnp.where(p.keys != big, p.keys // d_parts, trash)
             f0, cks, wcnt = _forward_execute_f0(f0, p, slots, trash)
-            return f0, jax.lax.psum(cks, AXIS), jax.lax.psum(wcnt, AXIS)
+            return (f0, jax.lax.psum(cks, AXIS),
+                    jax.lax.psum(wcnt, AXIS), dfr)
 
-        f0, cks, wcnt = jax.shard_map(
+        f0, cks, wcnt, dfr = jax.shard_map(
             body, mesh=mesh,
             in_specs=(P(AXIS), P(), P(), P(), P(), P()),
-            out_specs=(P(AXIS), P(), P()))(
+            out_specs=(P(AXIS), P(), P(),
+                       P(AXIS) if pair_cap else P()))(
                 tab.columns["F0"], batch.keys, batch.rank, batch.ts,
                 batch.is_write, valid)
         stats["read_checksum"] = stats["read_checksum"] + cks
         stats["write_cnt"] = stats["write_cnt"] + wcnt
         db = dict(db)
         db[TABLE] = tab._replace(columns={**tab.columns, "F0": f0})
-        return db
+        return db, dfr
 
     # -- execution (ycsb_txn.cpp:177-209 collapsed to one batch) -------
     def execute(self, db, q: YCSBQuery, mask: jax.Array, order: jax.Array,

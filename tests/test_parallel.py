@@ -168,6 +168,32 @@ def test_mc_plan_defer_marks_overflow_txns():
 
 
 @pytest.mark.slow
+def test_sharded_plan_path_bit_identical_to_single_device():
+    """Bit-identity THROUGH the active sharded-plan path: these shapes
+    give pair_cap = 512 < sl = 2048 (the all_to_all routing actually
+    runs, unlike the small-shape tests whose mc_pair_cap falls back to
+    the replicated plan), while moderate skew plus an ample capacity
+    factor keeps defers at zero — so every counter, including the read
+    checksum over forwarded values, must equal the single-device run."""
+    from deneva_tpu.ops import mc_pair_cap
+
+    cfg = cfg_for("TPU_BATCH").replace(
+        epoch_batch=4096, max_txn_in_flight=4096, zipf_theta=0.6,
+        synth_table_size=8192)
+    assert 0 < mc_pair_cap(4096, 4, 8, cfg.mc_plan_capacity) < 4096 * 4 // 8
+    eng = Engine(cfg, get_workload(cfg))
+    ref = jax.device_get(eng.jit_run(eng.init_state(seed=6), 8).stats)
+    cfg8 = cfg.replace(device_parts=8)
+    eng8 = Engine(cfg8, get_workload(cfg8))
+    place, run = make_sharded_run(eng8, make_mesh(8))
+    out = jax.device_get(run(place(eng8.init_state(seed=6)), 8).stats)
+    assert int(np.asarray(out["defer_cnt"])) == 0   # capacity ample
+    for k in ref:
+        assert (np.asarray(ref[k]) == np.asarray(out[k])).all(), k
+    assert int(np.asarray(out["total_txn_commit_cnt"])) > 0
+
+
+@pytest.mark.slow
 def test_mc_plan_capacity_overflow_defers_and_recovers():
     """Engine-level: a deliberately tight plan capacity under hot skew
     forces overflow defers; conservation must hold (no drops) and the
