@@ -30,7 +30,7 @@ def _counters(state: EngineState) -> dict:
     return {k: np.asarray(v) for k, v in host.items()}
 
 
-def _sync(state: EngineState) -> tuple[int, int]:
+def _sync(state: EngineState) -> tuple[int, int, np.ndarray]:
     """Real device->host transfer as the pacing barrier.
 
     `jax.block_until_ready` on a donated scan output can return before
@@ -41,13 +41,15 @@ def _sync(state: EngineState) -> tuple[int, int]:
     cannot complete early, so it both paces the loop and surfaces any
     execution error at the call site.
 
-    Returns (commit_cnt, next_seq) from ONE transfer: a tunnel round trip
-    costs tens of ms, so the seq-wrap guard must ride the pacing fetch
-    rather than pay its own (a second round trip per ~1 s chunk measured
-    ~15 % off the headline)."""
-    c, s = jax.device_get((state.stats["total_txn_commit_cnt"],
-                           state.pool.next_seq))
-    return int(c), int(s)
+    Returns (commit_cnt, next_seq, latency_hist) from ONE transfer: a
+    tunnel round trip costs tens of ms, so the seq-wrap guard AND the
+    per-chunk latency snapshot (the wall-clock calibration data, ~512 B)
+    must ride the pacing fetch rather than pay their own (a second
+    round trip per ~1 s chunk measured ~15 % off the headline)."""
+    c, s, h = jax.device_get((state.stats["total_txn_commit_cnt"],
+                              state.pool.next_seq,
+                              state.stats["latency_hist"]))
+    return int(c), int(s), np.asarray(h)
 
 
 def run_simulation(cfg: Config, chunk: int = 50,
@@ -101,10 +103,20 @@ def run_simulation(cfg: Config, chunk: int = 50,
                 f"{head}); shorten the run window or shrink epoch_batch "
                 "(seq advances epoch_batch+gen_chunk per epoch)")
 
+    # per-chunk latency calibration records (epochs, wall secs, hist
+    # snapshot): the summary maps each chunk's epoch-valued buckets to
+    # wall seconds with THAT chunk's measured pace — not one global mean
+    # (round-3's mean-scaled buckets, VERDICT r3 next #6)
+    chunk_log: list[tuple[int, float, float, np.ndarray]] = []
+    last_t = [time.monotonic()]
+
     def _after_chunk(state):
         """Shared per-chunk bookkeeping: pacing sync + wrap guard +
         progress + checkpoint cadence."""
-        _guard_seq(_sync(state)[1])
+        _, head, hist = _sync(state)
+        _guard_seq(head)
+        now = time.monotonic()
+        chunk_log.append((chunk, now - last_t[0], now, hist))
         epochs_total[0] += chunk
         prog_tick(state)
         if ckpt_bound:
@@ -113,6 +125,9 @@ def run_simulation(cfg: Config, chunk: int = 50,
                 from deneva_tpu.engine.checkpoint import save_state
                 save_state(cfg.checkpoint_path, state)
                 ckpt_due[0] = ckpt_bound
+        # reset AFTER the host-side bookkeeping (prog fetch, checkpoint
+        # write) so its cost is charged to no chunk's latency pace
+        last_t[0] = time.monotonic()
 
     def _retarget(state, epochs_per_sec: float, spread: int):
         """ONE resize rule for both calibrations: aim each device call at
@@ -140,6 +155,7 @@ def run_simulation(cfg: Config, chunk: int = 50,
     # barrier, system/thread.cpp:62-84)
     state = run_n(state, chunk)
     _guard_seq(_sync(state)[1])
+    last_t[0] = time.monotonic()
     # adaptive chunking: size each device call to ~chunk_target_secs —
     # large enough that the per-call sync round-trip (tens of ms on a
     # tunneled chip) stays in the noise, small enough that no single
@@ -147,7 +163,8 @@ def run_simulation(cfg: Config, chunk: int = 50,
     t1 = time.monotonic()
     state = run_n(state, chunk)
     _guard_seq(_sync(state)[1])
-    per_chunk = max(time.monotonic() - t1, 1e-4)
+    last_t[0] = time.monotonic()
+    per_chunk = max(last_t[0] - t1, 1e-4)
     state = _retarget(state, chunk / per_chunk, spread=2)
 
     def run_window(state, secs):
@@ -167,6 +184,8 @@ def run_simulation(cfg: Config, chunk: int = 50,
     if ep_w:
         state = _retarget(state, ep_w / max(el_w, 1e-4), spread=3)
     before = _counters(state)
+    chunk_log.clear()                 # calibrate over the measure window
+    last_t[0] = time.monotonic()
     t_start = time.monotonic()
     state, epochs, elapsed = run_window(state, cfg.done_secs)
     after = _counters(state)
@@ -186,14 +205,34 @@ def run_simulation(cfg: Config, chunk: int = 50,
             st.set(f"{nm}_{fam}_cnt", float(after[key][i] - before[key][i]))
     commits = after["total_txn_commit_cnt"] - before["total_txn_commit_cnt"]
     aborts = after["total_txn_abort_cnt"] - before["total_txn_abort_cnt"]
-    sec_per_epoch = elapsed / max(epochs, 1)
     # every committed txn contributes exactly one latency sample (its
-    # commit-epoch minus entry-epoch, engine latency_hist); the weighted
-    # StatsArr keeps the full multiset — no cap, no synthesis
-    hist = (after["latency_hist"] - before["latency_hist"]).astype(np.float64)
-    if hist.sum() > 0:
-        centers = (np.arange(len(hist)) + 0.5) * sec_per_epoch
-        st.arr("client_client_latency").extend_weighted(centers, hist)
+    # commit-epoch minus entry-epoch, engine latency_hist), calibrated
+    # to wall seconds with the PACE OF ITS OWN CHUNK (epoch timestamps
+    # per chunk; the weighted StatsArr keeps the full multiset — no
+    # cap, no synthesis).  Per-type families feed {type}_latency_*;
+    # the combined series keeps the reference-compatible name.
+    type_names = list(getattr(wl, "txn_type_names", ("txn",)))
+    lb = after["latency_hist"].shape[-1]
+    prev = before["latency_hist"].astype(np.float64)
+    for n_ep, secs, _, snap in chunk_log:
+        cur = snap.astype(np.float64)
+        delta = cur - prev
+        prev = cur
+        spe = secs / max(n_ep, 1)
+        centers = (np.arange(lb) + 0.5) * spe
+        for i, nm in enumerate(type_names):
+            row = delta[i] if delta.ndim == 2 else delta
+            if row.sum() > 0:
+                st.arr(f"{nm}_latency").extend_weighted(centers, row)
+                st.arr("client_client_latency").extend_weighted(
+                    centers, row)
+    # per-txn restart/wait decomposition (TxnStats analogue): counts of
+    # retries and waited epochs each committed txn paid
+    for key, name in (("retry_hist", "txn_retries"),
+                      ("wait_hist", "txn_waits")):
+        d = (after[key] - before[key]).astype(np.float64)
+        if d.sum() > 0:
+            st.arr(name).extend_weighted(np.arange(len(d)), d)
     st.set("abort_rate", float(aborts) / max(float(commits + aborts), 1.0))
     if cfg.checkpoint_path:
         from deneva_tpu.engine.checkpoint import save_state

@@ -40,6 +40,7 @@ from deneva_tpu.ops import (forward_verdict, forwarding_applies,
                             mc_defer_verdict)
 
 LAT_BUCKETS = 64
+RETRY_BUCKETS = 8      # per-txn restart/wait counts at commit (clipped)
 
 
 def forced_sentinel_mask(batch):
@@ -78,7 +79,15 @@ def init_device_stats(n_txn_types: int = 1) -> dict:
         "total_txn_commit_cnt": z(), "total_txn_abort_cnt": z(),
         "unique_txn_abort_cnt": z(),
         "defer_cnt": z(), "write_cnt": z(), "read_checksum": z(),
-        "latency_hist": jnp.zeros((LAT_BUCKETS,), jnp.uint32),
+        # commit latency in epochs, PER TXN TYPE (round-4: the
+        # reference's per-txn StatsArr families, stats_array.cpp);
+        # the driver calibrates buckets to wall seconds per chunk
+        "latency_hist": jnp.zeros((n_txn_types, LAT_BUCKETS), jnp.uint32),
+        # per-txn work decomposition at commit time (reference TxnStats,
+        # system/txn.h:72-114): how many restarts (abort_cnt) and how
+        # many waited epochs (defer_cnt) each committed txn paid
+        "retry_hist": jnp.zeros((RETRY_BUCKETS,), jnp.uint32),
+        "wait_hist": jnp.zeros((RETRY_BUCKETS,), jnp.uint32),
         # per-txn-kind commit/abort breakdown (reference Stats_thd's
         # per-type counter families); names come from
         # Workload.txn_type_names at summary time
@@ -290,14 +299,35 @@ class Engine:
         count_by_type(stats, wl, queries, exec_commit & active,
                       aborts & active)
         stats["defer_cnt"] += (verdict.defer & active).sum(dtype=jnp.uint32)
-        # histogram as a one-hot reduction: a 64-bucket scatter-add over
+        # histograms as one-hot reductions: a 64-bucket scatter-add over
         # the batch serializes on bucket contention on TPU (~4.5 ms at
-        # 64k lanes on v5e); the dense compare-and-sum is ~free
+        # 64k lanes on v5e); the dense compare-and-sum is ~free.
+        # latency_hist is PER TYPE (static unrolled — n_types is 2-8):
+        # the reference's per-txn-kind StatsArr latency families
+        committed = exec_commit & active
         lat = jnp.clip(state.epoch - sel(pool.entry_epoch),
                        0, LAT_BUCKETS - 1)
         onehot = (lat[:, None] == jnp.arange(LAT_BUCKETS, dtype=jnp.int32)) \
-            & (exec_commit & active)[:, None]
-        stats["latency_hist"] = stats["latency_hist"] + onehot.sum(
+            & committed[:, None]
+        ttype = wl.txn_type_of(queries) if len(
+            getattr(wl, "txn_type_names", ("txn",))) > 1 else None
+        rows = []
+        for t in range(stats["latency_hist"].shape[0]):
+            m = onehot if ttype is None \
+                else onehot & (ttype == t)[:, None]
+            rows.append(m.sum(axis=0, dtype=jnp.uint32))
+        stats["latency_hist"] = stats["latency_hist"] + jnp.stack(rows)
+        # per-txn restart/wait decomposition at commit (TxnStats
+        # analogue, system/txn.h:72-114): pre-update counters are the
+        # txn's whole-life totals since its slot (re)admission
+        rb = jnp.arange(RETRY_BUCKETS, dtype=jnp.int32)
+        retries = jnp.clip(pre_abort_cnt, 0, RETRY_BUCKETS - 1)
+        waits = jnp.clip(sel(pool.defer_cnt), 0, RETRY_BUCKETS - 1)
+        stats["retry_hist"] = stats["retry_hist"] + (
+            (retries[:, None] == rb) & committed[:, None]).sum(
+            axis=0, dtype=jnp.uint32)
+        stats["wait_hist"] = stats["wait_hist"] + (
+            (waits[:, None] == rb) & committed[:, None]).sum(
             axis=0, dtype=jnp.uint32)
 
         return EngineState(db=db, cc_state=cc_state, pool=pool, rng=rng,

@@ -88,13 +88,22 @@ class ClientNode:
         rng = jax.random.PRNGKey(cfg.seed + 7919 * cfg.node_id)
         n_pregen = 64
         self.ring: list[wire.QueryBlock] = []
+        self.ring_types: list[np.ndarray] = []
         for i in range(n_pregen):
             q = self.wl.generate(jax.random.fold_in(rng, i), self.chunk)
             keys, types, scalars = self.wl.to_wire(q)
             self.ring.append(wire.QueryBlock(
                 keys=keys, types=types, scalars=scalars,
                 tags=np.zeros(self.chunk, np.int64)))
+            self.ring_types.append(
+                np.asarray(self.wl.txn_type_of(q), np.uint8))
         self.ring_pos = 0
+        # per-txn-type latency families (reference per-kind StatsArr,
+        # VERDICT r3 next #6): remember each tag's txn type so CL_RSP
+        # latency samples can feed {type}_latency percentiles
+        self.type_names = list(getattr(self.wl, "txn_type_names",
+                                       ("txn",)))
+        self.tag_type = np.zeros(TAG_RING, np.uint8)
 
     # ------------------------------------------------------------------
     def _route(self, src: int, rtype: str, payload: bytes,
@@ -103,8 +112,15 @@ class ClientNode:
             tags = wire.decode_cl_rsp(payload)
             now = time.monotonic_ns() // 1000
             self.inflight[src] -= len(tags)       # src is a server id
-            sent = self.send_us[tags % TAG_RING]
-            lat_arr.extend((now - sent) / 1e6)    # seconds
+            slot = tags % TAG_RING
+            vals = (now - self.send_us[slot]) / 1e6     # seconds
+            lat_arr.extend(vals)
+            if len(self.type_names) > 1:
+                tt = self.tag_type[slot]
+                for t, nm in enumerate(self.type_names):
+                    m = tt == t
+                    if m.any():
+                        self.stats.arr(f"{nm}_latency").extend(vals[m])
             self.stats.incr("txn_cnt", len(tags))
         elif rtype == "SHUTDOWN":
             self.stop = True
@@ -149,6 +165,7 @@ class ClientNode:
                         break
                     n = min(n, budget)
                 blk = self.ring[self.ring_pos]
+                blk_types = self.ring_types[self.ring_pos]
                 self.ring_pos = (self.ring_pos + 1) % len(self.ring)
                 if n < self.chunk:
                     blk = blk.slice(0, n)
@@ -157,6 +174,7 @@ class ClientNode:
                         + self.next_tag) % TAG_RING
                 self.next_tag = int(tags[-1]) + 1
                 self.send_us[tags] = now
+                self.tag_type[tags] = blk_types[:n]
                 out = wire.QueryBlock(blk.keys, blk.types, blk.scalars, tags)
                 self.tp.send(srv, "CL_QRY_BATCH", wire.encode_qry_block(out))
                 self.inflight[srv] += n

@@ -496,6 +496,10 @@ class ServerNode:
         self.stop_epoch: int | None = None
         self.measure_epoch: int | None = None
         self.stats = Stats()
+        # per-committed-txn restart/wait histograms (TxnStats analogue,
+        # system/txn.h:72-114), accumulated host-side at retirement
+        self._retry_hist = np.zeros(8, np.int64)
+        self._wait_hist = np.zeros(8, np.int64)
 
     # -- message routing (reference InputThread::server_recv_loop) ------
     def _route(self, src: int, rtype: str, payload: bytes) -> None:
@@ -782,6 +786,12 @@ class ServerNode:
             n = len(block)
             my_commit = done[i, :n]
             if my_commit.any():
+                # TxnStats analogue: whole-life restart/wait counts of
+                # each committed txn (clipped to the 8-bucket family)
+                self._retry_hist += np.bincount(
+                    np.minimum(abort_cnt[my_commit], 7), minlength=8)
+                self._wait_hist += np.bincount(
+                    np.minimum(dfc[:n][my_commit], 7), minlength=8)
                 # tag high bits carry the home client's transport id
                 tags = block.tags[my_commit]
                 clients = tags >> 40
@@ -1031,6 +1041,8 @@ class ServerNode:
                 self._ph["process"] += time.monotonic() - t0
                 self._t_meas = time.monotonic()
                 self._uniq_meas = self._uniq_aborts
+                self._retry_meas = self._retry_hist.copy()
+                self._wait_meas = self._wait_hist.copy()
             # ---- retire the oldest group once K are in flight ----------
             while len(inflight) > K - 1:
                 self._retire(inflight.popleft(), tl)
@@ -1092,6 +1104,14 @@ class ServerNode:
         aborts = final["total_txn_abort_cnt"] - measured["total_txn_abort_cnt"]
         st.set("abort_rate",
                float(aborts) / max(float(commits + aborts), 1.0))
+        for name, hist, base in (
+                ("txn_retries", self._retry_hist,
+                 getattr(self, "_retry_meas", np.zeros(8, np.int64))),
+                ("txn_waits", self._wait_hist,
+                 getattr(self, "_wait_meas", np.zeros(8, np.int64)))):
+            d = (hist - base).astype(np.float64)
+            if d.sum() > 0:
+                st.arr(name).extend_weighted(np.arange(len(d)), d)
         st.set("worker_idle_time", self._ph["idle"])
         st.set("worker_process_time", self._ph["process"])
         for k, v in self.tp.stats().items():
