@@ -75,8 +75,11 @@ def child(platform: str) -> None:
                         synth_table_size=(1 << 21) // scale)
     # host OCC is measured by the PARENT before any JAX runtime exists
     # (its thread pool skews a host-CPU benchmark by 2-4x) and arrives
-    # via environment
+    # via environment: median of N=5 runs plus the min/max band, so the
+    # quoted ratio is robust to one noisy-neighbor sample
     host_occ = float(os.environ.get("DENEVA_HOST_OCC_TPUT", "0") or 0)
+    occ_lo = float(os.environ.get("DENEVA_HOST_OCC_LO", "0") or 0)
+    occ_hi = float(os.environ.get("DENEVA_HOST_OCC_HI", "0") or 0)
     print(json.dumps({
         "metric": "ycsb_zipf0.9_committed_txns_per_sec",
         "value": round(tpu_tput, 1),
@@ -84,35 +87,54 @@ def child(platform: str) -> None:
         "vs_baseline": round(tpu_tput / max(occ_tput, 1e-9), 3),
         "full_payload_tput": round(full_tput, 1),
         "host_occ_tput": round(host_occ, 1),
+        "host_occ_band": [round(occ_lo, 1), round(occ_hi, 1)],
         "vs_host_occ": round(tpu_tput / host_occ, 3) if host_occ else 0.0,
+        "vs_host_occ_band": [
+            round(tpu_tput / occ_hi, 3) if occ_hi else 0.0,
+            round(tpu_tput / occ_lo, 3) if occ_lo else 0.0],
+        "full_vs_host_occ": round(full_tput / host_occ, 3)
+        if host_occ else 0.0,
     }), flush=True)
 
 
-def _host_occ_tput() -> float:
+def _host_occ_tput(n: int = 5) -> tuple[float, float, float]:
     """Native host-CPU OCC baseline (native/src/host_occ.cc — the
     faithful stand-in for the unbuildable reference rundb): same YCSB
-    shape, 4 worker threads like the paper config."""
+    shape, 4 worker threads like the paper config.
+
+    Runs ``n`` times and returns (median, min, max): BENCH_r02->r03 the
+    quoted vs_host_occ ratio moved 12.2x -> 16.3x purely on one noisy
+    baseline sample (VERDICT r3 next #8), so the headline ratio is now
+    pinned to the median with the band reported alongside."""
     exe = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "native", "build", "host_occ")
     if not os.path.exists(exe):
-        return 0.0
-    try:
-        out = subprocess.run(
-            [exe, str(1 << 23), "4", "10", "0.9", "0.5", "5.0"],
-            capture_output=True, text=True, timeout=120)
-        for tok in out.stdout.split():
-            if tok.startswith("tput="):
-                return float(tok[5:])
-    except (subprocess.TimeoutExpired, OSError, ValueError):
-        pass
-    return 0.0
+        return 0.0, 0.0, 0.0
+    vals = []
+    for _ in range(n):
+        try:
+            out = subprocess.run(
+                [exe, str(1 << 23), "4", "10", "0.9", "0.5", "5.0"],
+                capture_output=True, text=True, timeout=120)
+            for tok in out.stdout.split():
+                if tok.startswith("tput="):
+                    vals.append(float(tok[5:]))
+                    break
+        except (subprocess.TimeoutExpired, OSError, ValueError):
+            pass
+    if not vals:
+        return 0.0, 0.0, 0.0
+    import statistics
+    return statistics.median(vals), min(vals), max(vals)
 
 
 def main() -> None:
-    host_occ = _host_occ_tput()    # quiet host, before any JAX runtime
+    occ_med, occ_lo, occ_hi = _host_occ_tput()  # quiet host, pre-JAX
     for platform in ("tpu", "cpu"):
         env = dict(os.environ)
-        env["DENEVA_HOST_OCC_TPUT"] = str(host_occ)
+        env["DENEVA_HOST_OCC_TPUT"] = str(occ_med)
+        env["DENEVA_HOST_OCC_LO"] = str(occ_lo)
+        env["DENEVA_HOST_OCC_HI"] = str(occ_hi)
         if platform == "cpu":
             env["PYTHONPATH"] = ""          # skip axon sitecustomize
             env["JAX_PLATFORMS"] = "cpu"

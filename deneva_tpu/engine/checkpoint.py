@@ -26,8 +26,9 @@ import numpy as np
 # fails with a clear message instead of an opaque tree/shape error.
 # History: 1 = round-2 (TOState->MVCCState, watermark_buckets split);
 #          2 = round-3 (MVCC per-row VersionRing joins the db pytree);
-#          3 = round-4 (PoolState.defer_cnt for the defer budget).
-SCHEMA_VERSION = 3
+#          3 = round-4 (PoolState.defer_cnt for the defer budget);
+#          4 = round-4 (per-type latency_hist + retry/wait hist leaves).
+SCHEMA_VERSION = 4
 
 
 def save_state(path: str, state) -> None:

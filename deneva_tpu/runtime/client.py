@@ -115,12 +115,11 @@ class ClientNode:
             slot = tags % TAG_RING
             vals = (now - self.send_us[slot]) / 1e6     # seconds
             lat_arr.extend(vals)
-            if len(self.type_names) > 1:
-                tt = self.tag_type[slot]
-                for t, nm in enumerate(self.type_names):
-                    m = tt == t
-                    if m.any():
-                        self.stats.arr(f"{nm}_latency").extend(vals[m])
+            tt = self.tag_type[slot]
+            for t, nm in enumerate(self.type_names):
+                m = tt == t
+                if m.any():
+                    self.stats.arr(f"{nm}_latency").extend(vals[m])
             self.stats.incr("txn_cnt", len(tags))
         elif rtype == "SHUTDOWN":
             self.stop = True
