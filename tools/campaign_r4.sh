@@ -1,0 +1,18 @@
+#!/bin/bash
+# Round-4 full re-measurement campaign (VERDICT r3 next #1/#2):
+# every sweep re-run against round-4 code so no number in results/
+# describes behavior the code doesn't have.  Sequential: single-client
+# TPU tunnel.  Priority order = the VERDICT's named sweeps first.
+# --bench = full problem sizes, short windows (the rounds-2/3 tier).
+cd /root/repo
+set -x
+for exp in tpcc_scaling ycsb_skew ycsb_inflight isolation_levels \
+           escrow_ablation modes cluster_scaling network_sweep \
+           operating_points ycsb_hot ycsb_writes ycsb_scaling \
+           ycsb_partitions pps_scaling; do
+  timeout 5400 python -m deneva_tpu.harness.run "$exp" --bench \
+    || echo "FAILED: $exp"
+  echo "DONE: $exp"
+done
+timeout 1200 python tools/measure_cluster_tpu.py || echo "FAILED: cluster_tpu"
+echo CAMPAIGN_R4_DONE
