@@ -213,6 +213,9 @@ class Config:
     mc_plan_capacity: float = 2.0  # sharded multi-chip plan: per-chip buffer
     #                                = factor * N/D lanes (0 = replicate
     #                                  the full plan per chip, round-3 mode)
+    tpcc_order_index: bool = False  # maintain the dynamic ordered ORDER
+    #                                 index (index_btree insert analogue;
+    #                                 one merge sort per epoch)
     exec_subrounds: int = 4        # chained-execution levels per epoch (CALVIN/TPU_BATCH)
     mvcc_his_len: int = 4          # in-state version history depth (HIS_RECYCLE_LEN analogue)
     escrow_order_free: bool = True  # honor workload order_free (escrow/
@@ -359,6 +362,17 @@ class Config:
             _check(self.max_accesses >= 3 + self.max_items_per_txn,
                    "TPCC max_accesses must cover wh+dist+cust+items "
                    f"(>= {3 + self.max_items_per_txn})")
+        if self.tpcc_order_index:
+            _check(self.workload == WorkloadKind.TPCC,
+                   "tpcc_order_index is TPC-C only")
+            _check(self.device_parts == 1,
+                   "tpcc_order_index does not compose with multi-chip "
+                   "execution yet")
+            _check(self.num_wh * 10 < 1024
+                   and self.insert_table_cap + 3001 < (1 << 21),
+                   "order_index_key packs district * 2^21 + o_id into "
+                   "int32: needs num_wh <= 102 and insert_table_cap + "
+                   "3001 < 2^21")
         _check(self.isolation_level in (
             "SERIALIZABLE", "READ_COMMITTED", "READ_UNCOMMITTED", "NOLOCK"),
             f"bad isolation_level {self.isolation_level!r}")

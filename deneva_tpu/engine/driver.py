@@ -234,6 +234,16 @@ def run_simulation(cfg: Config, chunk: int = 50,
         if d.sum() > 0:
             st.arr(name).extend_weighted(np.arange(len(d)), d)
     st.set("abort_rate", float(aborts) / max(float(commits + aborts), 1.0))
+    # host-side overflow surfacing for capacity-bounded index structures
+    # (DynamicSortedIndex contract): past overflow, probes may return
+    # slots of ring-overwritten rows — refuse to report such a run
+    for name, t in (state.db.items() if isinstance(state.db, dict) else ()):
+        if hasattr(t, "overflowed") and bool(
+                np.asarray(jax.device_get(t.overflowed()))):
+            raise RuntimeError(
+                f"index {name!r} overflowed its capacity during the run "
+                "(stale lookups possible); raise its capacity "
+                "(insert_table_cap) or shorten the run")
     if cfg.checkpoint_path:
         from deneva_tpu.engine.checkpoint import save_state
         save_state(cfg.checkpoint_path, state)

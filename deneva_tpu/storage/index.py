@@ -210,6 +210,103 @@ class SortedIndex:
         return jnp.where(inside, slots, jnp.int32(self.miss_slot)), inside
 
 
+_BIG = np.int32(np.iinfo(np.int32).max)
+
+
+@dataclass
+class DynamicSortedIndex:
+    """Ordered key -> slot index that accepts BATCHED INSERTS — the
+    dynamic half of the reference's latched B+-tree
+    (`storage/index_btree.cpp:252-420` ``index_insert``/``split_nd``
+    under per-node latches), closing SURVEY's last `partial` row.
+
+    TPU shape: a BIG-padded sorted array of static capacity.  An insert
+    epoch is ONE fused sort of (live entries ++ new entries) — the
+    batched between-epoch merge replacing per-key root-to-leaf descents
+    and node splits; probes are the same latch-free vectorized binary
+    search as `SortedIndex` (validity = key != BIG instead of a static
+    length, so the count can live on device).  Mutation between epochs,
+    probes within them: the latch discipline the reference's tree
+    exists to provide is the epoch boundary itself.
+
+    Capacity contract: entries past ``cap`` (the largest keys) are
+    dropped at merge time; ``cnt`` tracks the live total so callers can
+    detect overflow host-side (`overflowed`).  Duplicate keys are
+    allowed (itemid_t chains): `lookup` returns the first, stable by
+    insert order within one merge.
+    """
+
+    keys: jax.Array        # int32[cap] ascending; BIG = free tail
+    slots: jax.Array       # int32[cap]
+    cnt: jax.Array         # int32 scalar: live entries (pre-clip total)
+    # -- static --
+    cap: int
+    miss_slot: int
+
+    @classmethod
+    def build(cls, keys: np.ndarray, slots: np.ndarray, miss_slot: int,
+              cap: int) -> "DynamicSortedIndex":
+        keys = np.asarray(keys, np.int32)
+        slots = np.asarray(slots, np.int32)
+        assert keys.ndim == 1 and keys.shape == slots.shape
+        assert len(keys) <= cap, "initial entries exceed capacity"
+        assert (keys < _BIG).all(), "int32 max is the padding sentinel"
+        order = np.argsort(keys, kind="stable")
+        k = np.full(cap, _BIG, np.int32)
+        s = np.full(cap, miss_slot, np.int32)
+        k[: len(keys)] = keys[order]
+        s[: len(keys)] = slots[order]
+        return cls(keys=jnp.asarray(k), slots=jnp.asarray(s),
+                   cnt=jnp.int32(len(keys)), cap=cap,
+                   miss_slot=miss_slot)
+
+    # -- mutation (between epochs; one fused sort) ----------------------
+    def insert(self, new_keys: jax.Array, new_slots: jax.Array,
+               mask: jax.Array) -> "DynamicSortedIndex":
+        """Merge ``mask``-ed new entries: sort (live ++ new) by key and
+        keep the first ``cap`` (masked lanes carry BIG and sort out).
+        jit-safe; O((cap + m) log) — the whole epoch's inserts amortize
+        one merge, vs one tree descent per key in the reference."""
+        nk = jnp.where(mask, new_keys.astype(jnp.int32), _BIG)
+        ns = new_slots.astype(jnp.int32)
+        allk = jnp.concatenate([self.keys, nk.reshape(-1)])
+        alls = jnp.concatenate([self.slots, ns.reshape(-1)])
+        sk, ss = jax.lax.sort((allk, alls), num_keys=1, is_stable=True)
+        return DynamicSortedIndex(
+            keys=sk[: self.cap], slots=ss[: self.cap],
+            cnt=self.cnt + mask.sum(dtype=jnp.int32),
+            cap=self.cap, miss_slot=self.miss_slot)
+
+    def overflowed(self) -> jax.Array:
+        """True once inserts have exceeded capacity (dropped tail).
+        Callers MUST surface this host-side (the in-process driver
+        raises at summary time): past overflow, lookups can return
+        slots of rows the backing ring has since overwritten — silently
+        wrong data, not misses."""
+        return self.cnt > jnp.int32(self.cap)
+
+    # -- probes (epoch-batched, latch-free) -----------------------------
+    # Delegated to SortedIndex over the padded arrays with n = cap: the
+    # BIG padding sorts above every real query key (all real keys are
+    # < int32 max by construction), so its bounds checks subsume the
+    # validity test — one probe implementation, two index kinds.
+    def _view(self) -> SortedIndex:
+        return SortedIndex(keys=self.keys, slots=self.slots,
+                           n=self.cap, miss_slot=self.miss_slot)
+
+    def lookup(self, q: jax.Array) -> jax.Array:
+        return self._view().lookup(q)
+
+    def lookup_count(self, q: jax.Array) -> jax.Array:
+        return self._view().lookup_count(q)
+
+    def range_between(self, q_lo: jax.Array, q_hi: jax.Array, width: int
+                      ) -> tuple[jax.Array, jax.Array]:
+        """Padded scan of keys in [q_lo, q_hi] (q_hi < int32 max, so the
+        BIG padding can never enter the window); width caps it."""
+        return self._view().range_between(q_lo, q_hi, width)
+
+
 def _hash_np(k: np.ndarray, cap: int) -> np.ndarray:
     # full-width avalanche (lowbias32-style), then mask: a bare
     # multiply-shift keeps only 16 useful bits, which collapses any
@@ -244,4 +341,10 @@ jax.tree_util.register_dataclass(
     SortedIndex,
     data_fields=["keys", "slots"],
     meta_fields=["n", "miss_slot"],
+)
+
+jax.tree_util.register_dataclass(
+    DynamicSortedIndex,
+    data_fields=["keys", "slots", "cnt"],
+    meta_fields=["cap", "miss_slot"],
 )

@@ -279,6 +279,14 @@ class TPCCWorkload:
     def cust_key(self, w, d, c):
         return self.dist_key(w, d) * self.cust_per_dist + c
 
+    def order_index_key(self, w, d, o_id):
+        """Dynamic ORDER-index key, district-major so one district's
+        orders are a contiguous ascending o_id run (range scans = the
+        B+-tree leaf walk).  o_id stays < 2^21 and districts < 2^10 by
+        the tpcc_order_index config guard, so the composite fits int32."""
+        return (self.dist_key(w, d) * jnp.int32(1 << 21)
+                + o_id.astype(jnp.int32))
+
     def stock_key(self, w, i):
         return w * self.max_items + i
 
@@ -438,6 +446,16 @@ class TPCCWorkload:
                     ("STOCK", self.max_items), ("HISTORY", 1),
                     ("ORDER", 1), ("NEW-ORDER", 1), ("ORDER-LINE", 1)):
                 db[name] = to_mc_layout(db[name], D, anchor_rows)
+        if self.cfg.tpcc_order_index:
+            # dynamic ordered ORDER index (reference index_btree over
+            # inserted orders, `index_btree.cpp:252-420`): key =
+            # district * 2^21 + o_id, merged per epoch as NewOrders
+            # commit (`_exec_neworder`), probed by key or district range
+            from deneva_tpu.storage.index import DynamicSortedIndex
+            db["ORDER_IDX"] = DynamicSortedIndex.build(
+                np.zeros(0, np.int32), np.zeros(0, np.int32),
+                miss_slot=db["ORDER"].capacity,
+                cap=self.cfg.insert_table_cap)
         return db
 
     # -- generation (tpcc_query.cpp:144-260) ----------------------------
@@ -763,8 +781,13 @@ class TPCCWorkload:
                      "O_ALL_LOCAL": all_local.astype(jnp.int32)}
         if self.full_schema:
             order_row["O_CARRIER_ID"] = jnp.zeros((n,), jnp.int32)
-        db["ORDER"], _ = db["ORDER"].append(order_row, m_ins,
-                                            anchor=q.w_id)
+        db["ORDER"], oslots = db["ORDER"].append(order_row, m_ins,
+                                                 anchor=q.w_id)
+        if "ORDER_IDX" in db:
+            # between-epoch batched merge into the dynamic ordered index
+            # (one fused sort per epoch instead of per-key tree descents)
+            db["ORDER_IDX"] = db["ORDER_IDX"].insert(
+                self.order_index_key(q.w_id, q.d_id, o_id), oslots, m_ins)
         db["NEW-ORDER"], _ = db["NEW-ORDER"].append(
             {"NO_O_ID": o_id, "NO_D_ID": q.d_id, "NO_W_ID": q.w_id}, m_ins,
             anchor=q.w_id)
