@@ -200,7 +200,7 @@ class ClientNode:
             for nm in self.type_names:
                 a = st.arrays.get(f"{nm}_latency")
                 if a is not None:
-                    combined.extend(a._buf[: a._n], a._w[: a._n])
+                    combined.merge_from(a)
         st.set("total_runtime", time.monotonic() - t_start)
         st.set("sent_cnt", float(sent_total))
         for k, v in self.tp.stats().items():

@@ -102,6 +102,12 @@ class StatsArr:
     def percentiles(self, ps=(50, 90, 95, 99)) -> dict[str, float]:
         return {f"p{p}": self.percentile(p) for p in ps}
 
+    def merge_from(self, other: "StatsArr") -> None:
+        """Splice another array's weighted entries in (the one shared
+        representation-aware merge; used by Stats.merge and the cluster
+        client's per-type family rollup)."""
+        self.extend(other._buf[: other._n], other._w[: other._n])
+
     def mean(self) -> float:
         w = self._w[: self._n]
         tot = w.sum()
@@ -141,7 +147,7 @@ class Stats:
         for k, v in other.counters.items():
             self.counters[k] += v
         for k, a in other.arrays.items():
-            self.arr(k).extend(a._buf[: a._n], a._w[: a._n])
+            self.arr(k).merge_from(a)
         # Union of run windows: workers measure concurrently, so the
         # aggregate window spans min(start)..max(end), not the sum.
         if other._t_start is not None:

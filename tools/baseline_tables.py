@@ -65,7 +65,7 @@ def main() -> int:
     print(pivot("operating_points", "epoch_batch"))
     print("\n### escrow_ablation\n")
     print(listing("escrow_ablation"))
-    print("\n### isolation_levels (NO_WAIT)\n")
+    print("\n### isolation_levels (NO_WAIT + WAIT_DIE)\n")
     print(pivot("isolation_levels", "isolation_level", series="cc_alg"))
     print("\n### modes\n")
     print(pivot("modes", "mode", series="cc_alg"))

@@ -29,6 +29,7 @@ from deneva_tpu.harness.parse import load_results  # noqa: E402
 EXPECTED = "results/expected.json"
 SWEEPS = ("isolation_levels", "operating_points", "escrow_ablation",
           "ycsb_skew", "ycsb_writes", "ycsb_hot", "ycsb_inflight",
+          "ycsb_scaling", "ycsb_partitions",
           "tpcc_scaling", "pps_scaling", "modes", "cluster_tpu",
           "cluster_scaling", "network_sweep")
 
