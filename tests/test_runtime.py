@@ -201,7 +201,7 @@ def test_wait_die_preserves_birth_ts_across_restarts():
             birth = np.array([7, 9, 11, 13], np.int64)
             node.retry.push(blk, np.full(4, int(aborted), np.int32), birth,
                             epoch=0, aborted=np.full(4, aborted, bool))
-            _, _, ts = node._contribution(epoch=5)
+            _, _, ts, _ = node._contribution(epoch=5)
             return birth, ts
         finally:
             node.close()
