@@ -217,6 +217,14 @@ def _shift1(x: jax.Array, fill) -> jax.Array:
     return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
 
 
+def seg_first(flags: jax.Array, vals: jax.Array) -> jax.Array:
+    """Head-value propagation: every lane takes the value at the nearest
+    preceding flagged lane (its own if flagged; its initial value if no
+    flag precedes it).  The copy-head combine used by `forward_plan_flat`
+    and the executors' monotone-scatter winner propagation."""
+    return _seg_scan(flags, vals, lambda v1, v2: v1)
+
+
 def forward_plan(keys: jax.Array, rank: jax.Array,
                  is_write: jax.Array, valid: jax.Array,
                  with_perm: bool = False) -> ForwardPlan:
@@ -241,13 +249,19 @@ def forward_plan_flat(k: jax.Array, r: jax.Array, w: jax.Array,
     n = k.shape[0]
 
     # one fused sort carries the payload with the keys — materially
-    # faster on TPU than argsort + permutation gathers
+    # faster on TPU than argsort + permutation gathers.  is_stable=False:
+    # jax's default stable sort appends an iota tiebreaker operand (a 4th
+    # sorted array, ~12% of the sort's time on v5e); ties are (key, rank)
+    # duplicates — one txn's repeated accesses to one key — whose relative
+    # order is immaterial to fwd/win/checksum (group-head propagation and
+    # the suffix-max winner treat equal-(k,r) lanes identically).
     perm = None
     if with_perm:
         lanes = jnp.arange(n, dtype=jnp.int32)
-        sk, sr, sw, perm = jax.lax.sort((k, r, w, lanes), num_keys=2)
+        sk, sr, sw, perm = jax.lax.sort((k, r, w, lanes), num_keys=2,
+                                        is_stable=False)
     else:
-        sk, sr, sw = jax.lax.sort((k, r, w), num_keys=2)
+        sk, sr, sw = jax.lax.sort((k, r, w), num_keys=2, is_stable=False)
     big = jnp.int32(jnp.iinfo(jnp.int32).max)
     srd = (sk != big) & ~sw                         # valid reads
     cand = jnp.where(sw, sr, jnp.int32(-1))
@@ -262,7 +276,7 @@ def forward_plan_flat(k: jax.Array, r: jax.Array, w: jax.Array,
     # propagate the head's exclusive max through the group
     grp_head = key_head | (sr != _shift1(sr, jnp.int32(-1)))
     head_val = jnp.where(grp_head, excl, jnp.int32(-1))
-    fwd = _seg_scan(grp_head, head_val, lambda v1, v2: v1)
+    fwd = seg_first(grp_head, head_val)
 
     # final writer per key = the max-index write lane of the key segment
     # (reverse segmented max; segment heads in reverse order are the

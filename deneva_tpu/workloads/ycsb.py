@@ -75,7 +75,8 @@ def _field_bytes(key, version, nbytes: int) -> jax.Array:
     return ((mixed >> jnp.uint32(13)) & jnp.uint32(0xFF)).astype(jnp.uint8)
 
 
-def _forward_execute_f0(f0: jax.Array, p, slots: jax.Array, trash):
+def _forward_execute_f0(f0: jax.Array, p, slots: jax.Array, trash,
+                        mono: bool = False):
     """THE forwarding-executor data path, shared verbatim by the
     single-chip `execute` and each shard of `execute_mc` so their
     semantics cannot diverge: reads gather F0 (forwarded lanes take
@@ -85,7 +86,19 @@ def _forward_execute_f0(f0: jax.Array, p, slots: jax.Array, trash):
 
     ``f0`` is uint32[N] in fingerprint mode or uint8[N, S] under
     SIM_FULL_ROW — the full-row branch moves the real payload bytes, so
-    benchmark numbers measure reference-width HBM traffic."""
+    benchmark numbers measure reference-width HBM traffic.
+
+    ``mono`` (callers with key-monotone slot maps, i.e. every current
+    caller: slot order follows the plan's sorted key order and masked
+    lanes steer to a trash at/above the top): the write scatter hands
+    XLA MONOTONE, pre-sorted indices — ``cummax`` carries the latest
+    winner's slot into following lanes and two head-propagation scans
+    carry its (key, rank) so the duplicate lanes rewrite the same value
+    idempotently; lanes before the first winner drop (index -1,
+    mode='drop').  This skips the sort XLA otherwise inserts inside
+    every scatter lowering (~0.6 ms at 655k lanes on v5e — the roofline
+    ledger's sort.67).  The legacy trash-steered scatter remains for
+    non-monotone slot maps (mono=False)."""
     vals = jnp.take(f0, jnp.where(p.is_read, slots, trash), axis=0)
     if f0.ndim == 2:
         nbytes = f0.shape[1]
@@ -93,12 +106,24 @@ def _forward_execute_f0(f0: jax.Array, p, slots: jax.Array, trash):
                          _field_bytes(p.keys, p.fwd, nbytes), vals)
         cks = jnp.sum(jnp.where(p.is_read[:, None], vals, 0),
                       dtype=jnp.uint32)
-        wvals = _field_bytes(p.keys, p.rank, nbytes)
     else:
         vals = jnp.where(p.fwd >= 0, _field_fingerprint(p.keys, p.fwd), vals)
         cks = jnp.sum(jnp.where(p.is_read, vals, 0), dtype=jnp.uint32)
-        wvals = _field_fingerprint(p.keys, p.rank).astype(f0.dtype)
-    f0 = f0.at[jnp.where(p.win, slots, trash)].set(wvals)
+    if mono:
+        from deneva_tpu.ops.forward import seg_first
+        # nearest-preceding-winner slot: cummax works because slots
+        # ascend (a Kogge-Stone scan here measures slower end-to-end —
+        # XLA fuses its concatenate chains into the gather fusion)
+        wslot = jax.lax.cummax(jnp.where(p.win, slots, jnp.int32(-1)))
+        wkey = seg_first(p.win, p.keys)
+        wrank = seg_first(p.win, p.rank)
+        wvals = _field_bytes(wkey, wrank, f0.shape[1]) if f0.ndim == 2 \
+            else _field_fingerprint(wkey, wrank).astype(f0.dtype)
+        f0 = f0.at[wslot].set(wvals, mode="drop", indices_are_sorted=True)
+    else:
+        wvals = _field_bytes(p.keys, p.rank, f0.shape[1]) if f0.ndim == 2 \
+            else _field_fingerprint(p.keys, p.rank).astype(f0.dtype)
+        f0 = f0.at[jnp.where(p.win, slots, trash)].set(wvals)
     return f0, cks, p.is_write.sum(dtype=jnp.uint32)
 
 
@@ -383,7 +408,11 @@ class YCSBWorkload:
             # padded row is the block-local trash
             trash = jnp.int32(f0.shape[0] - 1)
             slots = jnp.where(p.keys != big, p.keys // d_parts, trash)
-            f0, cks, wcnt = _forward_execute_f0(f0, p, slots, trash)
+            # mono holds per shard: plan keys are sorted with non-owned
+            # lanes already masked to the big sentinel, so slots ascend
+            # toward the block-local trash at the top
+            f0, cks, wcnt = _forward_execute_f0(f0, p, slots, trash,
+                                                mono=True)
             return (f0, jax.lax.psum(cks, AXIS),
                     jax.lax.psum(wcnt, AXIS), dfr)
 
@@ -425,8 +454,14 @@ class YCSBWorkload:
                 "ForwardPlan embodies the commit set; pass mask=None"
             p = fwd_rank
             slots = self.index.lookup(p.keys)                  # [N]
+            # mono: with one partition every valid key is owned, so the
+            # slot map follows sorted-key order (DenseIndex identity /
+            # SortedIndex rank) and misses steer to capacity at the top;
+            # under part_cnt striping non-owned keys hit miss_slot
+            # INTERLEAVED between owned slots — not monotone
             f0, cks, wcnt = _forward_execute_f0(
-                tab.columns["F0"], p, slots, tab.capacity)
+                tab.columns["F0"], p, slots, tab.capacity,
+                mono=self.n_parts == 1)
             stats["read_checksum"] = stats["read_checksum"] + cks
             stats["write_cnt"] = stats["write_cnt"] + wcnt
             db = dict(db)

@@ -334,3 +334,51 @@ def test_overlap_pallas_kernel_body_interpret():
                     == np.asarray(overlap(a1, b1))).all()
     finally:
         pk._INTERPRET = old
+
+
+def test_forward_execute_mono_scatter_matches_legacy():
+    """The monotone pre-sorted scatter (mono=True, the hot-path default)
+    must be bit-identical to the legacy trash-steered scatter on both
+    table state and checksum — winners' values land, losers' duplicate
+    rewrites are idempotent, pre-first-winner lanes drop."""
+    from deneva_tpu.ops import forward_plan_flat
+    from deneva_tpu.workloads.ycsb import _forward_execute_f0
+
+    rng = np.random.default_rng(11)
+    n, tab = 4096, 512
+    keys = rng.integers(0, 200, n).astype(np.int32)   # heavy duplication
+    keys[rng.random(n) < 0.05] = np.iinfo(np.int32).max  # invalid lanes
+    rank = np.repeat(np.arange(n // 4, dtype=np.int32), 4)
+    w = rng.random(n) < 0.5
+    w &= keys != np.iinfo(np.int32).max
+    p = forward_plan_flat(jnp.asarray(keys), jnp.asarray(rank),
+                          jnp.asarray(w))
+    big = jnp.int32(np.iinfo(np.int32).max)
+    slots = jnp.where(p.keys != big, p.keys, tab)     # identity index
+    f0 = jnp.asarray(rng.integers(0, 2**32, tab + 1, dtype=np.uint32))
+    a_f0, a_cks, a_w = _forward_execute_f0(f0, p, slots, tab, mono=False)
+    b_f0, b_cks, b_w = _forward_execute_f0(f0, p, slots, tab, mono=True)
+    # trash slot may differ (legacy parks losers there); data rows must not
+    np.testing.assert_array_equal(np.asarray(a_f0)[:tab],
+                                  np.asarray(b_f0)[:tab])
+    assert int(a_cks) == int(b_cks) and int(a_w) == int(b_w)
+
+
+def test_forward_execute_mono_scatter_matches_legacy_full_row():
+    from deneva_tpu.ops import forward_plan_flat
+    from deneva_tpu.workloads.ycsb import _forward_execute_f0
+
+    rng = np.random.default_rng(12)
+    n, tab, width = 1024, 128, 24
+    keys = rng.integers(0, 64, n).astype(np.int32)
+    rank = np.repeat(np.arange(n // 2, dtype=np.int32), 2)
+    w = rng.random(n) < 0.5
+    p = forward_plan_flat(jnp.asarray(keys), jnp.asarray(rank),
+                          jnp.asarray(w))
+    slots = p.keys
+    f0 = jnp.asarray(rng.integers(0, 256, (tab + 1, width), dtype=np.uint8))
+    a_f0, a_cks, _ = _forward_execute_f0(f0, p, slots, tab, mono=False)
+    b_f0, b_cks, _ = _forward_execute_f0(f0, p, slots, tab, mono=True)
+    np.testing.assert_array_equal(np.asarray(a_f0)[:tab],
+                                  np.asarray(b_f0)[:tab])
+    assert int(a_cks) == int(b_cks)
