@@ -27,8 +27,9 @@ import numpy as np
 # History: 1 = round-2 (TOState->MVCCState, watermark_buckets split);
 #          2 = round-3 (MVCC per-row VersionRing joins the db pytree);
 #          3 = round-4 (PoolState.defer_cnt for the defer budget);
-#          4 = round-4 (per-type latency_hist + retry/wait hist leaves).
-SCHEMA_VERSION = 4
+#          4 = round-4 (per-type latency_hist + retry/wait hist leaves);
+#          5 = round-5 (VersionRing flattened to [R*H] storage).
+SCHEMA_VERSION = 5
 
 
 def save_state(path: str, state) -> None:

@@ -30,6 +30,30 @@ def last_writer(slots: jax.Array, order: jax.Array, mask: jax.Array,
     """
     n = slots.shape[0]
     slots = jnp.where(mask, slots, capacity).astype(jnp.int32)
+    if n < capacity:
+        # SORT-BASED tournament (round-5): scatter/gather over a
+        # [capacity+1] arena costs a full arena copy per call on TPU
+        # (XLA lowers batched scatters as copy + apply), which at small
+        # epochs over big tables (16M-row YCSB, eb<=2048 — every sweep
+        # backend's operating point) dominated the epoch (~0.66 ms/call).
+        # Sorting the N lanes by (slot, order, lane) makes each slot's
+        # winner its segment tail; a second sort by lane restores the
+        # original order.  Lane ids break ties exactly like the
+        # arena form (highest lane among equal order) and make the keys
+        # unique, so the unstable sorts are deterministic.  O(N log^2 N)
+        # independent of table size; the arena form remains for
+        # N >= capacity, where one arena pass beats two sorts.
+        lane = jnp.arange(n, dtype=jnp.int32)
+        neg_o = jnp.iinfo(order.dtype).min
+        eff_ord = jnp.where(mask, order, neg_o)
+        eff_lane = jnp.where(mask, lane, jnp.int32(-1))
+        ssl, _, _, slane = jax.lax.sort(
+            (slots, eff_ord, eff_lane, lane), num_keys=3,
+            is_stable=False)
+        tail = jnp.concatenate([ssl[1:] != ssl[:-1],
+                                jnp.ones((1,), bool)])
+        _, win = jax.lax.sort((slane, tail), num_keys=1, is_stable=False)
+        return win & mask
     neg = jnp.iinfo(order.dtype).min
     eff = jnp.where(mask, order, neg)
     best = jnp.full((capacity + 1,), neg, order.dtype).at[slots].max(eff)

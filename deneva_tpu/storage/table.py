@@ -183,69 +183,101 @@ class DeviceTable:
 
 @dataclass
 class VersionRing:
-    """Per-row bounded version history for ONE column (reference
-    `row_mvcc.{h,cpp}`: HIS_RECYCLE_LEN-deep write history per row,
-    `row_mvcc.cpp:172-196,303-321`).
+    """Per-row bounded OVERWRITE-TIMESTAMP history for ONE column
+    (reference `row_mvcc.{h,cpp}`: HIS_RECYCLE_LEN-deep write history per
+    row, `row_mvcc.cpp:172-196,303-321`).
 
-    Entry semantics: slot ``(r, i)`` records that a committed write with
-    timestamp ``wts[r, i]`` OVERWROTE the value ``old[r, i]`` — i.e. the
-    stored bytes are the version that was current in ``[prev_wts, wts)``.
-    A reader at timestamp t therefore takes the ``old`` of the OLDEST
-    entry with ``wts > t`` (the first overwrite after its read point); if
-    no entry is newer than t, the live table value is correct.  Rows never
-    written keep all-zero entries, which serve every reader from the live
-    table — the load-time base version needs no materialization.
+    Entry ``(r, i)`` (stored flat at ``r*H + i``) holds the serialization
+    timestamp of a committed overwrite of row r; 0 = empty.  The ring is
+    FIFO without a cursor: commit timestamps increase monotonically, so
+    the oldest entry is simply the row's MINIMUM and each push overwrites
+    it (argmin — empties first, since 0 sorts below every real ts >= 1).
+
+    The ring stores NO value bytes (round-5; round 3-4 stored the
+    overwritten payload per entry).  In this framework every committed
+    value is the deterministic version law ``f(key, writer_ts)`` — the
+    same law the executors use to WRITE (`workloads/ycsb._forward_execute_f0`)
+    — so the version a reader at t needs is reconstructed from timestamps
+    alone: it was written at ``v* = max(entry ts <= t, default 0)`` (0 =
+    the load-time base version), value ``f(key, v*)``.  ``select_version``
+    returns (v*, has_newer); the workload turns v* into bytes.  Dropping
+    the value array cut the ring from 600 MB to 268 MB at 16M rows and —
+    since a batched scatter on TPU costs a full copy of its operand every
+    epoch — removed two of the three whole-array copies from the MVCC
+    epoch.
 
     Retention/GC is the bucket boundary ring in `cc/timestamp.MVCCState`:
     a read COMMITS only when ``ts >= min(bucket boundaries)``, and at most
     H-1 distinct epoch boundaries (hence at most H-1 per-row overwrites)
-    can exceed such a ts, so the needed entry is always retained here.
-    The decision ring is a hashed over-approximation (may abort a
-    servable read, never serves a wrong one); this ring is exact per row.
+    can exceed such a ts, so every post-t overwrite of the row is still
+    retained here and v* is exact.  The decision ring is a hashed
+    over-approximation (may abort a servable read, never serves a wrong
+    one); this ring is exact per row.
     """
 
-    wts: jax.Array   # int32[R, H]   timestamp of the overwriting write
-    old: jax.Array   # dtype[R, H, *extra] bytes the write replaced
-    pos: jax.Array   # int32[R]      next ring slot per row
+    wts: jax.Array   # int32[R*H]   (flat [row, ring slot], row-major)
+    depth: int       # H (static)
 
     @classmethod
-    def create(cls, nrows: int, depth: int, dtype, extra: tuple = ()
-               ) -> "VersionRing":
-        return cls(wts=jnp.zeros((nrows, depth), jnp.int32),
-                   old=jnp.zeros((nrows, depth, *extra), dtype=dtype),
-                   pos=jnp.zeros((nrows,), jnp.int32))
+    def create(cls, nrows: int, depth: int) -> "VersionRing":
+        # FLAT storage, entry (r, i) at index r*H + i: 2D-indexed
+        # ``at[sl, p].set`` scatters lower to fully serialized XLA while
+        # loops on TPU (~1.3 us/lane measured — the 24 ms/epoch that made
+        # round-4 MVCC the floor of every sweep); the same updates
+        # against a flat buffer take the 1D fast path
+        return cls(wts=jnp.zeros((nrows * depth,), jnp.int32), depth=depth)
 
-    def select(self, slots: jax.Array, ts: jax.Array, current: jax.Array
-               ) -> jax.Array:
-        """Version-correct read values: ``slots``/``ts`` broadcast over the
-        access shape; ``current`` is the live-table gather result."""
-        big = jnp.int32(jnp.iinfo(jnp.int32).max)
-        vw = jnp.take(self.wts, slots, axis=0)           # [..., H]
+    def rows(self, slots: jax.Array) -> jax.Array:
+        """Gather the H ring entries of many rows at once: int32[..., H].
+        A gather against the big flat array costs ~0.3-1.5 ms per OP on
+        v5e regardless of lane count, so callers that both read versions
+        and push overwrites in one epoch fetch ONE combined row set and
+        feed it to `version_from` / `push_rows`."""
+        h = self.depth
+        base = slots[..., None] * h + jnp.arange(h, dtype=jnp.int32)
+        return jnp.take(self.wts, base, axis=0)
+
+    @staticmethod
+    def version_from(vw: jax.Array, ts: jax.Array
+                     ) -> tuple[jax.Array, jax.Array]:
+        """(v*, has_newer) per access from pre-gathered rows ``vw``
+        (int32[..., H]): ``v*`` is the timestamp that wrote the version
+        current at ``ts`` (0 = load base) and ``has_newer`` whether any
+        retained overwrite postdates ``ts`` (if not, the live table value
+        is already correct and callers skip reconstruction)."""
         newer = vw > ts[..., None]
-        idx = jnp.argmin(jnp.where(newer, vw, big), axis=-1)
-        vo = jnp.take(self.old, slots, axis=0)           # [..., H, *extra]
-        ix = idx.reshape(idx.shape + (1,) * (vo.ndim - idx.ndim))
-        sel = jnp.take_along_axis(vo, ix, axis=idx.ndim).squeeze(idx.ndim)
-        has = newer.any(axis=-1)
-        has = has.reshape(has.shape + (1,) * (current.ndim - has.ndim))
-        return jnp.where(has, sel, current)
+        vstar = jnp.max(jnp.where(newer, 0, vw), axis=-1)
+        return vstar, newer.any(axis=-1)
 
-    def push(self, slots: jax.Array, wts: jax.Array, old_vals: jax.Array,
-             mask: jax.Array) -> "VersionRing":
+    def select_version(self, slots: jax.Array, ts: jax.Array
+                       ) -> tuple[jax.Array, jax.Array]:
+        """`rows` + `version_from` for callers without a shared gather."""
+        return self.version_from(self.rows(slots), ts)
+
+    def push_rows(self, vw: jax.Array, slots: jax.Array, wts: jax.Array,
+                  mask: jax.Array) -> "VersionRing":
         """Record committed overwrites (flat lanes; masked lanes land on
-        the trash row).  Callers pre-resolve duplicate slots (one winner
-        per row per epoch), so each row advances at most one ring slot."""
-        trash = jnp.int32(self.pos.shape[0] - 1)
+        the trash row) given pre-gathered rows ``vw`` (int32[N, H], from
+        `rows(slots)` — the RAW slots, unmasked: a masked lane's ring
+        position is garbage steered onto the trash row anyway).  Callers
+        pre-resolve duplicate slots (one winner per row per epoch), so
+        each row advances at most one ring slot.  FIFO slot = argmin of
+        the row (0-empties first; real ts are monotone)."""
+        h = self.depth
+        trash = jnp.int32(self.wts.shape[0] // h - 1)
         sl = jnp.where(mask, slots, trash)
-        p = jnp.take(self.pos, sl)
+        p = jnp.argmin(vw, axis=-1)
         return VersionRing(
-            wts=self.wts.at[sl, p].set(wts.astype(jnp.int32)),
-            old=self.old.at[sl, p].set(old_vals.astype(self.old.dtype)),
-            pos=self.pos.at[sl].set((p + 1) % self.wts.shape[1]))
+            wts=self.wts.at[sl * h + p].set(wts.astype(jnp.int32)),
+            depth=self.depth)
+
+    def push(self, slots: jax.Array, wts: jax.Array, mask: jax.Array
+             ) -> "VersionRing":
+        return self.push_rows(self.rows(slots), slots, wts, mask)
 
 
 jax.tree_util.register_dataclass(
-    VersionRing, data_fields=["wts", "old", "pos"], meta_fields=[])
+    VersionRing, data_fields=["wts"], meta_fields=["depth"])
 
 
 def mc_block_geometry(capacity: int, anchor_rows: int, d_parts: int

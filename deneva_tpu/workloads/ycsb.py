@@ -218,16 +218,23 @@ class YCSBWorkload:
             tab = to_mc_layout(tab, self.cfg.device_parts)
         db = {TABLE: tab}
         if self.cfg.cc_alg == CCAlg.MVCC and self.cfg.device_parts == 1:
-            # per-row version-value ring (row_mvcc.cpp:172-196): stale
+            # per-row overwrite-ts ring (row_mvcc.cpp:172-196): stale
             # reads of read-write txns return HISTORICAL bytes of the
-            # queried field, not the live snapshot.  Paired with the
-            # bucket boundary ring in cc/timestamp.MVCCState, which makes
-            # the retention DECISION (see VersionRing docstring for why
-            # its commit rule bounds this ring's needed depth).
+            # queried field — reconstructed from the version law
+            # f(key, v*) with v* from the ring (VersionRing docstring).
+            # Paired with the bucket boundary ring in
+            # cc/timestamp.MVCCState, which makes the retention DECISION
+            # and bounds this ring's needed depth.
             f0 = tab.columns["F0"]
+            # depth must be the FULL mvcc_his_len: a servable read at t
+            # may have mvcc_his_len-1 overwrites postdating t (the
+            # decision ring's commit rule allows exactly that many), and
+            # the ts-only reconstruction needs ONE more retained entry —
+            # the newest <= t, which IS v* (the value ring of rounds 3-4
+            # stored displaced bytes, so it only needed the >t entries;
+            # this one reads v* directly)
             db[VER_TABLE] = VersionRing.create(
-                f0.shape[0], self.cfg.mvcc_his_len, f0.dtype,
-                tuple(f0.shape[1:]))
+                f0.shape[0], self.cfg.mvcc_his_len)
         return db
 
     # -- query generation (ycsb_query.cpp:303-376) ---------------------
@@ -478,16 +485,31 @@ class YCSBWorkload:
         ver: VersionRing | None = db.get(VER_TABLE)
         if ver is not None:
             # MVCC stale reads serve HISTORICAL bytes (row_mvcc.cpp:
-            # 172-196).  Verdict.order is the serialization ts, with
-            # read-only txns forced to 0 (they serialize AT the epoch
-            # snapshot, so the live gather already gave them the right
-            # version — exclude them by reading "at +inf").  Safe because
-            # real txn ts are >= 1 by construction — pool.next_seq starts
-            # at 1 and server._contribution raises on a sub-1 stamp.
+            # 172-196), reconstructed from the version law f(key, v*)
+            # (VersionRing.select_version).  Verdict.order is the
+            # serialization ts, with read-only txns forced to 0 (they
+            # serialize AT the epoch snapshot, so the live gather already
+            # gave them the right version — exclude them by reading "at
+            # +inf").  Safe because real txn ts are >= 1 by construction
+            # — pool.next_seq starts at 1 and server._contribution raises
+            # on a sub-1 stamp.
             big = jnp.int32(jnp.iinfo(jnp.int32).max)
             ver_ts = jnp.where(order > 0, order, big)
-            vals = ver.select(rslots, jnp.broadcast_to(
-                ver_ts[:, None], rslots.shape), vals)
+            # ONE row gather serves both the version select here and the
+            # push below (each gather against the big ring array costs a
+            # fixed ~ms-scale pass on v5e; see VersionRing.rows).  Raw
+            # slots: write-lane rows are garbage for select (masked by
+            # rmask downstream) and exactly what push needs.
+            ver_rows = ver.rows(slots)
+            vstar, has = ver.version_from(
+                ver_rows, jnp.broadcast_to(ver_ts[:, None], slots.shape))
+            if full:
+                vals = jnp.where(has[..., None],
+                                 _field_bytes(q.keys, vstar,
+                                              self.cfg.tup_size), vals)
+            else:
+                vals = jnp.where(has, _field_fingerprint(q.keys, vstar),
+                                 vals)
         rm = rmask[..., None] if full else rmask
         stats["read_checksum"] = stats["read_checksum"] + jnp.sum(
             jnp.where(rm, vals, 0), dtype=jnp.uint32)
@@ -507,12 +529,11 @@ class YCSBWorkload:
             if full else _field_fingerprint(q.keys.reshape(-1), worder)
         db = dict(db)
         if ver is not None:
-            # record the bytes each winning write OVERWRITES, stamped
-            # with the writer's commit ts (one winner per row per epoch,
-            # so each row advances at most one ring slot)
-            wsl = jnp.where(win, wslots, tab.capacity)
-            old_cur = jnp.take(tab.columns["F0"], wsl, axis=0)
-            db[VER_TABLE] = ver.push(wsl, worder, old_cur, win)
+            # record each winning overwrite's commit ts (one winner per
+            # row per epoch, so each row advances at most one ring slot);
+            # no value bytes — reads reconstruct via f(key, v*)
+            db[VER_TABLE] = ver.push_rows(
+                ver_rows.reshape(-1, ver.depth), wslots, worder, win)
         db[TABLE] = tab.scatter(wslots, {"F0": wvals}, mask=win)
         stats["write_cnt"] = stats["write_cnt"] + wmask.sum(dtype=jnp.uint32)
         return db

@@ -537,3 +537,59 @@ def test_to_watermark_width_no_false_aborts():
     # uniform keys on 64k rows, 1k accesses/epoch, 1M watermark buckets:
     # real ts conflicts are rare and false sharing rarer
     assert aborts / max(commits + aborts, 1) < 0.05
+
+
+def test_mvcc_value_ring_boundary_depth():
+    """Round-5 review regression: the ts-only VersionRing must retain the
+    FULL mvcc_his_len entries.  A servable read may have his_len-1
+    overwrites postdating its ts (the decision ring's commit rule allows
+    exactly that many), and the reconstruction reads the newest entry
+    <= ts — one MORE retained entry than the old displaced-bytes ring
+    needed.  With his_len=4: overwrites at ts 10/20/30/40, reader at 15
+    commits and must see f(5, 10), not the load base f(5, 0)."""
+    from deneva_tpu.config import WorkloadKind
+    from deneva_tpu.engine.step import init_device_stats
+    from deneva_tpu.workloads import get_workload
+    from deneva_tpu.workloads.ycsb import (VER_TABLE, YCSBQuery,
+                                           _field_fingerprint)
+
+    cfg = Config(workload=WorkloadKind.YCSB, cc_alg=CCAlg.MVCC,
+                 synth_table_size=1024, req_per_query=2, max_accesses=2,
+                 epoch_batch=2, conflict_buckets=512,
+                 max_txn_in_flight=2)
+    wl = get_workload(cfg)
+    db = wl.load()
+    be = get_backend(CCAlg.MVCC)
+    st = be.init_state(cfg)
+    stats = init_device_stats(len(wl.txn_type_names))
+
+    def epoch(db, st, stats, keys, is_write, ts):
+        n = len(keys)
+        q = YCSBQuery(keys=jnp.asarray(keys, jnp.int32),
+                      is_write=jnp.asarray(is_write))
+        p = wl.plan(db, q)
+        batch = AccessBatch(
+            table_ids=p["table_ids"], keys=p["keys"], is_read=p["is_read"],
+            is_write=p["is_write"], valid=p["valid"],
+            ts=jnp.asarray(ts, jnp.int32),
+            rank=jnp.arange(n, dtype=jnp.int32),
+            active=jnp.ones(n, bool))
+        inc = build_incidence(batch, cfg.conflict_buckets, cfg.conflict_exact)
+        v, st = be.validate(cfg, st, batch, inc)
+        db = wl.execute(db, q, v.commit & batch.active, v.order, stats)
+        return db, st, v, stats
+
+    for wts in (10, 20, 30, 40):          # his_len=4 overwrites of key 5
+        db, st, v, stats = epoch(db, st, stats, [[5, 5]],
+                                 [[True, True]], [wts])
+        assert np.asarray(v.commit)[0]
+    c0 = int(np.asarray(stats["read_checksum"]))
+    # reader at ts 15: 3 = his_len-1 overwrites (20/30/40) postdate it;
+    # the needed v*=10 entry must still be retained
+    db, st, v, stats = epoch(db, st, stats, [[5, 7]],
+                             [[False, True]], [15])
+    assert np.asarray(v.commit)[0], "decision ring must serve ts 15"
+    got = (int(np.asarray(stats["read_checksum"])) - c0) & 0xFFFFFFFF
+    want = int(np.asarray(_field_fingerprint(np.int32(5),
+                                             np.int32(10))))
+    assert got == want, f"boundary-depth read got {got} != f(5,10)={want}"

@@ -44,7 +44,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # op-name prefix -> phase attribution at the headline shape (v5e HLO);
 # anything unmatched lands in "bookkeeping/other"
-def classify(name: str, dur_ms: float, big: dict) -> str:
+def classify(name: str, big: dict) -> str:
     if name.startswith("sort."):
         return "plan sort (key,rank,w)"
     if name.startswith("reduce-window"):
@@ -134,7 +134,7 @@ def main():
           f"{eb / total * 1000 / 1e6:.2f}M txn/s (device-bound)\n")
     phases = collections.Counter()
     for nm, d in by.items():
-        phases[classify(nm, d / n / 1000, big)] += d
+        phases[classify(nm, big)] += d
     print(f"{'phase':<42}{'ms/epoch':>9}{'% epoch':>9}")
     for ph, d in phases.most_common():
         ms = d / n / 1000
