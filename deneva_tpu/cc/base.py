@@ -45,13 +45,16 @@ from deneva_tpu.ops import access_incidence, bucket_hash, combine_key
 
 
 def get_overlap(cfg):
-    """Per-config overlap op: the fused Pallas kernel when enabled, else
-    the XLA path.  Single dispatch point so no backend can miss the flag
-    (all overlap() call sites in cc/ go through this)."""
+    """Per-config overlap op.  A hand-written Pallas epilogue-fusion
+    kernel lived behind this dispatch in rounds 3-4; round-5 measured it
+    0.58-0.96x the XLA path at every sweep operating point (B in
+    {512,1024,2048} x K=8192, dual hash on/off, v5e — XLA already keeps
+    the compare+AND epilogue fused) and deleted it (BASELINE.md round-5
+    notes; kernel retrievable from git history at tag-of-commit 6fba114).
+    The dispatch point stays so a future winning kernel has one seam."""
     from deneva_tpu.ops import overlap
-    from deneva_tpu.ops.pallas_kernels import overlap_fused
 
-    return overlap_fused if cfg.use_pallas else overlap
+    return overlap
 
 
 @dataclass

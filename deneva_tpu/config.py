@@ -78,9 +78,19 @@ class Config:
     client_node_cnt: int = 1
     part_cnt: int = 1              # keyspace partitions (== node_cnt in reference)
     core_cnt: int = 8
-    thread_cnt: int = 4            # worker threads per node (interactive runtime)
-    rem_thread_cnt: int = 1        # input (receive) threads
-    send_thread_cnt: int = 1       # output (send) threads
+    thread_cnt: int = 1            # host codec worker threads (reference
+    #                                THREAD_CNT, main.cpp:196-310): >1 runs
+    #                                the cluster loop's per-epoch blob
+    #                                encode + feed assembly through a
+    #                                thread pool (numpy codecs release the
+    #                                GIL, so a multi-core host overlaps
+    #                                admit work with itself; this 1-core
+    #                                box measures it ~neutral)
+    rem_thread_cnt: int = 1        # native receiver IO threads (reference
+    #                                REM_THREAD_CNT): peers shard src % n
+    send_thread_cnt: int = 1       # native sender IO threads (reference
+    #                                SEND_THREAD_CNT): dests shard dest % n
+    #                                (per-dest FIFO preserved)
     client_thread_cnt: int = 4
 
     # ---- replication (reference config.h:24-27) ----
@@ -190,10 +200,6 @@ class Config:
     log_dir: str = "/tmp/deneva_logs"
 
     # ---- epoch engine (TPU-shaped; replaces thread/latch knobs) ----
-    use_pallas: bool = False       # fused Pallas conflict kernel on TPU
-    #                                (auto-falls back off-TPU / odd shapes;
-    #                                 measured ~par with XLA's own fusion on
-    #                                 v5e — kept as the tuning surface)
     epoch_batch: int = 2048        # txns validated per epoch (Calvin SEQ_BATCH analogue)
     conflict_buckets: int = 8192   # hashed key-bucket width of incidence matrices
     conflict_exact: bool = True    # dual-hash AND to squeeze out false conflicts
@@ -368,6 +374,12 @@ class Config:
             _check(self.device_parts == 1,
                    "tpcc_order_index does not compose with multi-chip "
                    "execution yet")
+            _check(self.node_cnt == 1,
+                   "tpcc_order_index is single-node only: the cluster "
+                   "server path maintains ORDER_IDX but has no "
+                   "overflow surfacing (the index's contract requires "
+                   "the host to check DynamicSortedIndex.overflowed(); "
+                   "only engine/driver.run_simulation does)")
             _check(self.num_wh * 10 < 1024
                    and self.insert_table_cap + 3001 < (1 << 21),
                    "order_index_key packs district * 2^21 + o_id into "
@@ -384,6 +396,9 @@ class Config:
                f"bad deploy {self.deploy!r}")
         _check(self.pipeline_epochs >= 1 and self.pipeline_groups >= 1,
                "pipeline_epochs/pipeline_groups must be >= 1")
+        _check(self.send_thread_cnt >= 1 and self.rem_thread_cnt >= 1
+               and self.thread_cnt >= 1,
+               "send/rem/worker thread counts must be >= 1")
         _check(self.client_batch_size >= 64,
                "client_batch_size must be >= 64 (the client skips sends "
                "smaller than one minimal message, client.py)")

@@ -30,7 +30,7 @@ def _counters(state: EngineState) -> dict:
     return {k: np.asarray(v) for k, v in host.items()}
 
 
-def _sync(state: EngineState) -> tuple[int, int, np.ndarray]:
+def _sync(state: EngineState) -> tuple[int, int, np.ndarray, bool]:
     """Real device->host transfer as the pacing barrier.
 
     `jax.block_until_ready` on a donated scan output can return before
@@ -41,15 +41,21 @@ def _sync(state: EngineState) -> tuple[int, int, np.ndarray]:
     cannot complete early, so it both paces the loop and surfaces any
     execution error at the call site.
 
-    Returns (commit_cnt, next_seq, latency_hist) from ONE transfer: a
-    tunnel round trip costs tens of ms, so the seq-wrap guard AND the
-    per-chunk latency snapshot (the wall-clock calibration data, ~512 B)
-    must ride the pacing fetch rather than pay their own (a second
-    round trip per ~1 s chunk measured ~15 % off the headline)."""
-    c, s, h = jax.device_get((state.stats["total_txn_commit_cnt"],
-                              state.pool.next_seq,
-                              state.stats["latency_hist"]))
-    return int(c), int(s), np.asarray(h)
+    Returns (commit_cnt, next_seq, latency_hist, index_overflowed) from
+    ONE transfer: a tunnel round trip costs tens of ms, so the seq-wrap
+    guard, the per-chunk latency snapshot (the wall-clock calibration
+    data, ~512 B) AND the capacity-bounded-index overflow bit must ride
+    the pacing fetch rather than pay their own (a second round trip per
+    ~1 s chunk measured ~15 % off the headline)."""
+    ovf = [t.overflowed()
+           for t in (state.db.values() if isinstance(state.db, dict) else ())
+           if hasattr(t, "overflowed")]
+    c, s, h, o = jax.device_get((state.stats["total_txn_commit_cnt"],
+                                 state.pool.next_seq,
+                                 state.stats["latency_hist"],
+                                 ovf))
+    return int(c), int(s), np.asarray(h), any(bool(np.asarray(x))
+                                              for x in o)
 
 
 def run_simulation(cfg: Config, chunk: int = 50,
@@ -112,9 +118,10 @@ def run_simulation(cfg: Config, chunk: int = 50,
 
     def _after_chunk(state):
         """Shared per-chunk bookkeeping: pacing sync + wrap guard +
-        progress + checkpoint cadence."""
-        _, head, hist = _sync(state)
+        overflow fail-fast + progress + checkpoint cadence."""
+        _, head, hist, ovf = _sync(state)
         _guard_seq(head)
+        _guard_overflow(ovf)
         now = time.monotonic()
         chunk_log.append((chunk, now - last_t[0], hist))
         epochs_total[0] += chunk
@@ -146,6 +153,18 @@ def run_simulation(cfg: Config, chunk: int = 50,
             state = run_n(state, chunk)     # one compile at the new n
             _after_chunk(state)
         return state
+
+    def _guard_overflow(ovf: bool):
+        # fail-fast surfacing for capacity-bounded index structures
+        # (DynamicSortedIndex contract): past overflow, probes may return
+        # slots of ring-overwritten rows — refuse at the FIRST overflowed
+        # chunk instead of burning the whole window (ADVICE r4); the bit
+        # rides the existing pacing fetch so it costs no extra round trip
+        if ovf:
+            raise RuntimeError(
+                "a capacity-bounded index overflowed during the run "
+                "(stale lookups possible); raise its capacity "
+                "(insert_table_cap) or shorten the run")
 
     # pre-flight wrap check (a resumed checkpoint may sit near int32 seq
     # exhaustion, e.g. after an epoch_batch change): refuse before the
@@ -234,9 +253,9 @@ def run_simulation(cfg: Config, chunk: int = 50,
         if d.sum() > 0:
             st.arr(name).extend_weighted(np.arange(len(d)), d)
     st.set("abort_rate", float(aborts) / max(float(commits + aborts), 1.0))
-    # host-side overflow surfacing for capacity-bounded index structures
-    # (DynamicSortedIndex contract): past overflow, probes may return
-    # slots of ring-overwritten rows — refuse to report such a run
+    # named backstop for the per-chunk _guard_overflow fail-fast (also
+    # covers overflow in the final partial chunk): past overflow, probes
+    # may return slots of ring-overwritten rows — refuse to report
     for name, t in (state.db.items() if isinstance(state.db, dict) else ()):
         if hasattr(t, "overflowed") and bool(
                 np.asarray(jax.device_get(t.overflowed()))):

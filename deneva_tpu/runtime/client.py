@@ -47,7 +47,9 @@ class ClientNode:
                       + cfg.replica_cnt * cfg.node_cnt)
         self.wl = get_workload(cfg)
         self.tp = NativeTransport(self.me, endpoints, self.n_all,
-                                  msg_size_max=cfg.msg_size_max)
+                                  msg_size_max=cfg.msg_size_max,
+                                  send_threads=cfg.send_thread_cnt,
+                                  recv_threads=cfg.rem_thread_cnt)
         self.tp.start()
         if cfg.net_delay_us:
             self.tp.set_delay_us(int(cfg.net_delay_us))

@@ -66,6 +66,9 @@ def _load() -> C.CDLL:
                                       C.c_uint32, C.c_uint32]
             lib.dt_start.restype = C.c_int
             lib.dt_start.argtypes = [C.c_void_p, C.c_int]
+            lib.dt_set_io_threads.restype = C.c_int
+            lib.dt_set_io_threads.argtypes = [C.c_void_p, C.c_uint32,
+                                              C.c_uint32]
             lib.dt_send.restype = C.c_int
             lib.dt_send.argtypes = [C.c_void_p, C.c_uint32, C.c_uint16,
                                     C.c_void_p, C.c_uint32]
@@ -114,12 +117,18 @@ class NativeTransport:
     `transport/transport.cpp:171`)."""
 
     def __init__(self, node_id: int, endpoints: str, n_nodes: int,
-                 msg_size_max: int = 4096, flush_timeout_us: int = 200):
+                 msg_size_max: int = 4096, flush_timeout_us: int = 200,
+                 send_threads: int = 1, recv_threads: int = 1):
         self._lib = _load()
         self._h = self._lib.dt_create(node_id, endpoints.encode(), n_nodes,
                                       msg_size_max, flush_timeout_us)
         if not self._h:
             raise RuntimeError("dt_create failed (bad endpoint table?)")
+        if send_threads > 1 or recv_threads > 1:
+            # reference SEND_THREAD_CNT / REM_THREAD_CNT axes
+            if self._lib.dt_set_io_threads(self._h, send_threads,
+                                           recv_threads) != 0:
+                raise RuntimeError("dt_set_io_threads must precede start")
         self.node_id = node_id
         self.n_nodes = n_nodes
         self._recv_buf = np.empty(1 << 20, np.uint8)

@@ -35,7 +35,9 @@ class ReplicaNode:
         n_repl = cfg.replica_cnt * cfg.node_cnt
         self.n_all = self.n_srv + self.n_cl + n_repl
         self.tp = NativeTransport(self.me, endpoints, self.n_all,
-                                  msg_size_max=cfg.msg_size_max)
+                                  msg_size_max=cfg.msg_size_max,
+                                  send_threads=cfg.send_thread_cnt,
+                                  recv_threads=cfg.rem_thread_cnt)
         self.tp.start()
         if cfg.net_delay_us:
             self.tp.set_delay_us(int(cfg.net_delay_us))

@@ -105,6 +105,13 @@ void dt_stats(const dt_transport *t, uint64_t *out);
  * (failure detection — the reference has none, SURVEY §5.3). */
 int dt_peer_alive(const dt_transport *t, uint32_t peer);
 
+/* IO-thread axes (reference SEND_THREAD_CNT / REM_THREAD_CNT,
+ * system/main.cpp:196-310): destinations shard over n_send sender
+ * threads (dest % n_send; per-destination FIFO preserved) and peers
+ * shard over n_recv receiver threads (src % n_recv).  Call BEFORE
+ * dt_start; returns -1 after start.  0 means 1. */
+int dt_set_io_threads(dt_transport *t, uint32_t n_send, uint32_t n_recv);
+
 /* Ping-pong round trips against peer; returns mean round-trip ns, or -1.
  * (reference NETWORK_TEST, system/main.cpp:346-387) */
 long dt_ping(dt_transport *t, uint32_t peer, uint32_t rounds,

@@ -94,28 +94,38 @@ struct dt_transport {
   std::vector<std::atomic<bool>> peer_dead;
   int listen_fd = -1;
 
-  // flush protocol: dt_flush bumps flush_req; the sender drains send_q
-  // and empties every mbuf before catching flush_done up to flush_req.
-  std::atomic<uint64_t> flush_req{0};
-  std::atomic<uint64_t> flush_done{0};
-
   // bounded (SURVEY §2.6: the reference's queues are bounded rings);
-  // full send_q blocks dt_send, full recv_q pauses the reader -> TCP
-  // backpressure reaches the remote sender.
-  deneva::MpmcQueue<OutFrame> send_q{1 << 16};
+  // a full shard queue blocks dt_send, full recv_q pauses the reader ->
+  // TCP backpressure reaches the remote sender.
   deneva::MpmcQueue<RecvMsg> recv_q{1 << 16};
 
-  std::thread sender, receiver;
-  std::atomic<bool> stop{false};
-  std::atomic<uint64_t> delay_us{0};
-  std::atomic<uint64_t> stats[DT_STAT_COUNT]{};
-
-  // per-dest batch accumulation (sender thread only)
+  // per-dest batch accumulation (owned by one sender shard)
   struct Mbuf {
     std::vector<uint8_t> buf;
     uint64_t first_us = 0;
   };
-  std::vector<Mbuf> mbufs;
+
+  // IO-thread axes (reference SEND_THREAD_CNT / REM_THREAD_CNT,
+  // transport/transport.cpp:171-221 one socket pair per (peer,
+  // send-thread)): destinations shard over n_send sender threads
+  // (dest % n_send -> per-dest FIFO preserved, which the runtime's
+  // MEASURE/SHUTDOWN-before-blob ordering relies on) and peers shard
+  // over n_recv receiver threads (src % n_recv).  Each sender shard
+  // owns its queue, its mbufs and its flush ticket pair; dt_flush
+  // tickets every shard.  Set via dt_set_io_threads BEFORE dt_start.
+  struct IoShard {
+    deneva::MpmcQueue<OutFrame> q{1 << 16};
+    std::atomic<uint64_t> flush_req{0};
+    std::atomic<uint64_t> flush_done{0};
+    std::vector<Mbuf> mbufs;
+  };
+  uint32_t n_send = 1, n_recv = 1;
+  std::vector<std::unique_ptr<IoShard>> shards;
+  std::vector<std::thread> senders, receivers;
+
+  std::atomic<bool> stop{false};
+  std::atomic<uint64_t> delay_us{0};
+  std::atomic<uint64_t> stats[DT_STAT_COUNT]{};
 
   // ping bookkeeping: receiver thread answers pings itself and routes
   // pongs here instead of the application queue
@@ -123,11 +133,13 @@ struct dt_transport {
 
   ~dt_transport() {
     stop.store(true);
-    send_q.stop();
+    for (auto &sh : shards) sh->q.stop();
     recv_q.stop();
     pong_q.stop();
-    if (sender.joinable()) sender.join();
-    if (receiver.joinable()) receiver.join();
+    for (auto &th : senders)
+      if (th.joinable()) th.join();
+    for (auto &th : receivers)
+      if (th.joinable()) th.join();
     for (int fd : peer_fd)
       if (fd >= 0) ::close(fd);
     if (listen_fd >= 0) ::close(listen_fd);
@@ -256,8 +268,8 @@ struct dt_transport {
 
   // ---- sender --------------------------------------------------------
 
-  void flush_dest(uint32_t dest) {
-    Mbuf &mb = mbufs[dest];
+  void flush_dest(IoShard &sh, uint32_t dest) {
+    Mbuf &mb = sh.mbufs[dest];
     if (mb.buf.empty()) return;
     int fd = peer_fd[dest];
     if (fd >= 0 && !peer_dead[dest].load(std::memory_order_relaxed)) {
@@ -274,80 +286,81 @@ struct dt_transport {
     mb.first_us = 0;
   }
 
-  void sender_loop() {
+  void sender_loop(IoShard &sh) {
     std::vector<OutFrame> delayed;
     while (!stop.load()) {
       OutFrame f;
       // wait at most the flush timeout so timed flushes happen
       long wait = static_cast<long>(
           flush_timeout_us ? flush_timeout_us : 100);
-      if (!delayed.empty() || flush_req.load() != flush_done.load())
+      if (!delayed.empty() || sh.flush_req.load() != sh.flush_done.load())
         wait = 100;  // stay responsive while frames are parked
-      bool got = send_q.pop(&f, wait);
+      bool got = sh.q.pop(&f, wait);
       uint64_t now = now_us();
       if (got) {
-        accept(std::move(f), now, delayed);
+        accept(sh, std::move(f), now, delayed);
         // drain the whole queue per wake: one blocking pop then
         // non-blocking pops until empty (batching amortizes syscalls)
         OutFrame g;
-        while (send_q.pop(&g, 0)) accept(std::move(g), now, delayed);
+        while (sh.q.pop(&g, 0)) accept(sh, std::move(g), now, delayed);
       }
       // release matured delayed frames
       for (size_t i = 0; i < delayed.size();) {
         if (delayed[i].ready_us <= now) {
-          append(std::move(delayed[i]), now);
+          append(sh, std::move(delayed[i]), now);
           delayed.erase(delayed.begin() + static_cast<long>(i));
         } else {
           ++i;
         }
       }
       // flush full/timed-out buffers; when idle (or told to) flush all
-      uint64_t freq = flush_req.load(std::memory_order_acquire);
-      bool force = freq != flush_done.load(std::memory_order_relaxed);
+      uint64_t freq = sh.flush_req.load(std::memory_order_acquire);
+      bool force = freq != sh.flush_done.load(std::memory_order_relaxed);
       if (force) {
         // flush contract: everything enqueued before dt_flush must hit
         // the wire before the ticket is acked — drain the queue again in
         // case frames raced in after the drain above
         OutFrame g;
-        while (send_q.pop(&g, 0)) accept(std::move(g), now, delayed);
+        while (sh.q.pop(&g, 0)) accept(sh, std::move(g), now, delayed);
       }
       for (uint32_t d = 0; d < n_nodes; ++d) {
-        Mbuf &mb = mbufs[d];
+        Mbuf &mb = sh.mbufs[d];
         if (mb.buf.empty()) continue;
         bool full = mb.buf.size() >= msg_size_max;
         bool timed = flush_timeout_us == 0 ||
                      now - mb.first_us >= flush_timeout_us;
         bool idle = !got && delayed.empty();
-        if (full || timed || idle || force) flush_dest(d);
+        if (full || timed || idle || force) flush_dest(sh, d);
       }
-      if (force) flush_done.store(freq, std::memory_order_release);
+      if (force) sh.flush_done.store(freq, std::memory_order_release);
     }
     // drain on shutdown: queued frames AND parked delayed frames
     OutFrame f;
-    while (send_q.pop(&f, 0)) append(std::move(f), now_us());
-    for (auto &df : delayed) append(std::move(df), now_us());
-    for (uint32_t d = 0; d < n_nodes; ++d) flush_dest(d);
+    while (sh.q.pop(&f, 0)) append(sh, std::move(f), now_us());
+    for (auto &df : delayed) append(sh, std::move(df), now_us());
+    for (uint32_t d = 0; d < n_nodes; ++d) flush_dest(sh, d);
   }
 
-  void accept(OutFrame f, uint64_t now, std::vector<OutFrame> &delayed) {
+  void accept(IoShard &sh, OutFrame f, uint64_t now,
+              std::vector<OutFrame> &delayed) {
     if (f.ready_us > now) {
       delayed.push_back(std::move(f));
     } else {
-      append(std::move(f), now);
+      append(sh, std::move(f), now);
     }
   }
 
-  void append(OutFrame f, uint64_t now) {
-    Mbuf &mb = mbufs[f.dest];
+  void append(IoShard &sh, OutFrame f, uint64_t now) {
+    Mbuf &mb = sh.mbufs[f.dest];
     if (mb.buf.empty()) mb.first_us = now;
     mb.buf.insert(mb.buf.end(), f.bytes.begin(), f.bytes.end());
     bump(DT_STAT_MSG_SENT);
-    if (mb.buf.size() >= msg_size_max) flush_dest(f.dest);
+    if (mb.buf.size() >= msg_size_max) flush_dest(sh, f.dest);
   }
 
   // ---- receiver ------------------------------------------------------
 
-  void receiver_loop() {
+  void receiver_loop(uint32_t shard) {
     std::vector<std::vector<uint8_t>> streams(n_nodes);
     std::vector<pollfd> pfds;
     std::vector<uint32_t> ids;
@@ -355,7 +368,7 @@ struct dt_transport {
       pfds.clear();
       ids.clear();
       for (uint32_t p = 0; p < n_nodes; ++p) {
-        if (peer_fd[p] >= 0 &&
+        if (p % n_recv == shard && peer_fd[p] >= 0 &&
             !peer_dead[p].load(std::memory_order_relaxed)) {
           pfds.push_back({peer_fd[p], POLLIN, 0});
           ids.push_back(p);
@@ -437,7 +450,7 @@ struct dt_transport {
     f.bytes.resize(sizeof(h) + len);
     std::memcpy(f.bytes.data(), &h, sizeof(h));
     if (len) std::memcpy(f.bytes.data() + sizeof(h), payload, len);
-    send_q.push(std::move(f));
+    shards[dest % n_send]->q.push(std::move(f));
     return 0;
   }
 };
@@ -458,7 +471,6 @@ dt_transport *dt_create(uint32_t node_id, const char *endpoints,
   t->eps.resize(n_nodes);
   t->peer_fd.assign(n_nodes, -1);
   t->peer_dead = std::vector<std::atomic<bool>>(n_nodes);
-  t->mbufs.resize(n_nodes);
 
   std::string text(endpoints);
   size_t pos = 0;
@@ -485,6 +497,8 @@ dt_transport *dt_create(uint32_t node_id, const char *endpoints,
     delete t;
     return nullptr;
   }
+  t->shards.emplace_back(new dt_transport::IoShard());
+  t->shards.back()->mbufs.resize(n_nodes);
   return t;
 }
 
@@ -507,8 +521,32 @@ int dt_start(dt_transport *t, int timeout_ms) {
     for (uint32_t p = 0; p < t->n_nodes; ++p)
       if (p != t->node_id && t->peer_fd[p] < 0) return -1;
   }
-  t->sender = std::thread([t] { t->sender_loop(); });
-  t->receiver = std::thread([t] { t->receiver_loop(); });
+  for (uint32_t k = 0; k < t->n_send; ++k) {
+    dt_transport::IoShard *sh = t->shards[k].get();
+    t->senders.emplace_back([t, sh] { t->sender_loop(*sh); });
+  }
+  for (uint32_t k = 0; k < t->n_recv; ++k)
+    t->receivers.emplace_back([t, k] { t->receiver_loop(k); });
+  return 0;
+}
+
+int dt_set_io_threads(dt_transport *t, uint32_t n_send, uint32_t n_recv) {
+  if (!t || !t->senders.empty()) return -1;  /* must precede dt_start */
+  t->n_send = n_send ? n_send : 1;
+  t->n_recv = n_recv ? n_recv : 1;
+  /* rebuild the shard set at the new width, rerouting any frames queued
+   * before the resize (sends are legal from construction on) */
+  std::vector<std::unique_ptr<dt_transport::IoShard>> old;
+  old.swap(t->shards);
+  for (uint32_t k = 0; k < t->n_send; ++k) {
+    t->shards.emplace_back(new dt_transport::IoShard());
+    t->shards.back()->mbufs.resize(t->n_nodes);
+  }
+  for (auto &sh : old) {
+    OutFrame f;
+    while (sh->q.pop(&f, 0))
+      t->shards[f.dest % t->n_send]->q.push(std::move(f));
+  }
   return 0;
 }
 
@@ -547,12 +585,18 @@ long dt_recv(dt_transport *t, uint8_t *buf, uint32_t cap, uint32_t *src,
 }
 
 void dt_flush(dt_transport *t) {
-  if (!t || !t->sender.joinable()) return;
-  uint64_t ticket = t->flush_req.fetch_add(1, std::memory_order_acq_rel) + 1;
+  if (!t || t->senders.empty()) return;
   uint64_t deadline = now_us() + 1'000'000;  // 1s bound
-  while (t->flush_done.load(std::memory_order_acquire) < ticket &&
-         !t->stop.load() && now_us() < deadline) {
-    std::this_thread::sleep_for(std::chrono::microseconds(50));
+  std::vector<uint64_t> tickets(t->shards.size());
+  for (size_t k = 0; k < t->shards.size(); ++k)
+    tickets[k] =
+        t->shards[k]->flush_req.fetch_add(1, std::memory_order_acq_rel) + 1;
+  for (size_t k = 0; k < t->shards.size(); ++k) {
+    while (t->shards[k]->flush_done.load(std::memory_order_acquire) <
+               tickets[k] &&
+           !t->stop.load() && now_us() < deadline) {
+      std::this_thread::sleep_for(std::chrono::microseconds(50));
+    }
   }
 }
 
@@ -573,7 +617,9 @@ void dt_stats(const dt_transport *t, uint64_t *out) {
   if (!t || !out) return;
   for (int i = 0; i < DT_STAT_COUNT; ++i)
     out[i] = t->stats[i].load(std::memory_order_relaxed);
-  out[DT_STAT_SEND_QUEUE_DEPTH] = t->send_q.size();
+  uint64_t sq = 0;
+  for (const auto &sh : t->shards) sq += sh->q.size();
+  out[DT_STAT_SEND_QUEUE_DEPTH] = sq;
   out[DT_STAT_RECV_QUEUE_DEPTH] = t->recv_q.size();
 }
 

@@ -234,3 +234,42 @@ def test_vote_wire_roundtrip():
             wire.encode_vote(118, commit, abort, bounds))
         assert epoch == 118 and (c == commit).all() and (a == abort).all()
         assert bnd is not None and (bnd == bounds).all()
+
+
+def test_sharded_io_threads_full_mesh(lib):
+    """Round-5 IO-thread axes (reference SEND_THREAD_CNT/REM_THREAD_CNT):
+    a 3-node mesh with 2 sender + 2 receiver shards per node must
+    preserve per-(src, dst) FIFO and deliver every frame, including
+    under flush and a burst that spans both sender shards."""
+    eps = ipc_endpoints(3, uuid.uuid4().hex[:8])
+    nodes = [NativeTransport(i, eps, 3, send_threads=2, recv_threads=2)
+             for i in range(3)]
+    threads = [threading.Thread(target=t.start) for t in nodes]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    try:
+        n_msgs = 200
+        for src in (0, 1, 2):
+            for dst in (0, 1, 2):
+                if src == dst:
+                    continue
+                for k in range(n_msgs):
+                    nodes[src].send(dst, "EPOCH_BLOB",
+                                    f"{src}->{dst}#{k}".encode())
+            nodes[src].flush()
+        for dst in (0, 1, 2):
+            seen = {src: 0 for src in (0, 1, 2) if src != dst}
+            for _ in range(n_msgs * 2):
+                got = nodes[dst].recv(timeout_us=2_000_000)
+                assert got is not None, f"node {dst} starved at {seen}"
+                src, rtype, payload = got
+                assert rtype == "EPOCH_BLOB"
+                want = f"{src}->{dst}#{seen[src]}".encode()
+                assert payload == want, (payload, want)  # per-link FIFO
+                seen[src] += 1
+            assert all(v == n_msgs for v in seen.values())
+    finally:
+        for t in nodes:
+            t.close()

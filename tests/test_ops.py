@@ -273,69 +273,6 @@ def test_last_earlier_writer_matches_serial_reference():
     assert (got[valid] == exp[valid]).all()
 
 
-def test_overlap_fused_falls_back_and_matches():
-    """Off-TPU the fused kernel must fall back to the XLA path and match;
-    shape-ineligible inputs must also fall back rather than fail."""
-    from deneva_tpu.ops.conflict import overlap
-    from deneva_tpu.ops.pallas_kernels import overlap_fused
-    rng = np.random.default_rng(3)
-    for b, k in ((256, 1024), (48, 100)):   # tiling ok / tiling impossible
-        a1 = jnp.asarray(rng.random((b, k)) < 0.01, jnp.bfloat16)
-        b1 = jnp.asarray(rng.random((b, k)) < 0.01, jnp.bfloat16)
-        a2 = jnp.asarray(rng.random((b, k)) < 0.01, jnp.bfloat16)
-        b2 = jnp.asarray(rng.random((b, k)) < 0.01, jnp.bfloat16)
-        ref = np.asarray(overlap(a1, b1, a2, b2))
-        got = np.asarray(overlap_fused(a1, b1, a2, b2))
-        assert (ref == got).all()
-        assert (np.asarray(overlap_fused(a1, b1))
-                == np.asarray(overlap(a1, b1))).all()
-
-
-@pytest.mark.slow
-def test_engine_use_pallas_flag_runs():
-    """Drive the Pallas kernel through the full engine: tile-eligible
-    shapes (B=128, K=512) with the interpreter forced on so the kernel
-    body actually executes off-TPU."""
-    import deneva_tpu.ops.pallas_kernels as pk
-    from deneva_tpu.config import Config
-    from deneva_tpu.engine import Engine
-    from deneva_tpu.workloads import get_workload
-    cfg = Config(cc_alg="OCC", epoch_batch=128, conflict_buckets=512,
-                 max_accesses=4, req_per_query=4, synth_table_size=1024,
-                 max_txn_in_flight=256, use_pallas=True)
-    old = pk._INTERPRET
-    pk._INTERPRET = True
-    try:
-        eng = Engine(cfg, get_workload(cfg))
-        st = eng.jit_run(eng.init_state(), 5)
-        assert int(jax.device_get(st.stats["total_txn_commit_cnt"])) > 0
-    finally:
-        pk._INTERPRET = old
-
-
-def test_overlap_pallas_kernel_body_interpret():
-    """Execute the actual Pallas kernel body (interpret mode) against the
-    XLA reference — catches tile indexing / epilogue bugs off-TPU."""
-    import deneva_tpu.ops.pallas_kernels as pk
-    from deneva_tpu.ops.conflict import overlap
-    old = pk._INTERPRET
-    pk._INTERPRET = True
-    try:
-        rng = np.random.default_rng(9)
-        b, k = 128, 512            # one tile exactly + multi-tile below
-        for bb, kk in ((128, 512), (256, 1024)):
-            a1 = jnp.asarray(rng.random((bb, kk)) < 0.02, jnp.bfloat16)
-            b1 = jnp.asarray(rng.random((bb, kk)) < 0.02, jnp.bfloat16)
-            a2 = jnp.asarray(rng.random((bb, kk)) < 0.02, jnp.bfloat16)
-            b2 = jnp.asarray(rng.random((bb, kk)) < 0.02, jnp.bfloat16)
-            assert (np.asarray(pk.overlap_fused(a1, b1, a2, b2))
-                    == np.asarray(overlap(a1, b1, a2, b2))).all()
-            assert (np.asarray(pk.overlap_fused(a1, b1))
-                    == np.asarray(overlap(a1, b1))).all()
-    finally:
-        pk._INTERPRET = old
-
-
 def test_forward_execute_mono_scatter_matches_legacy():
     """The monotone pre-sorted scatter (mono=True, the hot-path default)
     must be bit-identical to the legacy trash-steered scatter on both

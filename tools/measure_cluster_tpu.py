@@ -51,6 +51,19 @@ def main() -> int:
              pipeline_groups=2, client_batch_size=8192),
         dict(cc_alg="CALVIN", epoch_batch=4096, max_txn_in_flight=65536,
              pipeline_epochs=8, pipeline_groups=2, client_batch_size=4096),
+        # round-5 latency/throughput frontier (VERDICT r4 next #5): the
+        # mid point — full pipeline depth at a bounded inflight window —
+        # completes the TIF x (C,K) table BASELINE quotes
+        dict(cc_alg="TPU_BATCH", epoch_batch=16384,
+             max_txn_in_flight=262144, client_batch_size=16384,
+             pipeline_epochs=32, pipeline_groups=2),
+        # round-5 host thread axes at the headline point (reference
+        # THREAD_CNT/SEND_THREAD_CNT/REM_THREAD_CNT): measured on the
+        # 1-core box for the cost-neutrality record
+        dict(cc_alg="TPU_BATCH", epoch_batch=16384,
+             max_txn_in_flight=2097152, client_batch_size=16384,
+             pipeline_epochs=32, pipeline_groups=2,
+             thread_cnt=2, send_thread_cnt=2, rem_thread_cnt=2),
     ]
     out_dir = os.path.join("results", "cluster_tpu")
     os.makedirs(out_dir, exist_ok=True)
