@@ -593,3 +593,27 @@ def test_mvcc_value_ring_boundary_depth():
     want = int(np.asarray(_field_fingerprint(np.int32(5),
                                              np.int32(10))))
     assert got == want, f"boundary-depth read got {got} != f(5,10)={want}"
+
+
+def test_timestamp_staleness_abort_after_queueing_age():
+    """The theta=0.7-cliff mechanism, scripted (BASELINE round-5 note): a
+    txn stamped at admission but validated epochs later aborts iff some
+    NEWER-ts txn committed its key meanwhile — the cross-epoch watermark
+    staleness term that lock backends don't have.  Epoch 1: writer W2
+    (ts 20) commits key 5.  Epoch 2: aged reader R (ts 15, stamped before
+    W2 but queued behind it) must watermark-abort its read of key 5,
+    while a fresh reader (ts 30) sails through; same for writers."""
+    be = get_backend("TIMESTAMP")
+    st = be.init_state(CFG)
+    v, st, _ = run("TIMESTAMP", [[(5, "w")]], ts=[20], state=st)
+    assert np.asarray(v.commit)[0]
+    # aged reader (15 < 20) + fresh reader (30 > 20), one epoch later
+    v, st, _ = run("TIMESTAMP", [[(5, "r")], [(5, "r")]],
+                   ts=[15, 30], state=st)
+    assert np.asarray(v.abort)[0], "aged reader must hit wts>ts"
+    assert np.asarray(v.commit)[1], "fresh reader unaffected"
+    # aged writer aborts on BOTH watermarks; fresh writer commits
+    v, st, _ = run("TIMESTAMP", [[(5, "w")], [(5, "w")]],
+                   ts=[18, 40], state=st)
+    assert np.asarray(v.abort)[0], "aged writer must hit wts>ts"
+    assert np.asarray(v.commit)[1]

@@ -46,6 +46,34 @@ def listing(exp: str, fields=("tput", "abort_rate")) -> str:
     return "\n".join(out) + "\n"
 
 
+def frontier(exp: str) -> str:
+    """Cluster latency/throughput frontier (VERDICT r4 next #5): per
+    point, the server tput next to the CLIENT-observed end-to-end p50 and
+    p99 (worst client).  Client summaries ride the '# node N (client)'
+    lines of each .out; the plain parser only surfaces the server's."""
+    import glob
+
+    from deneva_tpu.stats import parse_summary
+    out = [f"| point | tput | client p50 s | p99 s |",
+           "|---|---|---|---|"]
+    for path in sorted(glob.glob(f"results/{exp}/*.out")):
+        tput, p50, p99 = None, 0.0, 0.0
+        for line in open(path):
+            if "[summary]" not in line:
+                continue
+            f = parse_summary(line[line.index("[summary]") - 0:])
+            if line.startswith("#"):       # a client node
+                p50 = max(p50, f.get("client_client_latency_p50", 0.0))
+                p99 = max(p99, f.get("client_client_latency_p99", 0.0))
+            else:                          # the server
+                tput = f.get("tput")
+        if tput is None:
+            continue
+        stem = __import__("os").path.basename(path)[:-4]
+        out.append(f"| {stem} | {tput:,.0f} | {p50:.3f} | {p99:.3f} |")
+    return "\n".join(out) + "\n"
+
+
 def main() -> int:
     print("### ycsb_skew (tput, txn/s)\n")
     print(pivot("ycsb_skew", "zipf_theta"))
@@ -73,6 +101,9 @@ def main() -> int:
     print(pivot("cluster_scaling", "node_cnt"))
     print("\n### cluster_tpu (1 TPU server + CPU clients)\n")
     print(listing("cluster_tpu"))
+    print("\n### cluster_tpu latency/throughput frontier "
+          "(client-observed e2e)\n")
+    print(frontier("cluster_tpu"))
     return 0
 
 

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-5 full re-measurement campaign (VERDICT r4 next #8): every sweep
+# re-run against round-5 code — the RA-pass trims, the ts-only MVCC ring,
+# the sort-based last_writer, the Pallas deletion and the host thread
+# axes all change measured numbers, so no stale number may survive in
+# results/.  Sequential: single-client TPU tunnel, one host core.
+# --bench = full problem sizes, short windows (the rounds-2/3 tier).
+cd /root/repo
+set -x
+for exp in ycsb_skew tpcc_scaling ycsb_inflight isolation_levels \
+           escrow_ablation modes cluster_scaling network_sweep \
+           operating_points ycsb_hot ycsb_writes ycsb_scaling \
+           ycsb_partitions pps_scaling; do
+  timeout 5400 python -m deneva_tpu.harness.run "$exp" --bench \
+    || echo "FAILED: $exp"
+  echo "DONE: $exp"
+done
+timeout 1800 python tools/measure_cluster_tpu.py || echo "FAILED: cluster_tpu"
+echo CAMPAIGN_R5_DONE

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-5 campaign, reordered tail: critical + small sweeps first so a
+# hard time stop costs only the large ycsb variant sweeps (re-run last).
+cd /root/repo
+set -x
+for exp in tpcc_scaling ycsb_inflight isolation_levels escrow_ablation \
+           modes cluster_scaling network_sweep operating_points \
+           pps_scaling; do
+  timeout 7200 python -m deneva_tpu.harness.run "$exp" --bench \
+    || echo "FAILED: $exp"
+  echo "DONE: $exp"
+done
+timeout 1800 python tools/measure_cluster_tpu.py || echo "FAILED: cluster_tpu"
+echo CRITICAL_SWEEPS_DONE
+for exp in ycsb_writes ycsb_hot ycsb_scaling ycsb_partitions; do
+  timeout 7200 python -m deneva_tpu.harness.run "$exp" --bench \
+    || echo "FAILED: $exp"
+  echo "DONE: $exp"
+done
+echo CAMPAIGN_R5_TAIL_DONE

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Post-campaign: recapture the regression gate against round-5 results,
+# regenerate the BASELINE tables, and sanity-run the gate check.
+cd /root/repo
+set -x
+python tools/regression_gate.py capture || exit 1
+python tools/regression_gate.py check || exit 1
+python tools/insert_baseline_tables.py || exit 1
+echo POST_CAMPAIGN_R5_DONE
