@@ -284,6 +284,38 @@ class Config:
     #                                         merged batch with global state
     #                                         (round-1 behavior)
 
+    # ---- fault injection + failover (chaos harness; no reference
+    # analogue — SURVEY §5.3: a dead peer hangs the reference forever).
+    # All defaults OFF: with every knob at its default the runtime takes
+    # exactly the pre-chaos code paths. ----
+    fault_drop_prob: float = 0.0   # P(drop) per fault-eligible message
+    #                                (client<->server open-loop traffic;
+    #                                 see native.FAULT_RTYPE_MASK)
+    fault_dup_prob: float = 0.0    # P(duplicate) per eligible message
+    fault_delay_jitter_us: float = 0.0  # uniform [0, jitter) extra delay
+    fault_kill: str = ""           # "node:epoch" — server `node` calls
+    #                                _exit at the first group boundary
+    #                                >= `epoch` (crash, no teardown);
+    #                                requires logging (recovery replays).
+    #                                Killing node 0 (the coordinator) is
+    #                                best-effort: peers echo the
+    #                                measure/stop epochs on REJOIN, but
+    #                                a restart racing the warmup edge
+    #                                can still re-announce a later
+    #                                window — prefer killing node >= 1
+    fault_seed: int = 0            # fault-stream seed; mixed with the
+    #                                node id so each node draws its own
+    #                                deterministic splitmix64 stream
+    fault_resend_us: float = 250_000.0  # client resend timeout for
+    #                                unacked batches (fault mode only)
+    fault_recovery_timeout_s: float = 120.0  # how long peers wait for a
+    #                                dead server to rejoin before raising
+    #                                (fault mode only; otherwise the
+    #                                pre-chaos dead-peer raise fires)
+    recover: bool = False          # start this server in recovery mode:
+    #                                replay the command log, rejoin the
+    #                                mesh at the next group boundary
+
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
     checkpoint_path: str = ""      # "" = checkpointing off
@@ -295,6 +327,22 @@ class Config:
     debug_timeline: bool = False
 
     # ------------------------------------------------------------------
+    @property
+    def faults_enabled(self) -> bool:
+        """True iff any chaos knob is armed.  Every fault/failover code
+        path in client, server and launcher is gated on this, so the
+        default config runs byte-identical to the pre-chaos runtime."""
+        return (self.fault_drop_prob > 0 or self.fault_dup_prob > 0
+                or self.fault_delay_jitter_us > 0 or bool(self.fault_kill)
+                or self.recover)
+
+    def fault_kill_spec(self) -> tuple[int, int] | None:
+        """Parse fault_kill 'node:epoch' (None when unset)."""
+        if not self.fault_kill:
+            return None
+        node, epoch = self.fault_kill.split(":")
+        return int(node), int(epoch)
+
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw).validate()
 
@@ -420,6 +468,24 @@ class Config:
                    "forced-abort sentinel is a merged-mode debug oracle")
         _check(self.repl_type in ("AP", "AA"),
                f"bad repl_type {self.repl_type!r}")
+        _check(0.0 <= self.fault_drop_prob < 1.0
+               and 0.0 <= self.fault_dup_prob < 1.0,
+               "fault probabilities must be in [0, 1)")
+        _check(self.fault_delay_jitter_us >= 0,
+               "fault_delay_jitter_us must be >= 0")
+        if self.fault_kill:
+            parts = self.fault_kill.split(":")
+            _check(len(parts) == 2 and parts[0].lstrip("-").isdigit()
+                   and parts[1].lstrip("-").isdigit(),
+                   f"fault_kill must be 'node:epoch', got "
+                   f"{self.fault_kill!r}")
+            _check(0 <= int(parts[0]) < self.node_cnt,
+                   "fault_kill node must name a server node")
+            _check(int(parts[1]) >= 0, "fault_kill epoch must be >= 0")
+        if self.fault_kill or self.recover:
+            _check(self.logging,
+                   "fault_kill/recover need --logging: recovery rebuilds "
+                   "state by replaying the command log")
         if self.workload == WorkloadKind.PPS:
             mix = (self.perc_getparts + self.perc_getproducts + self.perc_getsuppliers
                    + self.perc_getpartbyproduct + self.perc_getpartbysupplier

@@ -15,6 +15,9 @@ Public surface:
   output file per point (`deneva_tpu.harness.run`), return parsed rows.
 * `parse` — `[summary]`-line parsing + result-table assembly
   (`deneva_tpu.harness.parse`).
+* `chaos` — fault-injection scenario runner with liveness/safety
+  invariants (`deneva_tpu.harness.chaos`; imported lazily — it boots
+  real clusters).
 """
 
 from deneva_tpu.harness.experiments import experiment_map, get_experiment  # noqa: F401

@@ -1,0 +1,240 @@
+"""Chaos scenario runner: compose transport fault specs into named
+scenarios and assert liveness + safety invariants over a real cluster.
+
+The reference has no failure story at all (SURVEY §5.3: a dead peer
+hangs its 1 s recv timeouts forever); this harness drives the fault
+subsystem end to end —
+
+* **lossy-net**    seeded CL_QRY_BATCH/CL_RSP drops; the client resend
+                   path plus server idempotent admission must converge
+                   (throughput degrades, nothing wedges or double-acks);
+* **dup-storm**    seeded duplication; the server's in-system dedup and
+                   the client's first-ack filter keep exactly-once
+                   accounting;
+* **jittery-net**  uniform extra delay on the open-loop traffic; the
+                   deterministic epoch exchange must be order-insensitive;
+* **kill-one-server**  fault_kill crashes a server at an epoch boundary
+                   (no teardown); the launcher restarts it in recovery
+                   mode, it replays its command log, rejoins the mesh,
+                   and the run COMPLETES — plus the replayed state is
+                   bit-identical to an independent replay of the same
+                   log prefix, and each replica log stays a byte prefix
+                   of its primary's.
+
+Every scenario runs from a fixed fault_seed, so failures reproduce.
+
+CLI:  python -m deneva_tpu.harness.chaos [scenario ...|all] [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from deneva_tpu.config import CCAlg, Config, WorkloadKind
+from deneva_tpu.stats import parse_summary
+
+
+def chaos_cfg(**kw) -> Config:
+    """Small, CI-sized 2-server + 1-client cluster config (the same
+    shape tests/test_runtime.py boots), chaos knobs layered on top."""
+    base = dict(
+        workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+        node_cnt=2, client_node_cnt=1,
+        epoch_batch=128, conflict_buckets=512, synth_table_size=4096,
+        max_txn_in_flight=1024, req_per_query=4, max_accesses=4,
+        zipf_theta=0.6, warmup_secs=0.5, done_secs=2.0,
+        fault_seed=1234)
+    base.update(kw)
+    return Config(**base)
+
+
+# scenario name -> config overrides (composable: overrides win)
+SCENARIOS: dict[str, dict] = {
+    "lossy-net": dict(fault_drop_prob=0.05, fault_resend_us=150_000.0),
+    "dup-storm": dict(fault_dup_prob=0.30),
+    "jittery-net": dict(fault_delay_jitter_us=20_000.0),
+    "kill-one-server": dict(
+        fault_kill="1:64", logging=True, replica_cnt=1, done_secs=4.0,
+        fault_recovery_timeout_s=300.0),
+}
+
+
+class ChaosViolation(AssertionError):
+    """A liveness or safety invariant failed under fault injection."""
+
+
+def _require(ok: bool, what: str) -> None:
+    if not ok:
+        raise ChaosViolation(what)
+
+
+def run_scenario(name: str, quick: bool = False,
+                 quiet: bool = False, **overrides) -> dict:
+    """Run one named scenario; returns a report dict (raises
+    ChaosViolation on an invariant failure, anything else on a crash
+    of the harness itself)."""
+    from deneva_tpu.runtime.launch import run_cluster
+
+    if name not in SCENARIOS:
+        raise KeyError(f"unknown scenario {name!r} "
+                       f"(have {sorted(SCENARIOS)})")
+    spec = dict(SCENARIOS[name])
+    if quick:
+        spec["done_secs"] = min(spec.get("done_secs", 2.0), 1.5)
+    spec.update(overrides)
+    cfg = chaos_cfg(**spec)
+    run_id = f"chaos_{name.replace('-', '_')}_{os.getpid()}"
+    t0 = time.monotonic()
+    out = run_cluster(cfg, platform="cpu", run_id=run_id)
+    wall = time.monotonic() - t0
+    report = {"scenario": name, "wall_secs": round(wall, 1),
+              "nodes": {nid: kind for nid, (kind, _) in out.items()}}
+    _check_invariants(name, cfg, out, run_id, report)
+    if not quiet:
+        print(f"[chaos] {name}: OK in {wall:.1f}s  "
+              + " ".join(f"{k}={v}" for k, v in report.items()
+                         if k not in ("scenario", "nodes")), flush=True)
+    return report
+
+
+def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
+                      report: dict) -> None:
+    n_srv, n_cl = cfg.node_cnt, cfg.client_node_cnt
+    n_all = n_srv + n_cl + cfg.replica_cnt * n_srv
+    # liveness: every node reported a summary (run_cluster raises on a
+    # node error; a wedged node would have tripped its timeout)
+    _require(set(out) == set(range(n_all)),
+             f"{name}: nodes {sorted(set(range(n_all)) - set(out))} "
+             "never reported")
+    srv = [parse_summary(out[s][1]) for s in range(n_srv)]
+    cls = [parse_summary(out[n_srv + c][1]) for c in range(n_cl)]
+    commits = [s["total_txn_commit_cnt"] for s in srv]
+    report["commits"] = commits
+    report["client_acked"] = [c["txn_cnt"] for c in cls]
+    report["resends"] = [c.get("resend_cnt", 0.0) for c in cls]
+    report["dup_acks"] = [c.get("dup_ack_cnt", 0.0) for c in cls]
+    for c in cls:
+        # exactly-once accounting: unique acks can never exceed unique
+        # sends (txn_cnt counts first acks only; resends don't add to
+        # sent_cnt) — a double-commit or double-count breaks this
+        _require(c["txn_cnt"] > 0, f"{name}: a client was starved")
+        _require(c["txn_cnt"] <= c["sent_cnt"],
+                 f"{name}: more unique acks ({c['txn_cnt']}) than unique "
+                 f"sends ({c['sent_cnt']}) — a tag was acked twice")
+    if name != "kill-one-server":
+        # deterministic replicated validation must survive the faults:
+        # identical [summary] commit counts on every server
+        _require(len(set(commits)) == 1 and commits[0] > 0,
+                 f"{name}: server commit counts diverged: {commits}")
+    if name == "lossy-net":
+        _require(sum(report["resends"]) > 0,
+                 "lossy-net: drops injected but the resend path never "
+                 "fired (is fault injection live?)")
+    if name == "dup-storm":
+        dup_seen = (sum(report["dup_acks"])
+                    + sum(s.get("dup_admit_cnt", 0.0) for s in srv)
+                    + sum(s.get("net_msg_dup", 0.0) for s in srv)
+                    + sum(c.get("net_msg_dup", 0.0) for c in cls))
+        _require(dup_seen > 0, "dup-storm: no duplicate was ever seen")
+    if name == "kill-one-server":
+        _check_recovery(cfg, out, run_id, report)
+
+
+def _check_recovery(cfg: Config, out: dict, run_id: str,
+                    report: dict) -> None:
+    """Safety of the failover path: the killed server recovered by log
+    replay (bit-for-bit vs an independent replay of the same prefix),
+    its log is epoch-contiguous across the crash, and each replica log
+    is a byte prefix of its primary's."""
+    from deneva_tpu.runtime.logger import (
+        iter_record_spans, replay_into, state_digest)
+    from deneva_tpu.runtime.server import make_dist_step
+
+    kill_node, _ = cfg.fault_kill_spec()
+    log_dir = os.path.join(cfg.log_dir, run_id)
+    killed = parse_summary(out[kill_node][1])
+    _require(killed.get("recovered", 0.0) == 1.0,
+             "kill-one-server: the killed node's summary did not come "
+             "from a recovered process")
+    side_path = os.path.join(log_dir, f"node{kill_node}.recovery.json")
+    _require(os.path.exists(side_path),
+             "kill-one-server: recovery sidecar missing")
+    with open(side_path) as f:
+        side = json.load(f)
+    report["resume_epoch"] = side["resume_epoch"]
+    # independent replay of the SAME log prefix must reproduce the
+    # recovered node's state digest bit for bit
+    node_cfg = cfg.replace(node_id=kill_node, part_cnt=cfg.node_cnt,
+                           recover=False, fault_kill="")
+    from deneva_tpu.cc import get_backend
+    from deneva_tpu.engine.step import init_device_stats
+    from deneva_tpu.workloads import get_workload
+    wl = get_workload(node_cfg)
+    be = get_backend(node_cfg.cc_alg)
+    step = make_dist_step(node_cfg, wl, be)
+    stats0 = init_device_stats(
+        len(getattr(wl, "txn_type_names", ("txn",))))
+    log_path = os.path.join(log_dir, f"node{kill_node}.log.bin")
+    db, _, _, last = replay_into(
+        log_path, node_cfg, wl, step, wl.load(), be.init_state(node_cfg),
+        stats0, stop_epoch=side["resume_epoch"])
+    _require(last == side["resume_epoch"] - 1,
+             f"kill-one-server: log prefix ends at {last}, expected "
+             f"{side['resume_epoch'] - 1}")
+    digest = state_digest(db)
+    report["digest_match"] = digest == side["state_digest"]
+    _require(report["digest_match"],
+             "kill-one-server: replayed state diverged from the "
+             f"recovered node's ({digest[:16]} != "
+             f"{side['state_digest'][:16]})")
+    # log epoch contiguity across the crash (truncate-then-append must
+    # leave no gap and no duplicate)
+    for s in range(cfg.node_cnt):
+        with open(os.path.join(log_dir, f"node{s}.log.bin"), "rb") as f:
+            buf = f.read()
+        epochs = [e for e, _, _ in iter_record_spans(buf)]
+        _require(epochs == list(range(len(epochs))),
+                 f"kill-one-server: node {s} log epochs not contiguous "
+                 f"(len={len(epochs)}, tail={epochs[-5:]})")
+    # replica logs: byte prefix of the primary's (group commit +
+    # rejoin-resync keep them aligned modulo trailing in-flight records)
+    n_front = cfg.node_cnt + cfg.client_node_cnt
+    for s in range(cfg.node_cnt):
+        for k in range(cfg.replica_cnt):
+            rid = n_front + s + k * cfg.node_cnt
+            with open(os.path.join(log_dir, f"node{s}.log.bin"),
+                      "rb") as f:
+                p = f.read()
+            with open(os.path.join(log_dir, f"replica{rid}.log.bin"),
+                      "rb") as f:
+                r = f.read()
+            _require(len(p) > 0, f"kill-one-server: node {s} log empty")
+            _require(p.startswith(r) or r.startswith(p),
+                     f"kill-one-server: replica {rid} log diverged from "
+                     f"primary {s} (not a byte prefix)")
+    report["replica_prefix_ok"] = True
+
+
+def main(argv: list[str]) -> int:
+    quick = "--quick" in argv
+    names = [a for a in argv if not a.startswith("--")]
+    if not names or names == ["all"]:
+        names = list(SCENARIOS)
+    rc = 0
+    for name in names:
+        try:
+            run_scenario(name, quick=quick)
+        except ChaosViolation as e:
+            print(f"[chaos] {name}: VIOLATION: {e}", flush=True)
+            rc = 1
+        except Exception as e:  # noqa: BLE001 — harness-level failure
+            print(f"[chaos] {name}: ERROR: {e!r}", flush=True)
+            rc = 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -18,6 +18,7 @@ client-side `StatsArr`, `scripts/latency_stats.py:20`).
 from __future__ import annotations
 
 import time
+from collections import deque
 
 import numpy as np
 
@@ -53,6 +54,25 @@ class ClientNode:
         self.tp.start()
         if cfg.net_delay_us:
             self.tp.set_delay_us(int(cfg.net_delay_us))
+        # ---- fault mode (chaos harness): the open loop must DEGRADE
+        # under message loss, not wedge.  A lost CL_QRY_BATCH or CL_RSP
+        # is repaired by resending the still-unacked tags after
+        # fault_resend_us (the server's idempotent admission dedups and
+        # re-acks); duplicate acks are filtered against the unacked
+        # bitmap so the inflight throttle never drifts.  All of it is
+        # gated off on a default config. ----
+        self._fault_mode = cfg.faults_enabled
+        if (cfg.fault_drop_prob or cfg.fault_dup_prob
+                or cfg.fault_delay_jitter_us):
+            self.tp.set_fault(cfg.fault_drop_prob, cfg.fault_dup_prob,
+                              cfg.fault_delay_jitter_us,
+                              seed=cfg.fault_seed + 7919 * cfg.node_id)
+        self._unacked = (np.zeros(TAG_RING, bool) if self._fault_mode
+                         else None)
+        self._resend_q: deque[tuple[int, int, wire.QueryBlock]] = deque()
+        self._resend_us = int(cfg.fault_resend_us)
+        self._resend_cnt = 0
+        self._dup_acks = 0
         self.inflight = np.zeros(self.n_srv, np.int64)
         self.chunk = cfg.client_batch_size
         # reference: inflight cap is per server pair (client_txn.cpp:25);
@@ -113,6 +133,18 @@ class ClientNode:
         if rtype == "CL_RSP":
             tags = wire.decode_cl_rsp(payload)
             now = time.monotonic_ns() // 1000
+            if self._fault_mode:
+                # exactly-once accounting under dup/replay: accept each
+                # tag's FIRST ack only — a duplicated CL_RSP or a
+                # re-ack answering our own resend must not double-count
+                # txn_cnt or drive the inflight throttle negative
+                fresh = self._unacked[tags % TAG_RING]
+                if not fresh.all():
+                    self._dup_acks += int((~fresh).sum())
+                    tags = tags[fresh]
+                    if not len(tags):
+                        return
+                self._unacked[tags % TAG_RING] = False
             self.inflight[src] -= len(tags)       # src is a server id
             slot = tags % TAG_RING
             vals = (now - self.send_us[slot]) / 1e6     # seconds
@@ -147,6 +179,24 @@ class ClientNode:
         wire.run_barrier(self.tp, self.me, self.n_all,
                          lambda s, r, p: self._route(s, r, p, lat),
                          f"client {self.me}", timeout_s)
+
+    def _resend_sweep(self) -> None:
+        """Repair message loss: batches older than fault_resend_us with
+        tags still unacked are re-sent (same tags — the server's
+        idempotent admission drops in-flight dups and re-acks committed
+        ones); fully-acked batches just retire from the queue.  Latency
+        keeps measuring from the FIRST send (send_us is not reset), so
+        a repaired loss shows up as tail latency, not a clean sample."""
+        now = time.monotonic_ns() // 1000
+        while self._resend_q and now - self._resend_q[0][0] >= self._resend_us:
+            _, srv, blk = self._resend_q.popleft()
+            alive = self._unacked[blk.tags % TAG_RING]
+            if not alive.any():
+                continue
+            sub = blk if alive.all() else blk.take(np.where(alive)[0])
+            self.tp.send(srv, "CL_QRY_BATCH", wire.encode_qry_block(sub))
+            self._resend_cnt += len(sub)
+            self._resend_q.append((now, srv, sub))
 
     # ------------------------------------------------------------------
     def run(self) -> Stats:
@@ -186,9 +236,14 @@ class ClientNode:
                 self.tag_type[tags] = blk_types[:n]
                 out = wire.QueryBlock(blk.keys, blk.types, blk.scalars, tags)
                 self.tp.send(srv, "CL_QRY_BATCH", wire.encode_qry_block(out))
+                if self._fault_mode:
+                    self._unacked[tags] = True
+                    self._resend_q.append((now, srv, out))
                 self.inflight[srv] += n
                 sent_total += n
                 progressed = True
+            if self._fault_mode:
+                self._resend_sweep()
             self._drain(lat, timeout_us=0 if progressed else 2_000)
         # drain trailing responses so server-side commits are counted
         t_end = time.monotonic() + 0.3
@@ -205,7 +260,14 @@ class ClientNode:
                     combined.merge_from(a)
         st.set("total_runtime", time.monotonic() - t_start)
         st.set("sent_cnt", float(sent_total))
+        if self._fault_mode:
+            st.set("resend_cnt", float(self._resend_cnt))
+            st.set("dup_ack_cnt", float(self._dup_acks))
+            st.set("unacked_cnt", float(int(self._unacked.sum())))
         for k, v in self.tp.stats().items():
+            if not self._fault_mode and k in ("msg_dropped", "msg_dup",
+                                              "reconnects"):
+                continue   # keep the default-config summary line as-is
             st.set(f"net_{k}", float(v))
         return st
 

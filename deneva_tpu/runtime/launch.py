@@ -139,13 +139,44 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
             daemon=True))
     for p in procs:
         p.start()
+    # supervision (chaos mode): map each server's node id to its process
+    # so a crash can be detected and the node restarted in recovery mode
+    srv_proc: dict[int, mp.process.BaseProcess] = {
+        s: procs[s] for s in range(n_srv)}
+    supervise = cfg.faults_enabled and cfg.logging
+    restarted: set[int] = set()
     out: dict[int, tuple[str, str]] = {}
     try:
         import queue as _queue
-        for _ in procs:
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
+        while len(out) < n_all:    # one report per node id (a restarted
+            #                        server reports under its old id)
             try:
-                nid, kind, line = q.get(timeout=timeout_s)
+                nid, kind, line = q.get(timeout=1.0)
             except _queue.Empty:
+                if supervise:
+                    # a dead, unreported server with logging enabled is
+                    # recoverable: restart it once in recovery mode (it
+                    # replays its command log and rejoins the mesh) —
+                    # the failover the reference never had (SURVEY §5.3)
+                    for s, p in srv_proc.items():
+                        if (s not in out and s not in restarted
+                                and not p.is_alive()
+                                and p.exitcode not in (0, None)):
+                            restarted.add(s)
+                            rp = ctx.Process(
+                                target=_server_main,
+                                args=(cfg.replace(node_id=s,
+                                                  part_cnt=n_srv,
+                                                  recover=True),
+                                      endpoints, platform, q),
+                                daemon=True)
+                            rp.start()
+                            procs.append(rp)
+                            srv_proc[s] = rp
+                if _time.monotonic() < deadline:
+                    continue
                 dead = [i for i, p in enumerate(procs)
                         if not p.is_alive() and p.exitcode not in (0, None)]
                 raise RuntimeError(

@@ -44,16 +44,47 @@ def pack_record(epoch: int, blob: bytes, active: np.ndarray) -> bytes:
 def unpack_records(buf: bytes):
     """Yield (epoch, blob_bytes, active_bits) from a log byte stream;
     stops cleanly at a torn tail (crash mid-write)."""
+    for epoch, lo, hi in iter_record_spans(buf):
+        magic, _, blen, alen = _FRAME.unpack_from(buf, lo)
+        del magic
+        blob = buf[lo + _FRAME.size: lo + _FRAME.size + blen]
+        bits = np.frombuffer(buf, np.uint8, count=alen,
+                             offset=lo + _FRAME.size + blen)
+        yield epoch, blob, bits
+
+
+def iter_record_spans(buf: bytes):
+    """Yield (epoch, start_off, end_off) for every complete framed record
+    (the raw-byte view of unpack_records; recovery re-ships and truncates
+    by span).  Stops cleanly at a torn tail."""
     off = 0
     while off + _FRAME.size <= len(buf):
         magic, epoch, blen, alen = _FRAME.unpack_from(buf, off)
-        if magic != _MAGIC or off + _FRAME.size + blen + alen > len(buf):
+        end = off + _FRAME.size + blen + alen
+        if magic != _MAGIC or end > len(buf):
             return
-        blob = buf[off + _FRAME.size: off + _FRAME.size + blen]
-        bits = np.frombuffer(buf, np.uint8, count=alen,
-                             offset=off + _FRAME.size + blen)
-        yield epoch, blob, bits
-        off += _FRAME.size + blen + alen
+        yield epoch, off, end
+        off = end
+
+
+def truncate_log_to_epoch(path: str, resume_epoch: int) -> int:
+    """Physically truncate the log at ``path`` to records with
+    epoch < resume_epoch (recovery discards the partial tail group the
+    crash may have torn — group-commit acks gate on whole-group
+    durability in fault mode, so no acked txn is lost).  Any torn tail
+    bytes go with it.  Returns the last epoch kept (-1 if none)."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    keep_end = 0
+    last = -1
+    for epoch, _lo, hi in iter_record_spans(buf):
+        if epoch >= resume_epoch:
+            break
+        keep_end = hi
+        last = epoch
+    if keep_end != len(buf):
+        os.truncate(path, keep_end)
+    return last
 
 
 class EpochLogger:
@@ -64,15 +95,19 @@ class EpochLogger:
     but callers poll it per epoch instead of parking per txn.
     """
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, append: bool = False,
+                 flushed_epoch: int = -1):
+        """``append`` (recovery): keep the existing prefix and write
+        after it; ``flushed_epoch`` seeds the durability watermark with
+        the last epoch of that prefix."""
         self.path = path
         self._q: _queue.Queue = _queue.Queue()
-        self._flushed = -1
+        self._flushed = flushed_epoch
         self._cv = threading.Condition()
         self._stop = False
         self._error: BaseException | None = None
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self._f = open(path, "wb")
+        self._f = open(path, "ab" if append else "wb")
         self._thr = threading.Thread(target=self._run, daemon=True)
         self._thr.start()
         self.records = 0
@@ -139,31 +174,26 @@ class EpochLogger:
         self._f.close()
 
 
-def replay_log(path: str, cfg) -> dict:
-    """Rebuild table state by re-executing the logged command stream
-    (deterministic replay; the reference has no equivalent —
-    `system/logger.cpp` writes records it never reads back).
-
-    Returns the reconstructed ``db`` dict for this node's partition.
-    """
+def replay_into(path: str, cfg, wl, step, db, cc_state, stats,
+                stop_epoch: int | None = None, on_epoch=None
+                ) -> tuple[dict, object, dict, int]:
+    """Re-execute the logged command stream into EXISTING engine state
+    through the per-epoch jit ``step`` (``make_dist_step`` — kept
+    precisely for this path).  Stops before ``stop_epoch`` when given.
+    ``on_epoch(epoch, block, active, done)`` is called per replayed
+    record (recovery seeds its committed-tag dedup set from the done
+    masks).  Returns (db, cc_state, stats, last_replayed_epoch[-1])."""
     import jax
     import jax.numpy as jnp
 
-    from deneva_tpu.cc import get_backend
-    from deneva_tpu.engine.step import init_device_stats
     from deneva_tpu.runtime import wire
-    from deneva_tpu.runtime.server import make_dist_step
-    from deneva_tpu.workloads import get_workload
 
-    wl = get_workload(cfg)
-    be = get_backend(cfg.cc_alg)
-    step = make_dist_step(cfg, wl, be)
-    db = wl.load()
-    cc_state = be.init_state(cfg)
-    stats = init_device_stats()
     with open(path, "rb") as f:
         buf = f.read()
+    last = -1
     for epoch, blob, bits in unpack_records(buf):
+        if stop_epoch is not None and epoch >= stop_epoch:
+            break
         _, block, ts = wire.decode_epoch_blob(blob)
         active = np.unpackbits(bits)[: len(block.keys)].astype(bool)
         # logged ts length always equals the merged block length (the
@@ -173,10 +203,49 @@ def replay_log(path: str, cfg) -> dict:
                 f"corrupt log record at epoch {epoch}: {len(ts)} ts for "
                 f"{len(block.keys)} txns")
         query = wl.from_wire(block.keys, block.types, block.scalars)
-        db, cc_state, stats, *_ = step(db, cc_state, stats,
-                                       jnp.int32(epoch),
-                                       jnp.asarray(active),
-                                       jnp.asarray(ts.astype(np.int32)),
-                                       query)
+        db, cc_state, stats, done, *_ = step(db, cc_state, stats,
+                                             jnp.int32(epoch),
+                                             jnp.asarray(active),
+                                             jnp.asarray(ts.astype(np.int32)),
+                                             query)
+        if on_epoch is not None:
+            on_epoch(epoch, block, active, np.asarray(done))
+        last = epoch
     jax.block_until_ready(stats["total_txn_commit_cnt"])
+    return db, cc_state, stats, last
+
+
+def replay_log(path: str, cfg) -> dict:
+    """Rebuild table state by re-executing the logged command stream
+    (deterministic replay; the reference has no equivalent —
+    `system/logger.cpp` writes records it never reads back).
+
+    Returns the reconstructed ``db`` dict for this node's partition.
+    """
+    from deneva_tpu.cc import get_backend
+    from deneva_tpu.engine.step import init_device_stats
+    from deneva_tpu.runtime.server import make_dist_step
+    from deneva_tpu.workloads import get_workload
+
+    wl = get_workload(cfg)
+    be = get_backend(cfg.cc_alg)
+    step = make_dist_step(cfg, wl, be)
+    stats = init_device_stats(len(getattr(wl, "txn_type_names", ("txn",))))
+    db, *_ = replay_into(path, cfg, wl, step, wl.load(),
+                         be.init_state(cfg), stats)
     return db
+
+
+def state_digest(db) -> str:
+    """Order-stable sha256 over every pytree leaf of the engine state
+    (the bit-for-bit recovery check: a replayed partition must hash
+    identically to the state it reconstructs; pytree flattening order is
+    deterministic for a fixed structure)."""
+    import hashlib
+
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(db):
+        h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()

@@ -28,12 +28,22 @@ _lib: C.CDLL | None = None
 RTYPE = {
     "INIT_DONE": 1, "CL_QRY_BATCH": 2, "CL_RSP": 3, "RDONE": 4,
     "EPOCH_BLOB": 5, "LOG_MSG": 6, "LOG_RSP": 7, "PING": 8, "PONG": 9,
-    "SHUTDOWN": 10, "MEASURE": 11, "VOTE": 12, "VOTE2": 13,
+    "SHUTDOWN": 10, "MEASURE": 11, "VOTE": 12, "VOTE2": 13, "REJOIN": 14,
 }
 RTYPE_NAME = {v: k for k, v in RTYPE.items()}
 
 STAT_NAMES = ("msg_sent", "msg_rcvd", "bytes_sent", "bytes_rcvd",
-              "batches_sent", "send_queue_depth", "recv_queue_depth")
+              "batches_sent", "send_queue_depth", "recv_queue_depth",
+              "msg_dropped", "msg_dup", "reconnects")
+
+# Fault-eligible message classes (chaos harness): only the client<->server
+# open-loop traffic may be dropped/duplicated/jittered — it has an
+# end-to-end retry story (client resend + server idempotent admission).
+# The server<->server epoch exchange and log shipping are the commit
+# protocol itself; their fault mode is process death + recovery, not
+# silent message loss (dropping an EPOCH_BLOB models a dead link, which
+# IS the dead-peer/kill scenario).
+FAULT_RTYPE_MASK = (1 << RTYPE["CL_QRY_BATCH"]) | (1 << RTYPE["CL_RSP"])
 
 
 def ensure_built(force: bool = False) -> str:
@@ -79,6 +89,12 @@ def _load() -> C.CDLL:
                                     C.POINTER(C.c_uint32)]
             lib.dt_flush.argtypes = [C.c_void_p]
             lib.dt_set_delay_us.argtypes = [C.c_void_p, C.c_uint64]
+            lib.dt_set_fault.restype = C.c_int
+            lib.dt_set_fault.argtypes = [C.c_void_p, C.c_uint32,
+                                         C.c_uint32, C.c_uint64,
+                                         C.c_uint64, C.c_uint32]
+            lib.dt_set_rejoin.restype = C.c_int
+            lib.dt_set_rejoin.argtypes = [C.c_void_p, C.c_int]
             lib.dt_stats.argtypes = [C.c_void_p, C.POINTER(C.c_uint64)]
             lib.dt_peer_alive.restype = C.c_int
             lib.dt_peer_alive.argtypes = [C.c_void_p, C.c_uint32]
@@ -118,7 +134,8 @@ class NativeTransport:
 
     def __init__(self, node_id: int, endpoints: str, n_nodes: int,
                  msg_size_max: int = 4096, flush_timeout_us: int = 200,
-                 send_threads: int = 1, recv_threads: int = 1):
+                 send_threads: int = 1, recv_threads: int = 1,
+                 rejoin: bool = False):
         self._lib = _load()
         self._h = self._lib.dt_create(node_id, endpoints.encode(), n_nodes,
                                       msg_size_max, flush_timeout_us)
@@ -129,6 +146,11 @@ class NativeTransport:
             if self._lib.dt_set_io_threads(self._h, send_threads,
                                            recv_threads) != 0:
                 raise RuntimeError("dt_set_io_threads must precede start")
+        if rejoin:
+            # crash-recovery restart: dt_start dials every live peer
+            # instead of the bind/connect split (they accept mid-run)
+            if self._lib.dt_set_rejoin(self._h, 1) != 0:
+                raise RuntimeError("dt_set_rejoin must precede start")
         self.node_id = node_id
         self.n_nodes = n_nodes
         self._recv_buf = np.empty(1 << 20, np.uint8)
@@ -173,6 +195,15 @@ class NativeTransport:
 
     def set_delay_us(self, us: int) -> None:
         self._lib.dt_set_delay_us(self._h, us)
+
+    def set_fault(self, drop_prob: float = 0.0, dup_prob: float = 0.0,
+                  jitter_us: float = 0.0, seed: int = 0,
+                  rtype_mask: int = FAULT_RTYPE_MASK) -> None:
+        """Seeded drop/dup/jitter injection on the fault-eligible message
+        classes (chaos harness; all-zero disables)."""
+        self._lib.dt_set_fault(
+            self._h, int(drop_prob * 1_000_000), int(dup_prob * 1_000_000),
+            int(jitter_us), seed & (2**64 - 1), rtype_mask)
 
     def peer_alive(self, peer: int) -> bool:
         """Link-level failure detection (the reference has none: its

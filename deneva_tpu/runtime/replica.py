@@ -61,6 +61,20 @@ class ReplicaNode:
             self.tp.send(src, "LOG_RSP", wire.encode_shutdown(epoch))
             self.stats.incr("log_records")
             self.stats.incr("log_bytes", len(payload))
+        elif rtype == "REJOIN":
+            # crash-recovery: the restarted primary resumes at this epoch
+            # boundary — drop any records past it (they were truncated
+            # from the primary's log too, so the byte-prefix invariant
+            # holds) and tell the primary what we last kept so it can
+            # re-ship the gap from its own log
+            from deneva_tpu.runtime.logger import truncate_log_to_epoch
+            resume = wire.decode_shutdown(payload)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            last = truncate_log_to_epoch(self.log_path, resume)
+            self._f.seek(0, os.SEEK_END)
+            self.tp.send(src, "LOG_RSP", wire.encode_shutdown(last))
+            self.stats.incr("rejoin_cnt")
         elif rtype == "SHUTDOWN":
             self.stop = True
 

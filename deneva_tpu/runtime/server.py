@@ -465,12 +465,44 @@ class ServerNode:
         self.dev_stats = init_device_stats(
             len(getattr(self.wl, "txn_type_names", ("txn",))))
 
+        # ---- chaos / failover gates (all off on a default config) ------
+        # _failover: peers tolerate a dead server and wait for its
+        # recovered incarnation instead of raising; acks gate on whole-
+        # group durability so recovery's truncate-to-boundary never
+        # drops an acked txn.  _dedup_on: idempotent admission (client
+        # resend + transport dup protection).
+        self._failover = cfg.faults_enabled and cfg.logging
+        self._dedup_on = cfg.faults_enabled
+        kill = cfg.fault_kill_spec()
+        self._kill_at = (kill[1] if kill is not None and kill[0] == self.me
+                         and not cfg.recover else None)
+        self._in_system: set[int] = set()
+        self._committed_set: set[int] = set()
+        self._committed_recent: deque[int] = deque()
+        self._committed_cap = 1 << 20
+        self._dup_admits = 0
+        self._reacks = 0
+        self._rejoin_pending: set[int] = set()
+        # retained recent own-contribution blobs (bytes), resent verbatim
+        # when a crashed peer rejoins and asks for epochs it missed
+        self._sent_blobs: deque[tuple[int, bytes]] = deque(
+            maxlen=max(64, 6 * self.C * self.K))
+        self._resume_epoch = 0
+        if cfg.recover:
+            self._recover_state()
+
         self.tp = NativeTransport(self.me, endpoints,
                                   self.n_srv + self.n_cl + self.n_repl,
                                   msg_size_max=cfg.msg_size_max,
                                   send_threads=cfg.send_thread_cnt,
-                                  recv_threads=cfg.rem_thread_cnt)
+                                  recv_threads=cfg.rem_thread_cnt,
+                                  rejoin=cfg.recover)
         self.tp.start()
+        if (cfg.fault_drop_prob or cfg.fault_dup_prob
+                or cfg.fault_delay_jitter_us):
+            self.tp.set_fault(cfg.fault_drop_prob, cfg.fault_dup_prob,
+                              cfg.fault_delay_jitter_us,
+                              seed=cfg.fault_seed + 7919 * cfg.node_id)
         # host codec workers (reference THREAD_CNT, main.cpp:196-310):
         # the admit path's per-epoch blob encode+broadcast and the group
         # feed assembly run through this pool when thread_cnt > 1 —
@@ -498,7 +530,12 @@ class ServerNode:
             from deneva_tpu.runtime.logger import EpochLogger
             self.log_path = os.path.join(cfg.log_dir,
                                          f"node{self.me}.log.bin")
-            self.logger = EpochLogger(self.log_path)
+            # recovery appends after the replayed prefix (truncated to
+            # the resume boundary by _recover_state) instead of
+            # truncating the whole file
+            self.logger = EpochLogger(
+                self.log_path, append=cfg.recover,
+                flushed_epoch=self._resume_epoch - 1)
         # new_txn_queue: FIFO of (src client id, query block)
         self.pending: deque[tuple[int, wire.QueryBlock]] = deque()
         self.retry = _RetryQueue(cfg.backoff)
@@ -514,12 +551,113 @@ class ServerNode:
         self._retry_hist = np.zeros(8, np.int64)
         self._wait_hist = np.zeros(8, np.int64)
 
+    # -- crash recovery (SURVEY §5.4: the reference logs and never
+    # reads back; here deterministic replay IS the failover path) -------
+    def _recover_state(self) -> None:
+        """Rebuild partition state by replaying the local command log
+        through the per-epoch jit, truncated to the last complete group
+        boundary (a torn tail group is discarded — acks gate on whole-
+        group durability in fault mode, so nothing acked is lost).
+        Leaves ``self.db/cc_state/dev_stats`` at the boundary and writes
+        a sidecar JSON the chaos harness uses for its bit-for-bit check.
+        """
+        import json
+
+        from deneva_tpu.runtime.logger import (
+            iter_record_spans, replay_into, state_digest,
+            truncate_log_to_epoch)
+
+        cfg = self.cfg
+        path = os.path.join(cfg.log_dir, f"node{self.me}.log.bin")
+        if not os.path.exists(path):
+            raise RuntimeError(
+                f"server {self.me}: recovery needs a command log at "
+                f"{path}")
+        with open(path, "rb") as f:
+            buf = f.read()
+        last = -1
+        for e, _lo, _hi in iter_record_spans(buf):
+            last = max(last, e)
+        boundary = (last + 1) // self.C * self.C
+        truncate_log_to_epoch(path, boundary)
+        # per-epoch jit: the replay path this function exists for
+        step = make_dist_step(cfg, self.wl, self.be)
+        sl = slice(self.me * self.b_loc, (self.me + 1) * self.b_loc)
+        committed: list[np.ndarray] = []
+
+        def seed_committed(epoch, block, active, done):
+            del epoch
+            # my slice's done txns were (or will be, via re-ack) acked:
+            # they must never be admitted again
+            mine = done[sl] & active[sl]
+            if mine.any():
+                committed.append(block.tags[sl][mine])
+
+        self.db, self.cc_state, self.dev_stats, replayed = replay_into(
+            path, cfg, self.wl, step, self.db, self.cc_state,
+            self.dev_stats, stop_epoch=boundary,
+            on_epoch=seed_committed if self._dedup_on else None)
+        for tags in committed:
+            for t in tags:
+                p = int(t)
+                if p not in self._committed_set:
+                    self._committed_set.add(p)
+                    self._committed_recent.append(p)
+        self._resume_epoch = boundary
+        meta = {"node": self.me, "resume_epoch": boundary,
+                "log_last_epoch": last, "replayed_through": replayed,
+                "state_digest": state_digest(self.db),
+                "committed_tags": len(self._committed_set)}
+        with open(os.path.join(cfg.log_dir,
+                               f"node{self.me}.recovery.json"), "w") as f:
+            json.dump(meta, f)
+        print(f"[recovery] node={self.me} resume_epoch={boundary} "
+              f"replayed_through={replayed} "
+              f"digest={meta['state_digest'][:16]}", flush=True)
+
+    def _announce_rejoin(self) -> None:
+        """Tell every server and replica we are back and where we
+        resume; then close the replica log gap (records the replica
+        acked before the crash may trail our truncated prefix — re-ship
+        (acked, resume) so its file stays a byte prefix of ours)."""
+        from deneva_tpu.runtime.logger import iter_record_spans
+
+        msg = wire.encode_shutdown(self._resume_epoch)
+        for p in range(self.n_srv):
+            if p != self.me:
+                self.tp.send(p, "REJOIN", msg)
+        self._rejoin_pending = set(self.repl_ids)
+        for r in self.repl_ids:
+            self.tp.send(r, "REJOIN", msg)
+        self.tp.flush()
+        if not self.repl_ids:
+            return
+        t0 = time.monotonic()
+        while self._rejoin_pending and time.monotonic() - t0 < 30.0:
+            self._drain(timeout_us=20_000)
+        if self._rejoin_pending:
+            raise RuntimeError(
+                f"server {self.me}: replicas {sorted(self._rejoin_pending)}"
+                " never answered the rejoin handshake")
+        with open(self.log_path, "rb") as f:
+            buf = f.read()
+        for r in self.repl_ids:
+            acked = self.repl_acked[r]
+            for e, lo, hi in iter_record_spans(buf):
+                if acked < e < self._resume_epoch:
+                    self.tp.send(r, "LOG_MSG", buf[lo:hi])
+        self.tp.flush()
+
     # -- message routing (reference InputThread::server_recv_loop) ------
     def _route(self, src: int, rtype: str, payload: bytes) -> None:
         if rtype == "CL_QRY_BATCH":
             blk = wire.decode_qry_block(payload)
             # stamp the source client into the tag's high bits? no — tags
             # are opaque to servers; remember src alongside
+            if self._dedup_on:
+                blk = self._admit_dedup(src, blk)
+                if blk is None:
+                    return
             self.pending.append((src, blk))
         elif rtype == "EPOCH_BLOB":
             epoch, blk, ts = wire.decode_epoch_blob(payload)
@@ -538,6 +676,33 @@ class ServerNode:
             # this replica acked everything up to this epoch (FIFO link)
             e = wire.decode_shutdown(payload)
             self.repl_acked[src] = max(self.repl_acked.get(src, -1), e)
+            self._rejoin_pending.discard(src)
+        elif rtype == "REJOIN":
+            # a crashed peer server recovered and resumes at this epoch
+            # boundary: resend our retained contribution blobs it missed
+            # while its link was down (idempotent — blob_buf keys on
+            # (epoch, src) and the bytes are verbatim), drop any stale
+            # buffered blobs of its dead incarnation past the boundary,
+            # and (coordinator only) re-announce the measure/stop epochs
+            # its restart lost
+            e = wire.decode_shutdown(payload)
+            for ep, blobs in self.blob_buf.items():
+                if ep >= e:
+                    blobs.pop(src, None)
+            for ep, blob in list(self._sent_blobs):
+                if ep >= e:
+                    self.tp.send(src, "EPOCH_BLOB", blob)
+            # ANY surviving peer echoes the coordinator's announcements
+            # (identical values everywhere, so duplicates are no-ops):
+            # a restarted node — including a restarted coordinator —
+            # re-learns the window instead of inventing a later one
+            if self.measure_epoch is not None:
+                self.tp.send(src, "MEASURE",
+                             wire.encode_shutdown(self.measure_epoch))
+            if self.stop_epoch is not None:
+                self.tp.send(src, "SHUTDOWN",
+                             wire.encode_shutdown(self.stop_epoch))
+            self.tp.flush()
         elif rtype == "INIT_DONE":
             pass  # late barrier duplicate; the barrier itself already ran
 
@@ -554,6 +719,51 @@ class ServerNode:
         wire.run_barrier(self.tp, self.me,
                          self.n_srv + self.n_cl + self.n_repl,
                          self._route, f"server {self.me}", timeout_s)
+
+    # -- idempotent admission (fault mode): message loss degrades
+    # throughput instead of correctness --------------------------------
+    def _admit_dedup(self, src: int,
+                     blk: wire.QueryBlock) -> wire.QueryBlock | None:
+        """Filter a CL_QRY_BATCH against the in-system and recently-
+        committed id sets (keyed on the same packed client<<40|tag id
+        the admission path stamps).  Already-committed tags are re-acked
+        immediately — a resend after a lost CL_RSP must converge, not
+        re-execute; in-flight duplicates are dropped.  Returns the block
+        of genuinely fresh txns (None if empty)."""
+        packed = (np.int64(src) << 40) | (blk.tags & _TAG_MASK)
+        fresh = np.ones(len(blk), bool)
+        reack: list[int] = []
+        for i, pid in enumerate(packed):
+            p = int(pid)
+            if p in self._committed_set:
+                fresh[i] = False
+                reack.append(int(blk.tags[i]))
+            elif p in self._in_system:
+                fresh[i] = False
+                self._dup_admits += 1
+            else:
+                self._in_system.add(p)
+        if reack:
+            self._reacks += len(reack)
+            self.tp.send(src, "CL_RSP",
+                         wire.encode_cl_rsp(np.asarray(reack, np.int64)))
+        if fresh.all():
+            return blk
+        if not fresh.any():
+            return None
+        return blk.take(np.where(fresh)[0])
+
+    def _retire_dedup(self, done_tags: np.ndarray) -> None:
+        """Move committed packed ids from in-system to the bounded
+        recently-committed ring (admission dedup's re-ack source)."""
+        for t in done_tags:
+            p = int(t)
+            self._in_system.discard(p)
+            if p not in self._committed_set:
+                self._committed_set.add(p)
+                self._committed_recent.append(p)
+        while len(self._committed_recent) > self._committed_cap:
+            self._committed_set.discard(self._committed_recent.popleft())
 
     # -- admission (client_thread + new_txn_queue + abort_queue) ---------
     def _contribution(self, epoch: int
@@ -631,6 +841,16 @@ class ServerNode:
             e = min(e, self.repl_acked[r])
         return e
 
+    def _durable_ack_epoch(self) -> int:
+        """Durability horizon for releasing held CL_RSPs.  In failover
+        mode it rounds DOWN to a group boundary: recovery truncates the
+        log to the last complete group, so an ack must never ride a
+        partially-durable group a crash could tear away."""
+        e = self._durable_through()
+        if self._failover:
+            e = (e + 1) // self.C * self.C - 1
+        return e
+
     def _flush_held_rsp(self, wait_epoch: int | None = None) -> None:
         """Release group-committed responses whose epoch is durable.
         With ``wait_epoch`` set, block (bounded) until that epoch is
@@ -639,14 +859,18 @@ class ServerNode:
             return
         if wait_epoch is not None:
             t0 = time.monotonic()
-            while self._durable_through() < wait_epoch \
+            while self._durable_ack_epoch() < wait_epoch \
                     and time.monotonic() - t0 < 10.0:
                 self.logger.wait_flushed(wait_epoch, timeout=0.05)
                 if self.n_repl:
                     self._drain(timeout_us=10_000)
-        durable = self._durable_through()
+        durable = self._durable_ack_epoch()
         while self._held_rsp and self._held_rsp[0][1] <= durable:
             c, _, tags = self._held_rsp.popleft()
+            if self._dedup_on:
+                # the ack is now safe to (re-)issue: only here do the
+                # packed ids gain re-ack authority in the committed set
+                self._retire_dedup((np.int64(c) << 40) | tags)
             self.tp.send(c, "CL_RSP", wire.encode_cl_rsp(tags))
 
     # -- batched 2PC round (VOTE protocol; see make_vote_steps) ----------
@@ -716,6 +940,8 @@ class ServerNode:
         """Collect one message per peer server into ``buf[epoch]`` with
         dead-peer detection; the wait is carved out of process time."""
         t0 = time.monotonic()
+        timeout = (self.cfg.fault_recovery_timeout_s if self._failover
+                   else 60.0)
         while len(buf.get(epoch, {})) < self.n_srv - 1:
             self._drain(timeout_us=5_000)
             have = buf.get(epoch, {})
@@ -728,11 +954,11 @@ class ServerNode:
                 self._drain(timeout_us=50_000)
                 have = buf.get(epoch, {})
                 dead = [p for p in dead if p not in have]
-            if dead and len(have) < self.n_srv - 1:
+            if dead and len(have) < self.n_srv - 1 and not self._failover:
                 raise RuntimeError(
                     f"server {self.me}: peer server(s) {dead} died "
                     f"waiting for epoch {epoch} {what}")
-            if time.monotonic() - t0 > 60:
+            if time.monotonic() - t0 > timeout:
                 raise TimeoutError(
                     f"server {self.me}: epoch {epoch} {what} wait: have "
                     f"{sorted(have)}")
@@ -746,8 +972,14 @@ class ServerNode:
     def _wait_blobs(self, epoch: int) -> None:
         """Block until every peer's contribution for ``epoch`` arrived
         (the RDONE analogue), with dead-peer detection (SURVEY §5.3: the
-        reference has none — it would hang on its 1s recv timeouts)."""
+        reference has none — it would hang on its 1s recv timeouts).
+        In failover mode a dead peer is NOT fatal: the supervisor
+        restarts it in recovery mode, it replays its log, rejoins the
+        mesh and re-broadcasts — we keep waiting up to the recovery
+        timeout instead of aborting the whole cluster."""
         t0 = time.monotonic()
+        timeout = (self.cfg.fault_recovery_timeout_s if self._failover
+                   else 60.0)
         while len(self.blob_buf.get(epoch, {})) < self.n_srv - 1:
             self._drain(timeout_us=5_000)
             have = self.blob_buf.get(epoch, {})
@@ -767,11 +999,11 @@ class ServerNode:
                 self._drain(timeout_us=50_000)
                 have = self.blob_buf.get(epoch, {})
                 dead = [p for p in dead if p not in have]
-            if dead and len(have) < self.n_srv - 1:
+            if dead and len(have) < self.n_srv - 1 and not self._failover:
                 raise RuntimeError(
                     f"server {self.me}: peer server(s) {dead} died "
                     f"waiting for epoch {epoch} blobs")
-            if time.monotonic() - t0 > 60:
+            if time.monotonic() - t0 > timeout:
                 raise TimeoutError(
                     f"server {self.me}: epoch {epoch} blob wait: have "
                     f"{sorted(have)}")
@@ -807,6 +1039,14 @@ class ServerNode:
                     np.minimum(dfc[:n][my_commit], 7), minlength=8)
                 # tag high bits carry the home client's transport id
                 tags = block.tags[my_commit]
+                if self._dedup_on and self.logger is None:
+                    # without logging the ack goes out right below; with
+                    # logging the committed-set entry (and its re-ack
+                    # authority) must wait for the SAME durability gate
+                    # the held ack waits for — _flush_held_rsp moves the
+                    # ids at release time, or a resend could extract an
+                    # early re-ack for a txn a crash then truncates away
+                    self._retire_dedup(tags)
                 clients = tags >> 40
                 for c in np.unique(clients):
                     rsp = (int(c), epoch, tags[clients == c] & _TAG_MASK)
@@ -893,12 +1133,18 @@ class ServerNode:
             # group_step donates its state args: adopt the outputs
             self.db, self.cc_state, self.dev_stats = out[:3]
             jax.block_until_ready(out[3])
-        self.barrier()
+        if cfg.recover:
+            # the mesh is mid-run: no INIT_DONE barrier — announce the
+            # rejoin instead (peers resend the blobs we missed, replicas
+            # resync their log tail)
+            self._announce_rejoin()
+        else:
+            self.barrier()
         t_start = time.monotonic()
         prog_next = t_start + cfg.prog_timer_secs
         warm_edge = t_start + cfg.warmup_secs
         measured = None     # counter snapshot at measure start
-        epoch0 = 0          # first epoch of the group being assembled
+        epoch0 = self._resume_epoch   # 0, or the recovery group boundary
         tl = _Timeline() if cfg.debug_timeline else None
         # phase-time ledger (reference Stats_thd worker time breakdowns,
         # `statistics/stats.h:116` worker_idle_time etc.)
@@ -907,6 +1153,16 @@ class ServerNode:
         while True:
             if tl:
                 tl.mark("loop")
+            if self._kill_at is not None and epoch0 >= self._kill_at:
+                # injected crash (fault_kill "node:epoch"): die at this
+                # group boundary with no teardown or farewell — but let
+                # the async log writer drain first, so the crash model
+                # is "process lost at an epoch boundary, log intact to
+                # that boundary" (torn tails are exercised separately:
+                # recovery truncates them, tests/test_chaos.py).
+                if self.logger is not None and epoch0 > 0:
+                    self.logger.wait_flushed(epoch0 - 1, timeout=10.0)
+                os._exit(17)
             self._drain()
             now = time.monotonic()
             # epoch-aligned measurement window: server 0 announces a
@@ -940,24 +1196,36 @@ class ServerNode:
                 # cross-epoch arrival order is free, and dt_send is
                 # thread-safe (MPMC queues)
                 blob = wire.encode_epoch_blob(e, block, birth_ts)
+                if self._failover:
+                    # retained for verbatim resend to a rejoining peer
+                    # (deque append is GIL-atomic; maxlen bounds it)
+                    self._sent_blobs.append((e, blob))
                 for p in range(self.n_srv):
                     if p != self.me:
                         self.tp.send(p, "EPOCH_BLOB", blob)
 
             futs = []
-            for i in range(C):
-                e = epoch0 + i
-                if i:
-                    self._drain()
-                block, abort_cnt, birth_ts, dfc = self._contribution(e)
-                if self.codec_pool is not None and self.n_srv > 1:
-                    futs.append(self.codec_pool.submit(
-                        _bcast, e, block, birth_ts))
-                else:
-                    _bcast(e, block, birth_ts)
-                eps.append((e, block, abort_cnt, birth_ts, dfc))
+            try:
+                for i in range(C):
+                    e = epoch0 + i
+                    if i:
+                        self._drain()
+                    block, abort_cnt, birth_ts, dfc = self._contribution(e)
+                    if self.codec_pool is not None and self.n_srv > 1:
+                        futs.append(self.codec_pool.submit(
+                            _bcast, e, block, birth_ts))
+                    else:
+                        _bcast(e, block, birth_ts)
+                    eps.append((e, block, abort_cnt, birth_ts, dfc))
+            finally:
+                # drain in-flight _bcast sends before any exception can
+                # unwind past self.tp teardown (they hold the native
+                # transport; an abandoned future would race the close)
+                if futs:
+                    from concurrent.futures import wait as _futs_wait
+                    _futs_wait(futs)
             for f in futs:
-                f.result()
+                f.result()    # surface any _bcast error after the drain
             self.tp.flush()
             if tl:
                 tl.mark("admit")
@@ -1149,7 +1417,14 @@ class ServerNode:
                 st.arr(name).extend_weighted(np.arange(len(d)), d)
         st.set("worker_idle_time", self._ph["idle"])
         st.set("worker_process_time", self._ph["process"])
+        chaos = cfg.faults_enabled
+        if chaos:
+            st.set("dup_admit_cnt", float(self._dup_admits))
+            st.set("reack_cnt", float(self._reacks))
+            st.set("recovered", 1.0 if cfg.recover else 0.0)
         for k, v in self.tp.stats().items():
+            if not chaos and k in ("msg_dropped", "msg_dup", "reconnects"):
+                continue   # keep the default-config summary line as-is
             st.set(f"net_{k}", float(v))
         return st
 

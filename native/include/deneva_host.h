@@ -52,6 +52,8 @@ enum dt_rtype {
   DT_SHUTDOWN = 10,   /* orderly teardown */
   DT_MEASURE = 11,    /* epoch-aligned measure-window start */
   DT_VOTE = 12,       /* batched 2PC prepare votes (RPREPARE/RACK_PREP) */
+  DT_VOTE2 = 13,      /* MAAT verify-round votes (second RACK_PREP) */
+  DT_REJOIN = 14,     /* crash-recovery: restarted node announces resume */
 };
 
 /* Stats slot indices for dt_stats(). */
@@ -63,7 +65,10 @@ enum dt_stat {
   DT_STAT_BATCHES_SENT = 4,
   DT_STAT_SEND_QUEUE_DEPTH = 5,
   DT_STAT_RECV_QUEUE_DEPTH = 6,
-  DT_STAT_COUNT = 7
+  DT_STAT_MSG_DROPPED = 7,   /* fault injection: frames dropped at send */
+  DT_STAT_MSG_DUP = 8,       /* fault injection: frames duplicated */
+  DT_STAT_RECONNECTS = 9,    /* links re-established after a peer restart */
+  DT_STAT_COUNT = 10
 };
 
 /* endpoints: n_nodes lines "node_id proto addr", e.g.
@@ -97,6 +102,25 @@ void dt_flush(dt_transport *t);
 /* Artificial send delay (NETWORK_DELAY_TEST): frames stay in the batch
  * queue for at least delay_us before hitting the socket. */
 void dt_set_delay_us(dt_transport *t, uint64_t delay_us);
+
+/* Seeded fault injection (chaos harness; the reference has none).
+ * Applied at enqueue time to frames whose rtype bit is set in rtype_mask
+ * (bit i = rtype i, rtypes >= 32 never match): drop with probability
+ * drop_ppm/1e6, duplicate with dup_ppm/1e6, and park for a uniform
+ * [0, jitter_us) extra delay.  Decisions come from a splitmix64 stream
+ * over (seed, per-transport frame counter), so a single-threaded sender
+ * replays the identical fault pattern from the same seed.  Loopback
+ * frames are exempt.  May be called before or after dt_start; all-zero
+ * arguments disable injection (the default).  Returns 0. */
+int dt_set_fault(dt_transport *t, uint32_t drop_ppm, uint32_t dup_ppm,
+                 uint64_t jitter_us, uint64_t seed, uint32_t rtype_mask);
+
+/* Crash-recovery rejoin: call BEFORE dt_start on a restarted node.
+ * dt_start then dials EVERY peer (instead of the bind/connect split) —
+ * live peers accept the redial on their listening socket at any time,
+ * replace the dead link and clear the peer's dead flag.  Returns 0,
+ * -1 after start. */
+int dt_set_rejoin(dt_transport *t, int on);
 
 /* Copy DT_STAT_COUNT counters into out. */
 void dt_stats(const dt_transport *t, uint64_t *out);

@@ -17,6 +17,7 @@
 #include <chrono>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -31,6 +32,15 @@ uint64_t now_us() {
   return std::chrono::duration_cast<std::chrono::microseconds>(
              Clock::now().time_since_epoch())
       .count();
+}
+
+// Deterministic fault stream (chaos harness): one splitmix64 draw per
+// eligible frame, chained for the per-frame drop/dup/jitter decisions.
+uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e9b5ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
 }
 
 constexpr uint32_t kHelloMagic = 0xD27EAF01u;
@@ -86,13 +96,19 @@ struct dt_transport {
   uint32_t flush_timeout_us = 200;
   std::vector<Endpoint> eps;
 
-  // peer_fd is written only during dt_start (before IO threads exist) and
-  // by the destructor (after they join) — read-only while threads run.
-  // Disconnects are flagged in peer_dead; fds stay open until teardown so
-  // the sender can never write to a recycled descriptor.
-  std::vector<int> peer_fd;          // fd per node id (-1 = none/self)
+  // peer_fd slots are atomic: besides dt_start (before IO threads exist)
+  // they are swapped by receiver shard 0 when a restarted peer redials
+  // (crash-recovery rejoin).  Replaced fds are parked in a graveyard and
+  // closed only at teardown, so a sender mid-write can never touch a
+  // recycled descriptor; a failed write/read marks peer_dead only if the
+  // slot still holds the fd it used (a stale-fd failure must not smear
+  // the freshly reconnected link).
+  std::vector<std::atomic<int>> peer_fd;  // fd per node id (-1 = none/self)
   std::vector<std::atomic<bool>> peer_dead;
+  std::vector<int> fd_graveyard;
+  std::mutex graveyard_mu;
   int listen_fd = -1;
+  bool rejoin = false;  // dt_start dials every peer instead of split
 
   // bounded (SURVEY §2.6: the reference's queues are bounded rings);
   // a full shard queue blocks dt_send, full recv_q pauses the reader ->
@@ -125,6 +141,13 @@ struct dt_transport {
 
   std::atomic<bool> stop{false};
   std::atomic<uint64_t> delay_us{0};
+  // fault injection (dt_set_fault): all-zero = disabled (default)
+  std::atomic<uint32_t> fault_drop_ppm{0};
+  std::atomic<uint32_t> fault_dup_ppm{0};
+  std::atomic<uint64_t> fault_jitter_us{0};
+  std::atomic<uint32_t> fault_mask{0};
+  std::atomic<uint64_t> fault_seed{0};
+  std::atomic<uint64_t> fault_ctr{0};
   std::atomic<uint64_t> stats[DT_STAT_COUNT]{};
 
   // ping bookkeeping: receiver thread answers pings itself and routes
@@ -140,7 +163,11 @@ struct dt_transport {
       if (th.joinable()) th.join();
     for (auto &th : receivers)
       if (th.joinable()) th.join();
-    for (int fd : peer_fd)
+    for (auto &slot : peer_fd) {
+      int fd = slot.load(std::memory_order_relaxed);
+      if (fd >= 0) ::close(fd);
+    }
+    for (int fd : fd_graveyard)
       if (fd >= 0) ::close(fd);
     if (listen_fd >= 0) ::close(listen_fd);
     if (node_id < eps.size() && eps[node_id].ipc)
@@ -221,7 +248,8 @@ struct dt_transport {
           return -1;
         }
         tune(fd);
-        peer_fd[peer] = fd;
+        peer_fd[peer].store(fd, std::memory_order_release);
+        peer_dead[peer].store(false, std::memory_order_relaxed);
         return 0;
       }
       ::close(fd);
@@ -252,12 +280,45 @@ struct dt_transport {
           continue;
         }
         tune(fd);
-        peer_fd[hello[1]] = fd;
+        peer_fd[hello[1]].store(fd, std::memory_order_release);
         return 0;
       }
       if (now_us() > deadline_us) return -1;
     }
     return -1;
+  }
+
+  // Runtime re-accept (crash-recovery rejoin): a restarted peer redials
+  // our listening socket mid-run; swap its link in and revive it.  The
+  // hello read is bounded so a junk connection cannot stall the
+  // receiver shard that owns the listen fd.
+  void accept_rejoin() {
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) return;
+    uint32_t hello[2] = {0, 0};
+    size_t got = 0;
+    uint64_t deadline = now_us() + 500'000;
+    while (got < sizeof(hello) && now_us() < deadline) {
+      pollfd pf{fd, POLLIN, 0};
+      if (::poll(&pf, 1, 50) <= 0) continue;
+      ssize_t r = ::read(fd, reinterpret_cast<uint8_t *>(hello) + got,
+                         sizeof(hello) - got);
+      if (r <= 0) break;
+      got += static_cast<size_t>(r);
+    }
+    if (got != sizeof(hello) || hello[0] != kHelloMagic ||
+        hello[1] >= n_nodes || hello[1] == node_id) {
+      ::close(fd);
+      return;
+    }
+    tune(fd);
+    int old = peer_fd[hello[1]].exchange(fd, std::memory_order_acq_rel);
+    if (old >= 0) {
+      std::lock_guard<std::mutex> g(graveyard_mu);
+      fd_graveyard.push_back(old);
+    }
+    peer_dead[hello[1]].store(false, std::memory_order_release);
+    bump(DT_STAT_RECONNECTS);
   }
 
   static void tune(int fd) {
@@ -271,14 +332,16 @@ struct dt_transport {
   void flush_dest(IoShard &sh, uint32_t dest) {
     Mbuf &mb = sh.mbufs[dest];
     if (mb.buf.empty()) return;
-    int fd = peer_fd[dest];
+    int fd = peer_fd[dest].load(std::memory_order_acquire);
     if (fd >= 0 && !peer_dead[dest].load(std::memory_order_relaxed)) {
       if (write_all(fd, mb.buf.data(), mb.buf.size()) >= 0) {
         bump(DT_STAT_BATCHES_SENT);
         bump(DT_STAT_BYTES_SENT, mb.buf.size());
-      } else {
+      } else if (peer_fd[dest].load(std::memory_order_acquire) == fd) {
         // failed write = dead peer; later sends to it drop visibly
-        // (peer_dead readable via stats going flat) instead of silently
+        // (peer_dead readable via stats going flat) instead of silently.
+        // If the slot changed under us the failure was on a replaced
+        // link — the reconnected peer must not be re-flagged dead.
         peer_dead[dest].store(true, std::memory_order_relaxed);
       }
     }
@@ -362,38 +425,65 @@ struct dt_transport {
 
   void receiver_loop(uint32_t shard) {
     std::vector<std::vector<uint8_t>> streams(n_nodes);
+    // fd the bytes in streams[p] came from: a different fd means a
+    // rejoin swapped the link, so the old incarnation's partial frame
+    // is discarded before the new link's bytes append.  Keyed on the
+    // fd itself (race-free: the stale-fd check below guarantees bytes
+    // only append from the CURRENT fd, and parked graveyard fds are
+    // never recycled while we run), not on a separate generation
+    // counter whose update could interleave with the fd swap.
+    std::vector<int> seen_fd(n_nodes, -1);
     std::vector<pollfd> pfds;
-    std::vector<uint32_t> ids;
+    std::vector<uint32_t> ids;  // ids[i] valid for peer entries only
+    // shard 0 also watches the listening socket so a crashed-and-
+    // restarted peer can redial mid-run (accept_rejoin swaps the link)
+    bool watch_listen = shard == 0 && listen_fd >= 0;
     while (!stop.load()) {
       pfds.clear();
       ids.clear();
       for (uint32_t p = 0; p < n_nodes; ++p) {
-        if (p % n_recv == shard && peer_fd[p] >= 0 &&
+        int fd = peer_fd[p].load(std::memory_order_acquire);
+        if (p % n_recv == shard && fd >= 0 &&
             !peer_dead[p].load(std::memory_order_relaxed)) {
-          pfds.push_back({peer_fd[p], POLLIN, 0});
+          pfds.push_back({fd, POLLIN, 0});
           ids.push_back(p);
         }
       }
+      size_t n_peers = pfds.size();
+      if (watch_listen) pfds.push_back({listen_fd, POLLIN, 0});
       if (pfds.empty()) {
         std::this_thread::sleep_for(std::chrono::milliseconds(5));
         continue;
       }
       int pr = ::poll(pfds.data(), pfds.size(), 20);
       if (pr <= 0) continue;
-      for (size_t i = 0; i < pfds.size(); ++i) {
+      if (watch_listen && (pfds[n_peers].revents & POLLIN)) accept_rejoin();
+      for (size_t i = 0; i < n_peers; ++i) {
         if (!(pfds[i].revents & (POLLIN | POLLHUP | POLLERR))) continue;
         uint8_t chunk[65536];
         ssize_t r = ::read(pfds[i].fd, chunk, sizeof(chunk));
         if (r <= 0) {
-          if (r == 0 || (errno != EINTR && errno != EAGAIN)) {
+          if ((r == 0 || (errno != EINTR && errno != EAGAIN)) &&
+              peer_fd[ids[i]].load(std::memory_order_acquire) ==
+                  pfds[i].fd) {
             // flag only; the fd stays open until the destructor so the
-            // sender never races a close/recycle
+            // sender never races a close/recycle.  Skip if the slot was
+            // already swapped by a rejoin — the old link's EOF must not
+            // kill the new one; any half-frame from the old incarnation
+            // is dropped with its stream buffer.
             peer_dead[ids[i]].store(true, std::memory_order_relaxed);
+            streams[ids[i]].clear();
           }
           continue;
         }
+        if (peer_fd[ids[i]].load(std::memory_order_acquire) != pfds[i].fd)
+          continue;  // stale fd drained after a rejoin swap: discard
         bump(DT_STAT_BYTES_RCVD, static_cast<uint64_t>(r));
         auto &st = streams[ids[i]];
+        if (pfds[i].fd != seen_fd[ids[i]]) {
+          st.clear();  // drop the old incarnation's partial frame
+          seen_fd[ids[i]] = pfds[i].fd;
+        }
         st.insert(st.end(), chunk, chunk + r);
         parse_stream(st);
       }
@@ -438,18 +528,43 @@ struct dt_transport {
     if (dest >= n_nodes || stop.load()) return -1;
     FrameHdr h{len, rtype, 0, node_id};
     if (dest == node_id) {
-      // loopback: skip the wire entirely
+      // loopback: skip the wire entirely (and the fault model with it)
       deliver(h, payload);
       bump(DT_STAT_MSG_SENT);
       return 0;
     }
+    uint64_t jitter = 0;
+    bool duplicate = false;
+    uint32_t mask = fault_mask.load(std::memory_order_relaxed);
+    if (mask && rtype < 32 && (mask & (1u << rtype))) {
+      uint64_t r = splitmix64(
+          fault_seed.load(std::memory_order_relaxed) +
+          fault_ctr.fetch_add(1, std::memory_order_relaxed));
+      uint32_t drop = fault_drop_ppm.load(std::memory_order_relaxed);
+      if (drop && static_cast<uint32_t>(r % 1000000u) < drop) {
+        bump(DT_STAT_MSG_DROPPED);
+        return 0;  // silently lost, exactly like a lossy network
+      }
+      r = splitmix64(r);
+      uint32_t dup = fault_dup_ppm.load(std::memory_order_relaxed);
+      if (dup && static_cast<uint32_t>(r % 1000000u) < dup)
+        duplicate = true;
+      r = splitmix64(r);
+      uint64_t jmax = fault_jitter_us.load(std::memory_order_relaxed);
+      if (jmax) jitter = r % jmax;
+    }
     OutFrame f;
     f.dest = dest;
-    uint64_t d = delay_us.load(std::memory_order_relaxed);
+    uint64_t d = delay_us.load(std::memory_order_relaxed) + jitter;
     f.ready_us = d ? now_us() + d : 0;
     f.bytes.resize(sizeof(h) + len);
     std::memcpy(f.bytes.data(), &h, sizeof(h));
     if (len) std::memcpy(f.bytes.data() + sizeof(h), payload, len);
+    if (duplicate) {
+      OutFrame g = f;  // byte-identical twin rides the same shard queue
+      bump(DT_STAT_MSG_DUP);
+      shards[dest % n_send]->q.push(std::move(g));
+    }
     shards[dest % n_send]->q.push(std::move(f));
     return 0;
   }
@@ -469,7 +584,8 @@ dt_transport *dt_create(uint32_t node_id, const char *endpoints,
   t->msg_size_max = msg_size_max ? msg_size_max : 4096;
   t->flush_timeout_us = flush_timeout_us;
   t->eps.resize(n_nodes);
-  t->peer_fd.assign(n_nodes, -1);
+  t->peer_fd = std::vector<std::atomic<int>>(n_nodes);
+  for (auto &slot : t->peer_fd) slot.store(-1, std::memory_order_relaxed);
   t->peer_dead = std::vector<std::atomic<bool>>(n_nodes);
 
   std::string text(endpoints);
@@ -507,19 +623,30 @@ int dt_start(dt_transport *t, int timeout_ms) {
   uint64_t deadline = now_us() + static_cast<uint64_t>(timeout_ms) * 1000;
   if (t->n_nodes > 1) {
     if (t->make_listen() != 0) return -1;
-    // accept from higher ids in a helper thread while we dial lower ids
-    uint32_t n_accept = t->n_nodes - 1 - t->node_id;
-    std::thread acceptor([t, n_accept, deadline] {
-      for (uint32_t k = 0; k < n_accept; ++k)
-        if (t->accept_one(deadline) != 0) return;
-    });
-    int rc = 0;
-    for (uint32_t p = 0; p < t->node_id; ++p)
-      if (t->connect_peer(p, deadline) != 0) rc = -1;
-    acceptor.join();
-    if (rc != 0) return -1;
+    if (t->rejoin) {
+      // crash-recovery restart: every live peer already holds a (dead)
+      // link to the old incarnation and will not redial — WE dial all
+      // of them; their receiver shards accept and swap the link in
+      for (uint32_t p = 0; p < t->n_nodes; ++p)
+        if (p != t->node_id && t->connect_peer(p, deadline) != 0)
+          return -1;
+    } else {
+      // accept from higher ids in a helper thread while we dial lower ids
+      uint32_t n_accept = t->n_nodes - 1 - t->node_id;
+      std::thread acceptor([t, n_accept, deadline] {
+        for (uint32_t k = 0; k < n_accept; ++k)
+          if (t->accept_one(deadline) != 0) return;
+      });
+      int rc = 0;
+      for (uint32_t p = 0; p < t->node_id; ++p)
+        if (t->connect_peer(p, deadline) != 0) rc = -1;
+      acceptor.join();
+      if (rc != 0) return -1;
+    }
     for (uint32_t p = 0; p < t->n_nodes; ++p)
-      if (p != t->node_id && t->peer_fd[p] < 0) return -1;
+      if (p != t->node_id &&
+          t->peer_fd[p].load(std::memory_order_relaxed) < 0)
+        return -1;
   }
   for (uint32_t k = 0; k < t->n_send; ++k) {
     dt_transport::IoShard *sh = t->shards[k].get();
@@ -604,10 +731,27 @@ void dt_set_delay_us(dt_transport *t, uint64_t delay_us) {
   if (t) t->delay_us.store(delay_us, std::memory_order_relaxed);
 }
 
+int dt_set_fault(dt_transport *t, uint32_t drop_ppm, uint32_t dup_ppm,
+                 uint64_t jitter_us, uint64_t seed, uint32_t rtype_mask) {
+  if (!t) return -1;
+  t->fault_drop_ppm.store(drop_ppm, std::memory_order_relaxed);
+  t->fault_dup_ppm.store(dup_ppm, std::memory_order_relaxed);
+  t->fault_jitter_us.store(jitter_us, std::memory_order_relaxed);
+  t->fault_seed.store(seed, std::memory_order_relaxed);
+  t->fault_mask.store(rtype_mask, std::memory_order_relaxed);
+  return 0;
+}
+
+int dt_set_rejoin(dt_transport *t, int on) {
+  if (!t || !t->senders.empty()) return -1; /* must precede dt_start */
+  t->rejoin = on != 0;
+  return 0;
+}
+
 int dt_peer_alive(const dt_transport *t, uint32_t peer) {
   if (!t || peer >= t->n_nodes) return 0;
   if (peer == t->node_id) return 1;
-  return (t->peer_fd[peer] >= 0 &&
+  return (t->peer_fd[peer].load(std::memory_order_relaxed) >= 0 &&
           !t->peer_dead[peer].load(std::memory_order_relaxed))
              ? 1
              : 0;

@@ -1,0 +1,94 @@
+"""_RetryQueue unit tests (reference `system/abort_queue.cpp:26-50`):
+backoff-exponent clamping, partial-block pop slicing, and defer
+re-entry semantics — the host-side retry policy the cluster loop
+routes every abort/defer through."""
+
+import numpy as np
+
+from deneva_tpu.runtime import wire
+from deneva_tpu.runtime.server import _RetryQueue
+
+
+def _blk(n, tag0=0):
+    return wire.QueryBlock(
+        keys=np.arange(n * 2, dtype=np.int32).reshape(n, 2),
+        types=np.ones((n, 2), np.int8),
+        scalars=np.zeros((n, 0), np.int32),
+        tags=np.arange(tag0, tag0 + n, dtype=np.int64))
+
+
+def test_backoff_exponent_clamps_past_cnt_32():
+    """2**(cnt-1) overflows int32 past cnt=32; the exponent (not just
+    the power) must clamp so the penalty never goes negative and never
+    exceeds the cap."""
+    q = _RetryQueue(backoff=True, cap=64)
+    counts = np.array([1, 2, 7, 32, 33, 40, 1000], np.int32)
+    q.push(_blk(7), counts, np.arange(1, 8, dtype=np.int64), epoch=10)
+    readies = sorted(r for r, *_ in q.items)
+    # penalty = min(2**min(cnt-1, log2(cap)), cap), ready = epoch+1+pen
+    want = sorted({10 + 1 + min(2 ** min(c - 1, 6), 64) for c in counts})
+    assert readies == want
+    assert all(r > 10 for r in readies), "negative/overflowed penalty"
+
+
+def test_backoff_disabled_is_flat_one_epoch():
+    q = _RetryQueue(backoff=False)
+    q.push(_blk(3), np.array([1, 5, 31], np.int32),
+           np.arange(3, dtype=np.int64), epoch=4)
+    assert [r for r, *_ in q.items] == [6]    # epoch + 1 + 1
+
+
+def test_pop_ready_partial_block_preserves_order_and_counts():
+    """A block bigger than the remaining budget splits: the taken slice
+    keeps FIFO order, the remainder re-enters at the SAME ready epoch
+    with its abort counts and birth timestamps intact."""
+    q = _RetryQueue(backoff=False)
+    birth = np.arange(100, 110, dtype=np.int64)
+    cnts = np.arange(10, dtype=np.int32) + 1
+    q.push(_blk(10), cnts, birth, epoch=0,
+           aborted=np.ones(10, bool),
+           defer_cnt=np.arange(10, dtype=np.int32))
+    blocks, counts, tss, abms, dfcs = q.pop_ready(epoch=5, limit=4)
+    got = wire.QueryBlock.concat(blocks)
+    assert len(got) == 4
+    assert (got.tags == np.arange(4)).all(), "partial take lost order"
+    assert (np.concatenate(counts) == cnts[:4]).all()
+    assert (np.concatenate(tss) == birth[:4]).all()
+    assert (np.concatenate(dfcs) == np.arange(4)).all()
+    # the remainder waits at the same ready epoch, nothing lost
+    assert len(q.items) == 1
+    r, blk, cnt, ts, ab, dc = q.items[0]
+    assert r == 2 and len(blk) == 6
+    assert (blk.tags == np.arange(4, 10)).all()
+    assert (ts == birth[4:]).all() and (cnt == cnts[4:]).all()
+    # a later pop drains the remainder in order
+    blocks2, _, tss2, _, _ = q.pop_ready(epoch=5, limit=100)
+    got2 = wire.QueryBlock.concat(blocks2)
+    assert (got2.tags == np.arange(4, 10)).all()
+    assert (np.concatenate(tss2) == birth[4:]).all()
+
+
+def test_deferred_entries_reenter_free_and_keep_birth_ts():
+    """A deferred (waiting) txn re-enters at epoch+1 with NO backoff
+    penalty — the waiter-list analogue — and keeps its birth ts even
+    though its abort counter is high (only ABORTED restarts pay)."""
+    q = _RetryQueue(backoff=True, cap=64)
+    birth = np.array([7, 9, 11], np.int64)
+    q.push(_blk(3), np.array([6, 6, 6], np.int32), birth, epoch=20,
+           aborted=np.zeros(3, bool),
+           defer_cnt=np.array([1, 2, 3], np.int32))
+    assert [r for r, *_ in q.items] == [21], "deferred must re-enter free"
+    blocks, counts, tss, abms, dfcs = q.pop_ready(epoch=21, limit=16)
+    assert (np.concatenate(tss) == birth).all()
+    assert not np.concatenate(abms).any()
+    assert (np.concatenate(dfcs) == [1, 2, 3]).all()
+
+
+def test_not_ready_entries_stay_queued():
+    q = _RetryQueue(backoff=True, cap=64)
+    q.push(_blk(2), np.array([5, 5], np.int32),
+           np.array([1, 2], np.int64), epoch=0)   # ready at 0+1+16=17
+    blocks, *_ = q.pop_ready(epoch=10, limit=16)
+    assert not blocks and len(q.items) == 1
+    blocks, *_ = q.pop_ready(epoch=17, limit=16)
+    assert sum(len(b) for b in blocks) == 2 and not q.items

@@ -52,20 +52,26 @@ def frontier(exp: str) -> str:
     p99 (worst client).  Client summaries ride the '# node N (client)'
     lines of each .out; the plain parser only surfaces the server's."""
     import glob
+    import re
 
     from deneva_tpu.stats import parse_summary
     out = [f"| point | tput | client p50 s | p99 s |",
            "|---|---|---|---|"]
+    # harness/run.py writes peers as '# node N (kind): [summary] ...'
+    # and the primary server's bare '[summary] ...' line; anchor on the
+    # explicit client marker so a node-prefix drift can never
+    # misattribute a client row as the server (ADVICE r5)
+    client_re = re.compile(r"^# node \d+ \(client\):")
     for path in sorted(glob.glob(f"results/{exp}/*.out")):
         tput, p50, p99 = None, 0.0, 0.0
         for line in open(path):
             if "[summary]" not in line:
                 continue
-            f = parse_summary(line[line.index("[summary]") - 0:])
-            if line.startswith("#"):       # a client node
+            f = parse_summary(line[line.index("[summary]"):])
+            if client_re.match(line):      # a client node
                 p50 = max(p50, f.get("client_client_latency_p50", 0.0))
                 p99 = max(p99, f.get("client_client_latency_p99", 0.0))
-            else:                          # the server
+            elif not line.startswith("#"):  # the server's own line
                 tput = f.get("tput")
         if tput is None:
             continue
