@@ -13,8 +13,20 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
                  in this image; see BASELINE.md).
 
 The measurement runs in a child process with a watchdog: this box's TPU
-tunnel is single-client and can wedge (see tests/conftest.py); on timeout
-the bench retries on CPU so the driver always gets a line.
+tunnel is single-client and can wedge (see tests/conftest.py).  Wedge
+protocol (the round-5 lesson — BENCH_r05 burned 25 min of driver window
+on a tunnel that had been dead for hours):
+
+1. a disposable ~90s ``jax.devices()`` PRE-PROBE child runs before the
+   1500s TPU measurement child — a wedged tunnel hangs every new process
+   at backend init, so the probe answers cheaply;
+2. on a wedged probe the TPU attempt retries once within the bench
+   window (sessions restart mid-campaign; the tunnel sometimes returns);
+3. if still wedged, the emitted line carries structured provenance —
+   ``"tunnel_wedged": true`` plus the newest checked-in on-chip
+   measurement (value + artifact path) — alongside the cpu-fallback
+   number, so the driver record distinguishes "chip unreachable" from
+   "code regressed" instead of printing a bare cpu line.
 """
 
 from __future__ import annotations
@@ -27,6 +39,8 @@ import sys
 MEASURE_SECS = 5.0
 WARMUP_SECS = 1.5
 TIMEOUT = 1500
+PROBE_SECS = 90       # jax.devices() pre-probe budget (wedged = hang)
+PROBE_RETRY_WAIT = 60  # pause before the one in-window retry
 
 
 def child(platform: str) -> None:
@@ -128,33 +142,136 @@ def _host_occ_tput(n: int = 5) -> tuple[float, float, float]:
     return statistics.median(vals), min(vals), max(vals)
 
 
-def main() -> None:
-    occ_med, occ_lo, occ_hi = _host_occ_tput()  # quiet host, pre-JAX
-    for platform in ("tpu", "cpu"):
-        env = dict(os.environ)
-        env["DENEVA_HOST_OCC_TPUT"] = str(occ_med)
-        env["DENEVA_HOST_OCC_LO"] = str(occ_lo)
-        env["DENEVA_HOST_OCC_HI"] = str(occ_hi)
-        if platform == "cpu":
-            env["PYTHONPATH"] = ""          # skip axon sitecustomize
-            env["JAX_PLATFORMS"] = "cpu"
+def _probe_tunnel(timeout_s: float = PROBE_SECS) -> str:
+    """~90s disposable-child tunnel probe: a wedged single-client TPU
+    tunnel hangs EVERY new process inside backend init (``jax.devices()``
+    never returns), so a short child answers "is the chip reachable"
+    without spending the 1500s measurement watchdog on a dead link.
+    Returns "tpu" (chip answered), "cpu" (JAX initialized fine but only
+    host devices exist — no chip configured, NOT a wedge), or "wedged"
+    (the probe hung or crashed)."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print(d[0].platform, len(d), flush=True)"],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(os.environ))
+    except (subprocess.TimeoutExpired, OSError):
+        return "wedged"
+    toks = out.stdout.split()
+    if out.returncode != 0 or len(toks) < 2:
+        return "wedged"
+    return "cpu" if toks[0] == "cpu" else "tpu"
+
+
+def _newest_chip_measurement() -> tuple[str, float] | None:
+    """Newest checked-in ON-CHIP headline (unit exactly "txn/s", no
+    cpu-fallback marker): the provenance pointer a wedged round emits."""
+    import glob
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
         try:
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child", platform],
-                capture_output=True, text=True, timeout=TIMEOUT, env=env)
-        except subprocess.TimeoutExpired:
-            print(f"bench: {platform} run timed out, falling back",
-                  file=sys.stderr)
+            with open(path) as f:
+                rec = json.load(f).get("parsed") or {}
+        except (OSError, ValueError):
             continue
-        lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
-        if out.returncode == 0 and lines:
-            print(lines[-1])
-            return
-        print(f"bench: {platform} run failed:\n{out.stderr[-2000:]}",
+        if rec.get("unit") == "txn/s" and rec.get("value"):
+            best = (os.path.basename(path), float(rec["value"]))
+    return best
+
+
+def _run_child(platform: str, env: dict,
+               timeout: float = TIMEOUT) -> tuple[str, str | None]:
+    """(status, json_line): status is "ok" | "timeout" | "failed".  The
+    caller must distinguish timeout — a TPU child that hangs AFTER a
+    healthy probe is the mid-run wedge (the round-5 failure mode), not a
+    code problem."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child", platform],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        print(f"bench: {platform} run timed out, falling back",
               file=sys.stderr)
-    print(json.dumps({"metric": "ycsb_zipf0.9_committed_txns_per_sec",
-                      "value": 0.0, "unit": "txn/s",
-                      "vs_baseline": 0.0}))
+        return "timeout", None
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    if out.returncode == 0 and lines:
+        return "ok", lines[-1]
+    print(f"bench: {platform} run failed:\n{out.stderr[-2000:]}",
+          file=sys.stderr)
+    return "failed", None
+
+
+def main() -> None:
+    import time
+    occ_med, occ_lo, occ_hi = _host_occ_tput()  # quiet host, pre-JAX
+    base_env = dict(os.environ)
+    base_env["DENEVA_HOST_OCC_TPUT"] = str(occ_med)
+    base_env["DENEVA_HOST_OCC_LO"] = str(occ_lo)
+    base_env["DENEVA_HOST_OCC_HI"] = str(occ_hi)
+
+    # TPU path: probe, then measure; one in-window retry on a wedge.
+    # The whole TPU phase (probes + children + the retry wait) spends at
+    # most the PRE-wedge-protocol worst case of 2x TIMEOUT, so the
+    # driver window the protocol exists to protect never grows: the
+    # attempt-2 child gets only the remaining budget.
+    t0 = time.monotonic()
+    budget = 2 * TIMEOUT
+    wedged = absent = False
+    for attempt in (1, 2):
+        remaining = budget - (time.monotonic() - t0)
+        if remaining < 2 * PROBE_SECS:
+            break                        # out of TPU budget: cpu line
+        probe = _probe_tunnel()
+        if probe == "tpu":
+            wedged = absent = False
+            remaining = budget - (time.monotonic() - t0)
+            status, line = _run_child("tpu", base_env,
+                                      timeout=min(TIMEOUT, remaining))
+            if line:
+                print(line)
+                return
+            if status == "timeout":
+                # the probe was healthy but the measurement child hung:
+                # a MID-RUN wedge (the round-5 failure) — mark it and
+                # let attempt 2 re-probe within the budget
+                wedged = True
+                continue
+            break     # tunnel alive but the run FAILED: a code problem —
+            #           fall through to cpu WITHOUT the wedge marker
+        if probe == "cpu":
+            # JAX answered instantly with host devices only: no chip is
+            # configured in this session (a dev container, not a wedge)
+            absent, wedged = True, False
+            print("bench: no TPU configured (probe saw cpu only)",
+                  file=sys.stderr)
+            break
+        wedged = True
+        print(f"bench: tunnel probe {attempt} wedged "
+              f"(jax.devices() > {PROBE_SECS}s)", file=sys.stderr)
+        if attempt == 1:
+            time.sleep(PROBE_RETRY_WAIT)
+
+    cpu_env = dict(base_env)
+    cpu_env["PYTHONPATH"] = ""          # skip axon sitecustomize
+    cpu_env["JAX_PLATFORMS"] = "cpu"
+    _, line = _run_child("cpu", cpu_env)
+    rec = json.loads(line) if line else {
+        "metric": "ycsb_zipf0.9_committed_txns_per_sec",
+        "value": 0.0, "unit": "txn/s", "vs_baseline": 0.0}
+    if wedged or absent:
+        # structured provenance instead of a bare cpu-fallback line: the
+        # driver record says WHY the number is a cpu number and where
+        # the newest believable chip number lives
+        rec["tunnel_wedged"] = wedged
+        if absent:
+            rec["chip_absent"] = True
+        chip = _newest_chip_measurement()
+        if chip:
+            rec["last_chip_file"], rec["last_chip_value"] = chip
+    print(json.dumps(rec))
 
 
 if __name__ == "__main__":

@@ -17,7 +17,8 @@ from typing import Any, Callable
 
 from deneva_tpu.config import CCAlg, Config
 from deneva_tpu.cc.base import (AccessBatch, Incidence, Verdict,  # noqa: F401
-                                build_conflict_incidence, build_incidence)
+                                build_conflict_incidence, build_incidence,
+                                gate_order_free)
 from deneva_tpu.cc.calvin import validate_calvin, validate_tpu_batch
 from deneva_tpu.cc.maat import validate_maat
 from deneva_tpu.cc.nocc import validate_nocc
@@ -40,11 +41,13 @@ class CCBackend:
     # workloads the whole batch commits with reads forwarded in-batch —
     # no conflict matrix at all; chained path is the fallback otherwise
     forward: bool = False
-    # deterministic batch executors may EXCLUDE accesses the workload
-    # marks ``order_free`` from conflict detection (escrow/commutative
-    # semantics: scatter-add updates and immutable-column reads need no
-    # ordering; the executor applies them order-exactly).  Lock/ts-based
-    # baselines keep the reference's row-level conflicts.
+    # the backend may EXCLUDE accesses the workload marks ``order_free``
+    # from conflict detection (escrow/commutative semantics: scatter-add
+    # deltas and immutable-column reads need no ordering; the executor
+    # applies deltas order-exactly over every committed winner).  Opted
+    # in per backend; the sweep backends' opt-in is additionally gated
+    # by ``Config.escrow_sweep`` (cc.base.gate_order_free) so the
+    # reference-faithful row-level-conflict baseline stays one flag away.
     exempt_order_free: bool = False
     # distributed VOTE protocol hook: apply cross-epoch state for the
     # GLOBALLY decided commit set (local validation's state output is
@@ -57,15 +60,28 @@ _NO_STATE = lambda cfg: ()  # noqa: E731
 _REGISTRY: dict[CCAlg, CCBackend] = {
     CCAlg.NOCC: CCBackend(CCAlg.NOCC, validate_nocc, _NO_STATE,
                           needs_incidence=False),
-    CCAlg.NO_WAIT: CCBackend(CCAlg.NO_WAIT, validate_no_wait, _NO_STATE),
+    # the six sweep backends opt into the escrow exemption (gated by
+    # escrow_order_free AND escrow_sweep): their edge derivations draw
+    # from the ordered incidence views, so commutative hot-row updates
+    # (TPC-C Payment's W_YTD/D_YTD, PPS PART_AMOUNT) commit many winners
+    # per epoch instead of ~1 — the reference's per-row latch serializes
+    # them within the window (row_lock.cpp:86-151) where epoch-snapshot
+    # validation used to admit a single winner and abort-storm the rest
+    CCAlg.NO_WAIT: CCBackend(CCAlg.NO_WAIT, validate_no_wait, _NO_STATE,
+                             exempt_order_free=True),
     CCAlg.WAIT_DIE: CCBackend(CCAlg.WAIT_DIE, validate_wait_die, _NO_STATE,
-                              fresh_ts_on_restart=False),
-    CCAlg.OCC: CCBackend(CCAlg.OCC, validate_occ, _NO_STATE),
+                              fresh_ts_on_restart=False,
+                              exempt_order_free=True),
+    CCAlg.OCC: CCBackend(CCAlg.OCC, validate_occ, _NO_STATE,
+                         exempt_order_free=True),
     CCAlg.TIMESTAMP: CCBackend(CCAlg.TIMESTAMP, validate_timestamp,
-                               init_to_state, commit_state=commit_to_state),
+                               init_to_state, commit_state=commit_to_state,
+                               exempt_order_free=True),
     CCAlg.MVCC: CCBackend(CCAlg.MVCC, validate_mvcc, init_mvcc_state,
-                          commit_state=commit_to_state),
-    CCAlg.MAAT: CCBackend(CCAlg.MAAT, validate_maat, _NO_STATE),
+                          commit_state=commit_to_state,
+                          exempt_order_free=True),
+    CCAlg.MAAT: CCBackend(CCAlg.MAAT, validate_maat, _NO_STATE,
+                          exempt_order_free=True),
     # forward=True: on blind-write workloads (YCSB) the forwarding
     # executor is the closed form of the reference Calvin's RFWD dirty-
     # read forwarding — the whole batch commits whatever the chain depth,

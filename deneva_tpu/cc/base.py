@@ -14,9 +14,13 @@ the batch into commit / abort / defer plus a serialization order and an
 execution wavefront level:
 
 * ``order`` — total serialization order among committed txns; duplicate
-  committed writes to one slot are resolved to the max-order writer
+  committed VALUE writes to one slot are resolved to the max-order writer
   (`deneva_tpu.ops.scatter.last_writer`), the batch analogue of the
-  reference applying writes serially under latches.
+  reference applying writes serially under latches.  Escrow (order_free)
+  writes are DELTAS, not values: the executors accumulate them over ALL
+  committed winners (`DeviceTable.scatter_add`), which is order-invariant
+  — the multi-winner commit path that lets many escrow writers of one hot
+  row commit in a single epoch.
 * ``level`` — sub-round index for algorithms that *chain* intra-epoch
   read-after-write dataflow (Calvin, TPU_BATCH): level-l reads observe
   writes of levels < l.  Algorithms whose committed sets are
@@ -29,9 +33,13 @@ execution wavefront level:
 
 Verdict invariants (asserted in tests): commit/abort/defer are disjoint,
 cover ``active``, and the committed set is serializable — for level-0
-algorithms it is RW/WR/(RMW)WW-conflict-free under ``order``; for chained
-algorithms each level is conflict-free and edges only point to lower
-levels.
+algorithms it is RW/WR/(RMW)WW-conflict-free under ``order`` over its
+ORDERED accesses; for chained algorithms each level is conflict-free and
+edges only point to lower levels.  Escrow (``order_free``) accesses are
+exempt from the conflict-freedom claim by design: their writes are
+commutative deltas whose accumulated sum is order-invariant, so
+serializability holds modulo commutativity (oracle: accumulator sums vs
+serial, `tests/test_escrow.py`).
 """
 
 from __future__ import annotations
@@ -76,6 +84,13 @@ class AccessBatch:
     # read validation (MVCC's ro fast path) — the unmasked plan's mask
     # rides here so every node classifies identically.
     ro_hint: jax.Array | None = None
+    # bool[B, A] | None: escrow/commutative accesses (workload
+    # ``order_free`` declarations, PRE-GATED by ``gate_order_free`` —
+    # None whenever the backend or config declines the exemption, so a
+    # None here reproduces the pre-escrow semantics bit for bit).  The
+    # T/O family consumes it directly for its cross-epoch watermark
+    # rules; the incidence builder consumes it for the ordered views.
+    order_free: jax.Array | None = None
 
     @property
     def shape(self) -> tuple[int, int]:
@@ -85,7 +100,7 @@ class AccessBatch:
 jax.tree_util.register_dataclass(
     AccessBatch,
     data_fields=["table_ids", "keys", "is_read", "is_write", "valid",
-                 "ts", "rank", "active", "ro_hint"],
+                 "ts", "rank", "active", "ro_hint", "order_free"],
     meta_fields=[],
 )
 
@@ -125,13 +140,42 @@ class Incidence:
     # per-access bucket ids in family 0 (for ts-table gathers/scatters)
     bucket1: jax.Array     # int32[B, A]
     # ordered-union incidence: accesses NOT marked order_free.  The
-    # deterministic executors draw conflict edges from overlap(uo, w) —
-    # a pair conflicts iff it overlaps AND at least one side needs
-    # ordering — so escrow add-add pairs carry no edge while reads of
-    # the same accumulators still order against every write.  Equals
-    # u1/u2 when no exemption applies.
+    # backends that honor the escrow exemption draw conflict edges from
+    # overlap(uo, w) — a pair conflicts iff it overlaps AND at least one
+    # side needs ordering — so escrow add-add pairs carry no edge while
+    # reads of the same accumulators still order against every write.
+    # Equals u1/u2 when no exemption applies.
     uo1: jax.Array | None = None
     uo2: jax.Array | None = None
+    # ordered read / write / pure-read incidence (r/w/pr minus the
+    # order_free accesses): the sweep backends' escrow-aware edge inputs
+    # — T/O reader-wait edges come from overlap(ro, w), the relaxed-
+    # isolation WW lock edges from overlap(wo, w), READ_COMMITTED's
+    # residual read locks from overlap(pro, w).  ALIASES of r/w/pr when
+    # no exemption applies (zero extra memory or matmuls).
+    ro1: jax.Array | None = None
+    ro2: jax.Array | None = None
+    wo1: jax.Array | None = None
+    wo2: jax.Array | None = None
+    pro1: jax.Array | None = None
+    pro2: jax.Array | None = None
+
+
+def gate_order_free(cfg, be, order_free: jax.Array | None
+                    ) -> jax.Array | None:
+    """The ONE escrow gate: returns the workload's ``order_free`` mask iff
+    this backend may consume it, else None (pre-escrow semantics, bit for
+    bit).  Chained/deterministic backends gate on ``escrow_order_free``
+    alone (their exemption shipped rounds ago); the sweep backends
+    additionally require ``escrow_sweep`` so the reference-faithful
+    baseline (per-row conflicts, the TPC-C hot-row floor) stays one flag
+    away."""
+    if order_free is None or not be.exempt_order_free \
+            or not cfg.escrow_order_free:
+        return None
+    if not be.chained and not cfg.escrow_sweep:
+        return None
+    return order_free
 
 
 def build_conflict_incidence(cfg, be, batch: AccessBatch,
@@ -142,8 +186,7 @@ def build_conflict_incidence(cfg, be, batch: AccessBatch,
     distributed server step so their conflict semantics cannot diverge."""
     if not be.needs_incidence:
         return None
-    if not be.exempt_order_free or not cfg.escrow_order_free:
-        order_free = None
+    order_free = gate_order_free(cfg, be, order_free)
     return build_incidence(batch, cfg.conflict_buckets, cfg.conflict_exact,
                            order_free=order_free)
 
@@ -158,23 +201,26 @@ def build_incidence(batch: AccessBatch, n_buckets: int, exact: bool,
     v = batch.valid & batch.active[:, None]
     rmask = v & batch.is_read
     wmask = v & batch.is_write
-    omask = (rmask | wmask) if order_free is None \
-        else (rmask | wmask) & ~order_free
+    prmask = rmask & ~wmask
     b1 = bucket_hash(ident, n_buckets, family=0)
-    r1 = shard_buckets(access_incidence(b1, rmask, n_buckets))
-    w1 = shard_buckets(access_incidence(b1, wmask, n_buckets))
-    u1 = shard_buckets(access_incidence(b1, rmask | wmask, n_buckets))
-    pr1 = shard_buckets(access_incidence(b1, rmask & ~wmask, n_buckets))
-    uo1 = u1 if order_free is None \
-        else shard_buckets(access_incidence(b1, omask, n_buckets))
-    r2 = w2 = u2 = pr2 = uo2 = None
+
+    def family(b):
+        inc = lambda m: shard_buckets(access_incidence(b, m, n_buckets))  # noqa: E731
+        r, w = inc(rmask), inc(wmask)
+        u, pr = inc(rmask | wmask), inc(prmask)
+        if order_free is None:
+            # aliases: escrow off (or nothing declared) costs nothing and
+            # the ordered views are bitwise the plain ones
+            return r, w, u, pr, u, r, w, pr
+        of = ~order_free
+        return (r, w, u, pr, inc((rmask | wmask) & of), inc(rmask & of),
+                inc(wmask & of), inc(prmask & of))
+
+    r1, w1, u1, pr1, uo1, ro1, wo1, pro1 = family(b1)
+    r2 = w2 = u2 = pr2 = uo2 = ro2 = wo2 = pro2 = None
     if exact:
         b2 = bucket_hash(ident, n_buckets, family=1)
-        r2 = shard_buckets(access_incidence(b2, rmask, n_buckets))
-        w2 = shard_buckets(access_incidence(b2, wmask, n_buckets))
-        u2 = shard_buckets(access_incidence(b2, rmask | wmask, n_buckets))
-        pr2 = shard_buckets(access_incidence(b2, rmask & ~wmask, n_buckets))
-        uo2 = u2 if order_free is None \
-            else shard_buckets(access_incidence(b2, omask, n_buckets))
+        r2, w2, u2, pr2, uo2, ro2, wo2, pro2 = family(b2)
     return Incidence(r1=r1, w1=w1, u1=u1, pr1=pr1, r2=r2, w2=w2, u2=u2,
-                     pr2=pr2, bucket1=b1, uo1=uo1, uo2=uo2)
+                     pr2=pr2, bucket1=b1, uo1=uo1, uo2=uo2, ro1=ro1,
+                     ro2=ro2, wo1=wo1, wo2=wo2, pro1=pro1, pro2=pro2)

@@ -84,9 +84,22 @@ def must_precede(cfg, inc: Incidence, b: int):
     minus the RMW self-overlap diagonal.  The ONE edge derivation shared
     by validate_maat and the distributed verify round
     (runtime/server.make_vote_steps.check): the verify round must check
-    exactly the edge set the positions were negotiated for."""
+    exactly the edge set the positions were negotiated for.
+
+    Escrow (``order_free``) exemption, gated by ``escrow_order_free``
+    AND ``escrow_sweep``: the reader side draws from the ORDERED read
+    incidence (ro aliases r when off).  Escrow writes are commutative
+    deltas — like blind writes they need no range constraint among
+    themselves (any linear extension accumulates the same sum) — and
+    escrow reads are declared-immutable columns, so a TPC-C Payment
+    epoch contributes NO must-precede edges: the warehouse-row RMW
+    clique that used to close m*(m-1)/2 ranges per epoch vanishes,
+    while an ordered read of the accumulator still precedes every
+    uncommitted delta writer exactly as before."""
     ov = get_overlap(cfg)
-    p = ov(inc.r1, inc.w1, inc.r2, inc.w2)
+    ro1 = inc.r1 if inc.ro1 is None else inc.ro1
+    ro2 = inc.r2 if inc.ro1 is None else inc.ro2
+    p = ov(ro1, inc.w1, ro2, inc.w2)
     return p & ~jnp.eye(b, dtype=bool)
 
 

@@ -19,6 +19,16 @@ is the lex-first MIS sweep over the *directed* U-vs-W overlap.
 Like the reference's central validation, the whole epoch validates in one
 place — except "one place" is the MXU, and the critical section is a
 matmul instead of a semaphore.
+
+Escrow (``order_free``) exemption, gated by ``escrow_order_free`` AND
+``escrow_sweep``: a txn's escrow accesses leave its validated set —
+``W_j ∩ (R_i ∪ W_i)`` is tested against the ORDERED union ``uo_i`` (the
+coarse-granularity false-abort class of arXiv:1811.04967: commutative
+deltas against one hot record are not read-write conflicts) — while j's
+write set stays FULL, so an ordered read of an accumulator still
+invalidates against every admitted add.  Add-add pairs carry no edge and
+the executor accumulates all their deltas.  With the gate off ``uo``
+aliases ``u`` and validation is bit-identical to Kung-Robinson.
 """
 
 from __future__ import annotations
@@ -30,9 +40,14 @@ from deneva_tpu.ops import earlier_edges, greedy_first_fit
 
 
 def validate_occ(cfg, state, batch: AccessBatch, inc: Incidence):
-    # directed: my accesses vs their writes (their reads never invalidate me)
+    # directed: my ORDERED accesses vs their writes (their reads never
+    # invalidate me; my escrow deltas commute with their writes' deltas
+    # and an ordered write of theirs on the same key appears in their uo
+    # for the mirrored pair, which earlier_edges then directs)
     ov = get_overlap(cfg)
-    uw = ov(inc.u1, inc.w1, inc.u2, inc.w2)
+    uo1 = inc.u1 if inc.uo1 is None else inc.uo1
+    uo2 = inc.u2 if inc.uo1 is None else inc.uo2
+    uw = ov(uo1, inc.w1, uo2, inc.w2)
     e = earlier_edges(uw, batch.rank, batch.active)
     win, lose, und = greedy_first_fit(e, batch.active, rounds=cfg.sweep_rounds)
     v = Verdict(commit=win, abort=lose, defer=und,

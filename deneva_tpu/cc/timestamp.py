@@ -70,6 +70,31 @@ MVCC (multi-version) differences:
 Timestamps are epoch-fresh on restart exactly as the reference re-stamps
 restarted txns (`system/worker_thread.cpp:492-508`); deferred (waiting)
 txns keep their birth ts like the reference's parked requests.
+
+Escrow (``order_free``) rules, gated by ``escrow_order_free`` AND
+``escrow_sweep`` (``batch.order_free`` arrives pre-gated — None gives
+bit-identical pre-escrow behavior).  An escrow WRITE is a commutative
+delta: deltas reorder freely among themselves (their sum is
+order-invariant — the escrow guarantee of O'Neil's escrow method /
+DGCC's commutative decomposition, arXiv:1503.03642), so
+* escrow writes skip the ``wts > ts`` check — an older delta landing
+  after a newer delta is not a violation — but KEEP the ``rts > ts``
+  check: a committed ORDERED read at higher ts already fixed the
+  accumulator value it observed, and a delta slotting before it in ts
+  order would invalidate that read;
+* escrow writes still RECORD ``wts`` so later ordered readers at lower
+  ts correctly abort (they missed a delta in their ts-past);
+* escrow READS (declared immutable columns) check nothing and record no
+  ``rts`` — a false rts from the accumulator's row bucket would
+  re-floor the adds.  Intra-epoch reader-wait edges likewise come from
+  the ORDERED read incidence (`overlap(ro, w)`).
+Consequence stated honestly: escrow deltas serialize in COMMIT order,
+not ts order (two deltas committed in different epochs apply in epoch
+order however their ts compare).  Sums, D_NEXT_O_ID uniqueness/density
+and every ordered read stay exact — the equivalence is modulo
+commutativity, which is the escrow contract.  Workloads must not mix
+ordered writes into order_free columns (none do; the executors apply
+deltas unconditionally).
 """
 
 from __future__ import annotations
@@ -148,7 +173,8 @@ def _readonly(batch: AccessBatch) -> jax.Array:
 
 def _watermark_aborts(cfg, state, batch: AccessBatch,
                       mvcc: bool) -> jax.Array:
-    """bool[B]: txn violates a cross-epoch watermark."""
+    """bool[B]: txn violates a cross-epoch watermark (escrow accesses
+    follow the relaxed rules in the module docstring)."""
     wm = _wm_bucket(cfg, batch)
     v = batch.valid & batch.active[:, None]
     wts_at = jnp.take(state.wts, wm)                   # [B, A]
@@ -165,7 +191,15 @@ def _watermark_aborts(cfg, state, batch: AccessBatch,
                         | (rmw & (wts_at > ts)))
     else:
         read_bad = v & batch.is_read & (wts_at > ts)
-    write_bad = v & batch.is_write & ((rts_at > ts) | (wts_at > ts))
+    if batch.order_free is None:
+        write_bad = v & batch.is_write & ((rts_at > ts) | (wts_at > ts))
+    else:
+        # escrow reads check nothing; escrow writes (deltas) check only
+        # rts — deltas commute with prior deltas, never with a committed
+        # ordered read whose ts-past they would rewrite
+        read_bad = read_bad & ~batch.order_free
+        write_bad = v & batch.is_write & jnp.where(
+            batch.order_free, rts_at > ts, (rts_at > ts) | (wts_at > ts))
     bad = (read_bad | write_bad).any(axis=1)
     if mvcc:
         bad = bad & ~_readonly(batch)       # read-only: snapshot
@@ -173,8 +207,12 @@ def _watermark_aborts(cfg, state, batch: AccessBatch,
 
 
 def _rw_later_reader_edges(cfg, batch: AccessBatch, inc: Incidence):
-    """E[i,j]: reader i (by ts) ordered after writer j on a common key."""
-    rw = get_overlap(cfg)(inc.r1, inc.w1, inc.r2, inc.w2)       # i reads ∩ j writes
+    """E[i,j]: ORDERED reader i (by ts) after writer j on a common key
+    (ro aliases r when no escrow exemption applies: declared-immutable
+    column reads never wait behind the row's delta writers)."""
+    ro1 = inc.r1 if inc.ro1 is None else inc.ro1
+    ro2 = inc.r2 if inc.ro1 is None else inc.ro2
+    rw = get_overlap(cfg)(ro1, inc.w1, ro2, inc.w2)    # i reads ∩ j writes
     return earlier_edges(rw, batch.ts, batch.active)   # j earlier by ts
 
 
@@ -182,7 +220,12 @@ def _commit_watermarks(cfg, state, batch: AccessBatch,
                        commit: jax.Array):
     v = batch.valid & commit[:, None]
     ts = jnp.broadcast_to(batch.ts[:, None], batch.keys.shape)
-    r_ts = jnp.where(v & batch.is_read, ts, 0)
+    # escrow reads record no rts (immutable columns; a false rts from
+    # the row's shared bucket would abort the row's own deltas); escrow
+    # WRITES still record wts so stale ordered readers abort
+    r_rec = v & batch.is_read if batch.order_free is None \
+        else v & batch.is_read & ~batch.order_free
+    r_ts = jnp.where(r_rec, ts, 0)
     w_ts = jnp.where(v & batch.is_write, ts, 0)
     flat = _wm_bucket(cfg, batch).reshape(-1)
     rts = state.rts.at[flat].max(r_ts.reshape(-1))

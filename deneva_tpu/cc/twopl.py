@@ -40,6 +40,19 @@ requests conflict, exactly mirroring the reference's per-level gating:
 Each level's edge set is a subset of the previous, so throughput is
 monotone in the isolation ladder — the shape `experiments.py`'s
 isolation_levels sweep exists to show.
+
+Escrow (``order_free``) exemption, gated by ``escrow_order_free`` AND
+``escrow_sweep``: lock requests for commutative accumulator updates and
+immutable-column reads need no lock at all — the reference analogue is
+escrow locking (O'Neil) layered under 2PL, where increment locks are
+mutually compatible.  Edges therefore come from the ORDERED incidence
+views: a pair conflicts iff it overlaps and at least one side's access
+is ordered — symmetrizing ``overlap(uo, w)`` (SERIALIZABLE),
+``overlap(wo, w)`` (WW), and directing ``overlap(pro, w)`` (RC's
+residual read locks) — so Payment add-add pairs on one warehouse row
+all acquire their "increment locks" together, while an ordered read of
+W_YTD still contends with every add.  With the gate off the views alias
+r/w/pr and the edges are bit-identical to the pre-escrow derivation.
 """
 
 from __future__ import annotations
@@ -53,21 +66,31 @@ from deneva_tpu.ops import earlier_edges, greedy_first_fit
 
 def _lock_edges(cfg, batch: AccessBatch, inc: Incidence):
     """Directed blocked-by edges E[i,j] ("earlier j blocks i") under the
-    configured isolation level; None means no locking at all (NOLOCK)."""
+    configured isolation level; None means no locking at all (NOLOCK).
+    Ordered incidence views (uo/wo/pro — alias u/w/pr when no escrow
+    exemption applies) keep escrow add-add pairs edge-free."""
     iso = cfg.isolation_level
     ov = get_overlap(cfg)
     if iso == "NOLOCK":
         return None
+    uo1 = inc.u1 if inc.uo1 is None else inc.uo1
+    uo2 = inc.u2 if inc.uo1 is None else inc.uo2
     if iso == "SERIALIZABLE":
-        uw = ov(inc.u1, inc.w1, inc.u2, inc.w2)
+        # symmetrized ordered-vs-write overlap: a pair conflicts iff at
+        # least one side's ORDERED access meets the other's write
+        uw = ov(uo1, inc.w1, uo2, inc.w2)
         return earlier_edges(uw | uw.T, batch.rank, batch.active)
-    ww = ov(inc.w1, inc.w1, inc.w2, inc.w2)
+    wo1 = inc.w1 if inc.wo1 is None else inc.wo1
+    wo2 = inc.w2 if inc.wo1 is None else inc.wo2
+    ww = ov(wo1, inc.w1, wo2, inc.w2)
     e = earlier_edges(ww | ww.T, batch.rank, batch.active)
     if iso == "READ_COMMITTED":
-        # i's pure read contends with an earlier writer j of the same key;
-        # the reverse direction (writer behind reader) is gone — the read
-        # lock is already released by the time the writer asks.
-        prw = ov(inc.pr1, inc.w1, inc.pr2, inc.w2)
+        # i's ordered pure read contends with an earlier writer j of the
+        # same key; the reverse direction (writer behind reader) is gone —
+        # the read lock is already released by the time the writer asks.
+        pro1 = inc.pr1 if inc.pro1 is None else inc.pro1
+        pro2 = inc.pr2 if inc.pro1 is None else inc.pro2
+        prw = ov(pro1, inc.w1, pro2, inc.w2)
         e = e | earlier_edges(prw, batch.rank, batch.active)
     return e
 

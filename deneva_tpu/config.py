@@ -226,12 +226,26 @@ class Config:
     mvcc_his_len: int = 4          # in-state version history depth (HIS_RECYCLE_LEN analogue)
     escrow_order_free: bool = True  # honor workload order_free (escrow/
     #                                 commutative) declarations in the
-    #                                 deterministic backends' conflict
-    #                                 graphs; False = ablation: TPU_BATCH/
-    #                                 CALVIN see the full RW-sets like the
-    #                                 lock/ts baselines (separates the
+    #                                 backends' conflict graphs; False =
+    #                                 ablation: every backend sees the
+    #                                 full RW-sets (separates the
     #                                 algorithm win from the annotation win
     #                                 in TPC-C/PPS numbers)
+    escrow_sweep: bool = True      # extend the escrow exemption to the six
+    #                                SWEEP backends (NO_WAIT/WAIT_DIE/OCC/
+    #                                TIMESTAMP/MVCC/MAAT): conflict edges
+    #                                come from the ordered incidence views
+    #                                (escrow add-add pairs carry no edge;
+    #                                accumulator READS still order against
+    #                                every add) and the T/O watermarks
+    #                                apply the escrow check/record rules
+    #                                (cc/timestamp.py).  False = the
+    #                                reference-faithful baseline: row-level
+    #                                conflicts, ~1 hot-row winner per epoch
+    #                                (the TPC-C 4-warehouse Payment floor).
+    #                                Chained backends ignore this flag
+    #                                (their exemption is escrow_order_free
+    #                                alone, as before).
     seq_batch_timer_us: float = 5000.0  # Calvin epoch cadence (config.h:348)
 
     # ---- device mesh ----

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from deneva_tpu.cc import (AccessBatch, build_conflict_incidence,
-                           get_backend)
+                           gate_order_free, get_backend)
 from deneva_tpu.config import Config, Mode
 from deneva_tpu.engine.pool import PoolState, TxnPool
 from deneva_tpu.ops import (forward_verdict, forwarding_applies,
@@ -183,14 +183,17 @@ class Engine:
         sel = (lambda v: v) if self.pool.full_pool \
             else (lambda v: jnp.take(v, slots))
 
-        # 3. plan RW-sets
+        # 3. plan RW-sets (order_free rides the batch pre-gated so the
+        # incidence builder and the T/O watermark rules cannot disagree)
         planned = wl.plan(state.db, queries)
         batch = AccessBatch(
             table_ids=planned["table_ids"], keys=planned["keys"],
             is_read=planned["is_read"], is_write=planned["is_write"],
             valid=planned["valid"],
             ts=sel(pool.ts), rank=sel(pool.seq),
-            active=active)
+            active=active,
+            order_free=gate_order_free(cfg, be,
+                                       planned.get("order_free")))
 
         # 4. validate
         forwarding = forwarding_applies(be, wl) and cfg.mode == Mode.NORMAL
@@ -221,7 +224,7 @@ class Engine:
             cc_state = state.cc_state
         else:
             inc = build_conflict_incidence(cfg, be, batch,
-                                           planned.get("order_free"))
+                                           batch.order_free)
             verdict, cc_state = be.validate(cfg, state.cc_state, batch, inc)
         # defer budget (defer_rounds_max, WAIT_DIE-style wait timeout): a
         # txn deferred past the budget force-restarts with fresh ts +

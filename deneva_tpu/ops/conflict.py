@@ -138,31 +138,6 @@ def wavefront_levels(edges: jax.Array, max_level: int
     return jnp.minimum(lv, max_level), lv > max_level
 
 
-def weighted_levels(prec: jax.Array, strict: jax.Array, active: jax.Array,
-                    rounds: int) -> tuple[jax.Array, jax.Array]:
-    """Max-plus longest-path levels with {0,1} edge weights.
-
-    prec: bool[B, B] acyclic must-precede digraph (P[i, j] = i before j);
-    strict: bool[B, B] subset of prec whose edges cost +1 level (the
-    read-after-write visibility constraints); 0-weight edges only order
-    within a level.  ``rounds`` relaxation sweeps compute exact levels for
-    every node whose longest incoming *path* (in edges, any weight) is
-    < rounds.  Soundness contract: callers must only trust levels of txns
-    whose unweighted `precedence_levels` depth is below ``rounds`` (its
-    ``unstable`` mask enforces exactly that) — an under-relaxed level
-    could seat a reader beside an unseen writer.
-    """
-    p = prec & active[:, None] & active[None, :]
-    w = jnp.where(p & strict, 1, 0)
-    lv = jnp.zeros(active.shape, jnp.int32)
-
-    def body(_, lv):
-        cand = jnp.where(p, lv[:, None] + w, -1)
-        return jnp.maximum(lv, cand.max(axis=0))
-
-    return jax.lax.fori_loop(0, rounds, body, lv)
-
-
 def precedence_levels(prec: jax.Array, active: jax.Array, rounds: int
                       ) -> tuple[jax.Array, jax.Array]:
     """Longest-path levels of a *possibly cyclic* must-precede digraph.

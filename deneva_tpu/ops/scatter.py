@@ -1,11 +1,27 @@
-"""Duplicate-scatter resolution.
+"""Duplicate-scatter resolution — the VALUE half of the delta-vs-value
+write split.
 
-When several committed transactions in one epoch write the same slot
-(allowed under the ts-ordered algorithms — T/O's Thomas-rule writes, MVCC,
-MAAT, Calvin), the batch must apply exactly the write of the *latest*
-transaction in serialization order.  The reference gets this for free by
-executing serially under latches (`storage/row.cpp:351-420`); here it is a
-scatter-max tournament.
+Committed writes apply in one of two ways:
+
+* **Value writes** (ordered): when several committed transactions in one
+  epoch write the same slot (allowed under the ts-ordered algorithms —
+  T/O's Thomas-rule writes, MVCC, MAAT, Calvin), the batch must apply
+  exactly the write of the *latest* transaction in serialization order.
+  The reference gets this for free by executing serially under latches
+  (`storage/row.cpp:351-420`); here it is the `last_writer` scatter-max
+  tournament below.
+* **Delta writes** (escrow / ``order_free``): commutative accumulator
+  updates are shipped as DELTAS and applied with a segmented scatter-add
+  over ALL committed winners (`storage.table.DeviceTable.scatter_add` —
+  `.at[slots].add`, XLA's sorted-segment sum), never through the
+  tournament: the sum is order-invariant, so N escrow writers of one hot
+  row all commit in the same epoch with serializable results.  This is
+  what un-floors TPC-C Payment for the sweep backends once their
+  validation stops drawing add-add edges (`cc/base.build_incidence`
+  ordered views).  A workload must never mix value writes into an
+  escrow column — the executors apply deltas unconditionally, so a
+  same-epoch value write would not see them (TPC-C/PPS keep the split
+  column-disjoint by construction).
 """
 
 from __future__ import annotations

@@ -62,7 +62,8 @@ def _make_epoch_body(cfg: Config, wl, be):
 
     import dataclasses as _dc
 
-    from deneva_tpu.cc import AccessBatch, build_conflict_incidence
+    from deneva_tpu.cc import (AccessBatch, build_conflict_incidence,
+                               gate_order_free)
     from deneva_tpu.engine.step import forced_sentinel_mask
     from deneva_tpu.ops import forward_verdict, forwarding_applies
 
@@ -76,7 +77,9 @@ def _make_epoch_body(cfg: Config, wl, be):
         batch = AccessBatch(
             table_ids=planned["table_ids"], keys=planned["keys"],
             is_read=planned["is_read"], is_write=planned["is_write"],
-            valid=planned["valid"], ts=ts, rank=rank, active=active)
+            valid=planned["valid"], ts=ts, rank=rank, active=active,
+            order_free=gate_order_free(cfg, be,
+                                       planned.get("order_free")))
         forced = forced_sentinel_mask(batch) if cfg.ycsb_abort_mode else None
         if forwarding:
             fbatch = batch if forced is None else _dc.replace(
@@ -95,7 +98,7 @@ def _make_epoch_body(cfg: Config, wl, be):
                             fwd_rank=fwd)
         else:
             inc = build_conflict_incidence(cfg, be, batch,
-                                           planned.get("order_free"))
+                                           batch.order_free)
             verdict, cc_state = be.validate(cfg, cc_state, batch, inc)
             if forced is not None:
                 forced = forced & ~(verdict.abort | verdict.defer)
@@ -256,7 +259,8 @@ def make_vote_steps(cfg: Config, wl, be):
     import jax
     import jax.numpy as jnp
 
-    from deneva_tpu.cc import AccessBatch, build_conflict_incidence
+    from deneva_tpu.cc import (AccessBatch, build_conflict_incidence,
+                               gate_order_free)
 
     b = max(1, cfg.epoch_batch // cfg.node_cnt) * cfg.node_cnt
     me = cfg.node_id
@@ -272,7 +276,12 @@ def make_vote_steps(cfg: Config, wl, be):
         batch = AccessBatch(
             table_ids=planned["table_ids"], keys=planned["keys"],
             is_read=planned["is_read"], is_write=planned["is_write"],
-            valid=owned, ts=ts, rank=rank, active=active, ro_hint=ro)
+            valid=owned, ts=ts, rank=rank, active=active, ro_hint=ro,
+            # per-access flags, so the owner mask composes: each owner
+            # exempts exactly its owned escrow accesses (and advances
+            # its LOCAL watermarks with the same rules at commit)
+            order_free=gate_order_free(cfg, be,
+                                       planned.get("order_free")))
         return batch, planned
 
     def global_order(batch):
@@ -291,8 +300,7 @@ def make_vote_steps(cfg: Config, wl, be):
     @jax.jit
     def vote(db, cc_state, query, active, ts):
         batch, planned = local_batch(db, query, active, ts)
-        inc = build_conflict_incidence(cfg, be, batch,
-                                       planned.get("order_free"))
+        inc = build_conflict_incidence(cfg, be, batch, batch.order_free)
         verdict, _ = be.validate(cfg, cc_state, batch, inc)
         # MAAT lower bound = local serialization position (order packs
         # position * b + lane; undo the lane)
@@ -310,8 +318,7 @@ def make_vote_steps(cfg: Config, wl, be):
         decides exactly like merged mode."""
         from deneva_tpu.cc.maat import must_precede
         batch, planned = local_batch(db, query, cand, ts)
-        inc = build_conflict_incidence(cfg, be, batch,
-                                       planned.get("order_free"))
+        inc = build_conflict_incidence(cfg, be, batch, batch.order_free)
         p = must_precede(cfg, inc, b)
         p = p & cand[:, None] & cand[None, :]
         # order values are distinct (lane tiebreak), so >= means >

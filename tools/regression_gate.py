@@ -1,8 +1,10 @@
-"""Throughput regression gate over committed sweep results.
+"""Throughput + measurement-health regression gate over committed sweep
+results.
 
 Usage:
   python tools/regression_gate.py capture   # results/ -> results/expected.json
   python tools/regression_gate.py check     # fail if tput regressed
+  python tools/regression_gate.py check --no-runtime   # tput only
 
 ``check`` compares every point present in both the live results tree and
 the committed expectation table; a point regresses when its measured
@@ -10,6 +12,16 @@ tput falls below ``(1 - tolerance)`` of the expectation.  Missing points
 warn (sweeps are allowed to grow); new points pass.  This is the
 round-over-round guard VERDICT round-1 #10 asked for: a later round can
 diff numbers instead of trusting prose.
+
+``check`` additionally validates MEASUREMENT HEALTH (VERDICT round-5
+weak #3 / next #4): a point whose ``total_runtime`` exceeds
+``RUNTIME_FACTOR x`` its configured bench window (the ``done_secs`` the
+file's own `# cfg` echo records) is STARVED — the host wedged or was
+descheduled mid-window, so its tput is an artifact, not a measurement
+(the shipped ycsb_inflight NO_WAIT@TIF=10000 point ran 70s against a 4s
+window and passed the old tput-only gate).  Starved points fail the
+gate regardless of their tput; re-run them via tools/rerun_starved.py
+or drop them.
 
 Tolerance default 0.35: single-chip tunnel runs show up to ~20 % run
 variance; the gate is for catching collapses (algorithmic regressions,
@@ -30,8 +42,12 @@ EXPECTED = "results/expected.json"
 SWEEPS = ("isolation_levels", "operating_points", "escrow_ablation",
           "ycsb_skew", "ycsb_writes", "ycsb_hot", "ycsb_inflight",
           "ycsb_scaling", "ycsb_partitions",
-          "tpcc_scaling", "pps_scaling", "modes", "cluster_tpu",
-          "cluster_scaling", "network_sweep")
+          "tpcc_scaling", "tpcc_escrow", "pps_scaling", "modes",
+          "cluster_tpu", "cluster_scaling", "network_sweep")
+# a measured window may overrun its spec this much (host pacing jitter +
+# the final partial chunk) before the point counts as starved
+RUNTIME_FACTOR = 2.0
+RUNTIME_SLACK_SECS = 2.0
 
 
 def live_table() -> dict[str, float]:
@@ -46,15 +62,39 @@ def live_table() -> dict[str, float]:
     return out
 
 
+def runtime_violations() -> list[tuple[str, float, float]]:
+    """(point, total_runtime, window) for every live point whose measured
+    window overran its own configured ``done_secs`` spec."""
+    out = []
+    for exp in SWEEPS:
+        d = os.path.join("results", exp)
+        if not os.path.isdir(d):
+            continue
+        for row in load_results(d):
+            rt, win = row.get("total_runtime"), row.get("done_secs")
+            if rt is None or not win:
+                continue
+            if float(rt) > RUNTIME_FACTOR * float(win) + RUNTIME_SLACK_SECS:
+                out.append((f"{exp}/{row['file']}", float(rt), float(win)))
+    return out
+
+
 def capture() -> int:
     table = live_table()
+    # never bake a starved artifact into the baseline: a 70s-window tput
+    # as the expectation would later flag the honest re-measurement as a
+    # false REGRESSION (and mask real ones until recapture)
+    starved = {key for key, _rt, _win in runtime_violations()}
+    for key in sorted(starved & table.keys()):
+        print(f"capture: skipping STARVED {key} (re-run it first)")
+        del table[key]
     with open(EXPECTED, "w") as f:
         json.dump(dict(sorted(table.items())), f, indent=1)
     print(f"captured {len(table)} points -> {EXPECTED}")
     return 0
 
 
-def check(tolerance: float = 0.35) -> int:
+def check(tolerance: float = 0.35, runtime: bool = True) -> int:
     if not os.path.exists(EXPECTED):
         print(f"no {EXPECTED}; run `capture` first")
         return 2
@@ -71,13 +111,21 @@ def check(tolerance: float = 0.35) -> int:
     for key, want, got in bad:
         print(f"REGRESSION {key}: expected >= {want * (1 - tolerance):.0f} "
               f"(baseline {want:.0f}), got {got:.0f}")
+    starved = runtime_violations() if runtime else []
+    for key, rt, win in starved:
+        print(f"STARVED {key}: total_runtime={rt:.1f}s against a "
+              f"{win:.1f}s window (> {RUNTIME_FACTOR:g}x + "
+              f"{RUNTIME_SLACK_SECS:g}s) — re-run via "
+              f"tools/rerun_starved.py or drop the point")
     if missing:
         print(f"note: {len(missing)} expected points absent from this run")
     print(f"checked {len(expected) - len(missing)} points, "
-          f"{len(bad)} regressions")
-    return 1 if bad else 0
+          f"{len(bad)} regressions, {len(starved)} starved")
+    return 1 if bad or starved else 0
 
 
 if __name__ == "__main__":
-    cmd = sys.argv[1] if len(sys.argv) > 1 else "check"
-    sys.exit(capture() if cmd == "capture" else check())
+    args = sys.argv[1:]
+    cmd = args[0] if args else "check"
+    sys.exit(capture() if cmd == "capture"
+             else check(runtime="--no-runtime" not in args))
