@@ -282,6 +282,40 @@ class Config:
     #                                reference's sequencer-vs-worker thread
     #                                decoupling, system/calvin_thread.cpp:102).
     #                                1 = retire synchronously.
+    host_overlap: str = "auto"     # cluster merged mode: run the host half
+    #                                of each epoch OFF the dispatch thread
+    #                                (the host-path pipeline).  A single
+    #                                ordered wire worker carries blob
+    #                                encode+broadcast, log-record packing +
+    #                                logger append + replica LOG_MSG sends
+    #                                (per-link FIFO preserved — one worker,
+    #                                program order); a retire worker
+    #                                prefetches each group's verdict planes
+    #                                (d2h wait + unpackbits + CL_RSP
+    #                                payloads) so retirement K groups later
+    #                                finds them ready; the device feed is
+    #                                assembled zero-copy (contributions and
+    #                                peer blobs land directly in reusable
+    #                                flat feed buffers, sends go out as
+    #                                scatter-gather parts via dt_sendv).
+    #                                "off" = the pre-pipeline serial loop:
+    #                                same admission policy, same stamping,
+    #                                same record bytes — bit-identical
+    #                                verdicts and logs (tested).  "auto"
+    #                                (default) = on unless this box's
+    #                                process count (servers + clients +
+    #                                replicas, the single-box launcher
+    #                                rig) oversubscribes its cores by
+    #                                more than one: overlap threads can
+    #                                only overlap DEVICE time if a spare
+    #                                cycle exists — measured on the
+    #                                2-core box, on wins at N<=2 procs+1
+    #                                and loses 29% at 5 procs (BASELINE
+    #                                round-7).  Multi-host fleets set
+    #                                on/off explicitly.  Vote mode
+    #                                ignores it (its epoch is a
+    #                                synchronous host round trip by
+    #                                construction).
     dist_protocol: str = "auto"    # cluster coordination for non-deterministic
     #                                backends (reference 2PC,
     #                                system/txn.cpp:498-606):
@@ -466,6 +500,8 @@ class Config:
                "smaller than one minimal message, client.py)")
         _check(self.dist_protocol in ("auto", "vote", "merged"),
                f"bad dist_protocol {self.dist_protocol!r}")
+        _check(self.host_overlap in ("auto", "on", "off"),
+               f"bad host_overlap {self.host_overlap!r}")
         if (self.logging or self.replica_cnt) and self.node_cnt > 1 \
                 and self.cc_alg not in (CCAlg.CALVIN, CCAlg.TPU_BATCH):
             _check(self.dist_protocol == "merged",

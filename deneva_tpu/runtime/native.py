@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import ctypes as C
 import os
+import struct
 import subprocess
 import threading
 
@@ -82,6 +83,9 @@ def _load() -> C.CDLL:
             lib.dt_send.restype = C.c_int
             lib.dt_send.argtypes = [C.c_void_p, C.c_uint32, C.c_uint16,
                                     C.c_void_p, C.c_uint32]
+            lib.dt_sendv.restype = C.c_int
+            lib.dt_sendv.argtypes = [C.c_void_p, C.c_uint32, C.c_uint16,
+                                     C.c_void_p, C.c_uint32]
             lib.dt_recv.restype = C.c_long
             lib.dt_recv.argtypes = [C.c_void_p, C.c_void_p, C.c_uint32,
                                     C.POINTER(C.c_uint32),
@@ -128,6 +132,37 @@ def tcp_endpoints(n_nodes: int, base_port: int = 17000,
                    for i in range(n_nodes))
 
 
+# dt_iov mirrored as a numpy record: building ONE structured array and
+# passing its base pointer costs ~1 us per sendv, where per-part ctypes
+# objects measured ~10 us each — at cluster blob sizes the wrapper
+# overhead would have eaten the copy savings
+_IOV_DT = np.dtype([("base", np.uint64), ("len", np.uint64)])
+
+
+def _iov_parts(parts) -> tuple[list, np.ndarray]:
+    """(live refs, iov record array) for ``dt_sendv``.
+
+    Accepts ``bytes``/``bytearray`` and numpy arrays (contiguified if
+    needed); the native side copies every segment into its frame before
+    returning, so the memory only has to stay alive for the call — the
+    refs list pins it that long."""
+    refs = []
+    bases = []
+    lens = []
+    for p in parts:
+        if isinstance(p, (bytes, bytearray)):
+            p = np.frombuffer(p, np.uint8)
+        elif not (isinstance(p, np.ndarray) and p.flags["C_CONTIGUOUS"]):
+            p = np.ascontiguousarray(p)
+        refs.append(p)
+        bases.append(p.__array_interface__["data"][0])
+        lens.append(p.nbytes)
+    iov = np.empty(len(refs), _IOV_DT)
+    iov["base"] = bases
+    iov["len"] = lens
+    return refs, iov
+
+
 class NativeTransport:
     """One node's handle on the mesh (reference `Transport`,
     `transport/transport.cpp:171`)."""
@@ -166,10 +201,41 @@ class NativeTransport:
              = b"") -> None:
         if isinstance(rtype, str):
             rtype = RTYPE[rtype]
-        buf = payload if isinstance(payload, bytes) else payload.tobytes()
-        rc = self._lib.dt_send(self._h, dest, rtype, buf, len(buf))
+        if isinstance(payload, bytes):
+            rc = self._lib.dt_send(self._h, dest, rtype, payload,
+                                   len(payload))
+        else:
+            # zero-copy: the native side frames from the array's memory
+            # before returning (no .tobytes() round trip)
+            a = payload if payload.flags["C_CONTIGUOUS"] \
+                else np.ascontiguousarray(payload)
+            rc = self._lib.dt_send(
+                self._h, dest, rtype,
+                C.c_void_p(a.__array_interface__["data"][0]), a.nbytes)
+            del a
         if rc != 0:
             raise RuntimeError(f"send to {dest} failed")
+
+    def sendv(self, dest: int, rtype: int | str, parts) -> None:
+        """Scatter-send: the message body is the concatenation of
+        ``parts`` (bytes / numpy arrays), framed once in the native
+        layer — the Python side never builds the contiguous payload
+        (`dt_sendv`, the writev-shaped fast path)."""
+        self.sendv_many((dest,), rtype, parts)
+
+    def sendv_many(self, dests, rtype: int | str, parts) -> None:
+        """``sendv`` to several destinations: the iov table is built
+        once and reused per dest (the server's blob broadcast — N-1
+        peers, identical body)."""
+        if isinstance(rtype, str):
+            rtype = RTYPE[rtype]
+        refs, iov = _iov_parts(parts)
+        pv = C.c_void_p(iov.__array_interface__["data"][0])
+        n = len(refs)
+        for d in dests:
+            if self._lib.dt_sendv(self._h, d, rtype, pv, n) != 0:
+                raise RuntimeError(f"sendv to {d} failed")
+        del refs
 
     def recv(self, timeout_us: int = -1) -> tuple[int, str, bytes] | None:
         """(src, rtype_name, payload) or None on timeout."""
@@ -294,3 +360,49 @@ def decode_qrybatch(buf: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray,
     if rc < 0:
         raise RuntimeError("qrybatch decode failed")
     return startts, keys, types, scalars
+
+
+_QB_HDR = struct.Struct("<III")
+
+
+def decode_qrybatch_into(buf: bytes, offset: int, startts: np.ndarray,
+                         keys: np.ndarray, types: np.ndarray,
+                         scalars: np.ndarray) -> int:
+    """Decode wire bytes (starting at ``offset`` into ``buf``) DIRECTLY
+    into caller-provided C-contiguous row views — the zero-copy feed
+    assembly path: a peer's contribution lands straight in the stacked
+    device-feed slice instead of round-tripping through fresh arrays
+    plus a copy.  The views' leading dimension is the capacity; rows
+    past the decoded count are left untouched.  Returns n decoded.
+
+    The header is parsed here (the shape checks below MUST precede the
+    native write — the C side only caps the keys array), so the decode
+    is a single native call."""
+    lib = _load()
+    if len(buf) - offset < _QB_HDR.size:
+        raise RuntimeError("qrybatch decode failed (truncated)")
+    N, W, S = _QB_HDR.unpack_from(buf, offset)
+    need = 12 + N * 8 + N * W * 4 + N * W + N * S * 4
+    if len(buf) - offset < need:
+        raise RuntimeError("qrybatch decode failed (truncated)")
+    for arr, want_minor, name in ((startts, 1, "startts"), (keys, W, "keys"),
+                                  (types, W, "types"),
+                                  (scalars, S, "scalars")):
+        minor = arr.shape[1] if arr.ndim == 2 else 1
+        if not arr.flags.c_contiguous or len(arr) < N \
+                or (want_minor and minor != want_minor):
+            raise ValueError(
+                f"decode_into target {name}: need C-contiguous "
+                f"[>= {N}, {want_minor}], got {arr.shape}")
+    base = C.cast(C.c_char_p(buf), C.c_void_p).value or 0
+    ai = startts.__array_interface__["data"][0]
+    rc = lib.dt_qrybatch_decode(
+        C.c_void_p(base + offset), len(buf) - offset, None, None, None,
+        C.c_void_p(ai),
+        C.c_void_p(keys.__array_interface__["data"][0]),
+        C.c_void_p(types.__array_interface__["data"][0]),
+        C.c_void_p(scalars.__array_interface__["data"][0]) if S else None,
+        N * W)
+    if rc < 0:
+        raise RuntimeError("qrybatch decode failed")
+    return N

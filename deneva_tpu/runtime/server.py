@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
 from collections import deque
 
@@ -494,6 +495,10 @@ class ServerNode:
         # when a crashed peer rejoins and asks for epochs it missed
         self._sent_blobs: deque[tuple[int, bytes]] = deque(
             maxlen=max(64, 6 * self.C * self.K))
+        # guards REJOIN's snapshot iteration against the wire worker's
+        # concurrent appends (deque append is atomic; iteration during a
+        # mutation is not)
+        self._sent_lock = threading.Lock()
         self._resume_epoch = 0
         if cfg.recover:
             self._recover_state()
@@ -515,12 +520,47 @@ class ServerNode:
         # feed assembly run through this pool when thread_cnt > 1 —
         # numpy codecs and socket sends release the GIL, so multi-core
         # hosts overlap the codec work that binds the 1-core cluster loop
+        from concurrent.futures import ThreadPoolExecutor
         self.codec_pool = None
         if cfg.thread_cnt > 1:
-            from concurrent.futures import ThreadPoolExecutor
             self.codec_pool = ThreadPoolExecutor(
                 max_workers=cfg.thread_cnt,
                 thread_name_prefix=f"srv{self.me}-codec")
+        # host-path pipeline (host_overlap, default auto): the host half of
+        # each epoch leaves the dispatch thread.  ONE ordered wire worker
+        # carries blob encode+broadcast and log pack/append/replica sends
+        # — a single thread consuming in program order is what preserves
+        # per-link FIFO; ONE retire worker prefetches each dispatched
+        # group's verdict planes (d2h wait + unpackbits + ack payloads)
+        # so retirement K groups later collects a finished result.  All
+        # state mutation (retry queue, dedup sets, held acks) stays on
+        # the dispatch thread at the exact loop positions of the serial
+        # path, so overlap on/off produce bit-identical verdict planes
+        # and log bytes (tested).  Vote mode is excluded: its epoch needs
+        # a synchronous host round trip (prepare -> vote -> decide).
+        ov = cfg.host_overlap
+        if ov == "auto":
+            # overlap threads only overlap DEVICE time if a spare cycle
+            # exists: on the single-box launcher rig, more processes
+            # than cores+1 means they would steal dispatch cycles
+            # instead (measured: +5-10% at <=3 procs on 2 cores, -29%
+            # at 5 — BASELINE round-7)
+            procs = (self.n_srv + self.n_cl + self.n_repl)
+            ov = "on" if (os.cpu_count() or 1) + 1 >= procs else "off"
+        self._overlap = ov == "on" and not self.vote_mode
+        self.wire_pool = None
+        self.retire_pool = None
+        if self._overlap:
+            self.wire_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"srv{self.me}-wire")
+            self.retire_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix=f"srv{self.me}-retire")
+        # reusable flat feed-buffer sets (zero-copy assembly): recycled
+        # through a free list once their group retires AND its wire
+        # sends drained — device_put may alias host memory on CPU
+        # backends, and retirement (mask fetch) proves the group's
+        # computation consumed its inputs
+        self._feed_free: list[dict] = []
         if cfg.net_delay_us:
             self.tp.set_delay_us(int(cfg.net_delay_us))
         # durability (reference LOGGING + replication, SURVEY §5.4):
@@ -667,8 +707,15 @@ class ServerNode:
                     return
             self.pending.append((src, blk))
         elif rtype == "EPOCH_BLOB":
-            epoch, blk, ts = wire.decode_epoch_blob(payload)
-            self.blob_buf.setdefault(epoch, {})[src] = (blk, ts)
+            if self._overlap:
+                # keep the raw payload: collect decodes it STRAIGHT into
+                # the stacked feed slice (decode_epoch_blob_into) instead
+                # of allocating arrays here and copying again at fill
+                epoch = wire.peek_blob_epoch(payload)
+                self.blob_buf.setdefault(epoch, {})[src] = payload
+            else:
+                epoch, blk, ts = wire.decode_epoch_blob(payload)
+                self.blob_buf.setdefault(epoch, {})[src] = (blk, ts)
         elif rtype == "VOTE":
             epoch, c, a, bnd = wire.decode_vote(payload)
             self.vote_buf.setdefault(epoch, {})[src] = (c, a, bnd)
@@ -696,7 +743,9 @@ class ServerNode:
             for ep, blobs in self.blob_buf.items():
                 if ep >= e:
                     blobs.pop(src, None)
-            for ep, blob in list(self._sent_blobs):
+            with self._sent_lock:
+                retained = list(self._sent_blobs)
+            for ep, blob in retained:
                 if ep >= e:
                     self.tp.send(src, "EPOCH_BLOB", blob)
             # ANY surviving peer echoes the coordinator's announcements
@@ -839,6 +888,200 @@ class ServerNode:
                 "stamping invariant is broken")
         return block, np.concatenate(counts), ts, np.concatenate(dfcs)
 
+    # -- host-path pipeline (host_overlap): zero-copy assembly + staged
+    # host work.  Everything here is either PURE given its inputs (blob
+    # parts, record packing, plane unpacking) or runs at the exact loop
+    # position of the serial path — which is why overlap on/off produce
+    # bit-identical verdict planes and log bytes. ----------------------
+    def _feed_acquire(self) -> dict:
+        """One reusable flat feed-buffer set [C, b, ...].  Only the
+        active plane is re-zeroed here: every other lane is covered by
+        exactly one per-server slice region, which its filler either
+        overwrites or tail-zeroes (_contribution_into/_collect_into) —
+        so unfilled lanes still match the serial path's fresh np.zeros
+        buffers byte for byte without a full-buffer memset per group."""
+        if self._feed_free:
+            fs = self._feed_free.pop()
+            fs["active"].fill(False)
+            return fs
+        C, b = self.C, self.b_merged
+        return {
+            "keys": np.zeros((C, b, self._width), np.int32),
+            "types": np.zeros((C, b, self._width), np.int8),
+            "scal": np.zeros((C, b, self._n_scalars), np.int32),
+            "tags": np.zeros((C, b), np.int64),
+            "ts": np.zeros((C, b), np.int64),
+            "ts32": np.zeros((C, b), np.int32),
+            "active": np.zeros((C, b), bool),
+        }
+
+    def _contribution_into(self, epoch: int, fs: dict, i: int
+                           ) -> tuple[wire.QueryBlock, np.ndarray,
+                                      np.ndarray, np.ndarray]:
+        """``_contribution``'s admission policy (identical order and
+        stamping), writing each piece STRAIGHT into this node's slice of
+        feed row ``i`` — no ``QueryBlock.concat``, no second fill pass.
+        Returns (view block, abort_cnt, birth-ts view, defer_cnt)."""
+        lo = self.me * self.b_loc
+        keys_r, types_r = fs["keys"][i], fs["types"][i]
+        scal_r, tags_r, ts_r = fs["scal"][i], fs["tags"][i], fs["ts"][i]
+        blocks, counts, tss, abms, dfcs = self.retry.pop_ready(
+            epoch, self.b_loc)
+        if self.be.fresh_ts_on_restart:
+            # re-stamp aborted retries only (deferred waiters keep their
+            # birth ts, exactly like _contribution)
+            tss = [np.where(ab, np.int64(-1), ts)
+                   for ts, ab in zip(tss, abms)]
+        n = 0
+        for blk, ts in zip(blocks, tss):
+            m = len(blk)
+            o = lo + n
+            keys_r[o:o + m] = blk.keys
+            types_r[o:o + m] = blk.types
+            scal_r[o:o + m] = blk.scalars
+            tags_r[o:o + m] = blk.tags
+            ts_r[o:o + m] = ts
+            n += m
+        while self.pending and n < self.b_loc:
+            src, blk = self.pending[0]
+            room = self.b_loc - n
+            if len(blk) <= room:
+                self.pending.popleft()
+                use = blk
+            else:
+                self.pending[0] = (src, blk.slice(room, len(blk)))
+                use = blk.slice(0, room)
+            m = len(use)
+            o = lo + n
+            keys_r[o:o + m] = use.keys
+            types_r[o:o + m] = use.types
+            scal_r[o:o + m] = use.scalars
+            tags_r[o:o + m] = (np.int64(src) << 40) | (use.tags & _TAG_MASK)
+            ts_r[o:o + m] = -1                        # -1 = stamp me
+            counts.append(np.zeros(m, np.int32))
+            dfcs.append(np.zeros(m, np.int32))
+            n += m
+        # zero the unfilled tail of my slice (reused buffer: these lanes
+        # must read as the serial path's np.zeros padding)
+        tail = slice(lo + n, lo + self.b_loc)
+        keys_r[tail] = 0
+        types_r[tail] = 0
+        scal_r[tail] = 0
+        tags_r[tail] = 0
+        ts_r[tail] = 0
+        sl = slice(lo, lo + n)
+        base = np.int64(epoch + 1) * self.b_merged + lo
+        stamped = base + np.arange(n, dtype=np.int64)
+        if n and stamped[-1] >= 2**31:
+            raise RuntimeError(
+                "birth-timestamp horizon exceeded (2^31; ~2^31/epoch_batch "
+                "epochs); restart the run — the reference's 64-bit ts has "
+                "the same finite-horizon caveat at larger scale")
+        np.copyto(ts_r[sl], stamped, where=ts_r[sl] < 0)
+        if n and ts_r[sl].min() < 1:
+            raise RuntimeError(
+                f"birth timestamp below 1 (min={ts_r[sl].min()}): the "
+                "ts>=1 stamping invariant is broken")
+        fs["active"][i, sl] = True
+        block = wire.QueryBlock(keys_r[sl], types_r[sl], scal_r[sl],
+                                tags_r[sl])
+        cnt = np.concatenate(counts) if counts else np.zeros(0, np.int32)
+        dfc = np.concatenate(dfcs) if dfcs else np.zeros(0, np.int32)
+        return block, cnt, ts_r[sl], dfc
+
+    def _bcast_views(self, e: int, block: wire.QueryBlock,
+                     birth_ts: np.ndarray) -> None:
+        """Wire-worker body: broadcast this node's contribution as
+        scatter-gather parts (``dt_sendv``) — zero Python-side payload
+        copies; the native layer frames header + ts + columns in one
+        pass.  Failover mode materializes the bytes instead: the
+        retained blob must survive feed-buffer recycling for verbatim
+        REJOIN resends."""
+        if self._failover:
+            blob = wire.encode_epoch_blob(e, block, birth_ts)
+            with self._sent_lock:
+                self._sent_blobs.append((e, blob))
+            for p in range(self.n_srv):
+                if p != self.me:
+                    self.tp.send(p, "EPOCH_BLOB", blob)
+            return
+        parts = wire.epoch_blob_parts(e, birth_ts, block.tags, block.keys,
+                                      block.types, block.scalars)
+        self.tp.sendv_many([p for p in range(self.n_srv) if p != self.me],
+                           "EPOCH_BLOB", parts)
+
+    def _collect_into(self, eps, fs: dict) -> float:
+        """RDONE barrier + zero-copy merge: each peer's raw EPOCH_BLOB
+        payload decodes STRAIGHT into its slice of the stacked feed row
+        (``decode_epoch_blob_into``).  Returns seconds spent decoding
+        (the caller's idle ledger carves it back out)."""
+        decode_s = 0.0
+        for i, (e, _blk, _cnt, _ts, _dfc) in enumerate(eps):
+            self._wait_blobs(e)
+            t0 = time.monotonic()
+            for s, payload in self.blob_buf.pop(e, {}).items():
+                o = s * self.b_loc
+                hi = o + self.b_loc
+                _ep, m = wire.decode_epoch_blob_into(
+                    payload, fs["tags"][i, o:hi], fs["ts"][i, o:hi],
+                    fs["keys"][i, o:hi], fs["types"][i, o:hi],
+                    fs["scal"][i, o:hi])
+                fs["active"][i, o:o + m] = True
+                if m < self.b_loc:
+                    # reused buffer: the short contribution's tail must
+                    # read as the serial path's np.zeros padding
+                    fs["keys"][i, o + m:hi] = 0
+                    fs["types"][i, o + m:hi] = 0
+                    fs["scal"][i, o + m:hi] = 0
+                    fs["tags"][i, o + m:hi] = 0
+                    fs["ts"][i, o + m:hi] = 0
+            decode_s += time.monotonic() - t0
+        return decode_s
+
+    def _log_group_views(self, fs: dict, eps) -> None:
+        """Wire-worker body: one-pass framed record per epoch straight
+        from the merged feed row (``pack_record_views``), appended
+        locally and shipped to my replicas — identical bytes by
+        construction (one packing, two destinations), identical to the
+        serial path's ``pack_record(encode_epoch_blob(...))`` bytes."""
+        from deneva_tpu.runtime.logger import pack_record_views
+        for i, (e, _blk, _cnt, _ts, _dfc) in enumerate(eps):
+            framed = pack_record_views(e, fs["ts"][i], fs["tags"][i],
+                                       fs["keys"][i], fs["types"][i],
+                                       fs["scal"][i], fs["active"][i])
+            self.logger.append(e, b"", fs["active"][i], framed=framed)
+            for r in self.repl_ids:
+                self.tp.send(r, "LOG_MSG", framed)
+
+    def _prefetch_retire(self, group: dict):
+        """Retire-worker body: wait out the verdict d2h copy, unpack the
+        bit planes and precompute the PURE per-epoch retirement pieces
+        (committed tags, per-client ack splits, histogram increments).
+        The dispatch thread's _retire is left with state mutation and
+        sends only — at the same loop position as the serial path."""
+        import jax
+
+        pk = np.asarray(jax.device_get(group["masks"]))
+        planes = np.unpackbits(pk, axis=-1, bitorder="little")
+        done, abort, defer = planes[:, :, :self.b_loc].astype(bool)
+        acks = []
+        for i, (_e, block, abort_cnt, _ts, dfc) in enumerate(group["eps"]):
+            n = len(block)
+            my_commit = done[i, :n]
+            if not my_commit.any():
+                acks.append(None)
+                continue
+            tags = block.tags[my_commit]
+            clients = tags >> 40
+            rsp = [(int(c), tags[clients == c] & _TAG_MASK)
+                   for c in np.unique(clients)]
+            retry_inc = np.bincount(np.minimum(abort_cnt[my_commit], 7),
+                                    minlength=8)
+            wait_inc = np.bincount(np.minimum(dfc[:n][my_commit], 7),
+                                   minlength=8)
+            acks.append((tags, rsp, retry_inc, wait_inc))
+        return done, abort, defer, acks
+
     def _durable_through(self) -> int:
         """Highest epoch that is on disk locally AND acked by every one of
         my replicas (the reference's `log_flushed && repl_finished` commit
@@ -878,7 +1121,8 @@ class ServerNode:
                 # the ack is now safe to (re-)issue: only here do the
                 # packed ids gain re-ack authority in the committed set
                 self._retire_dedup((np.int64(c) << 40) | tags)
-            self.tp.send(c, "CL_RSP", wire.encode_cl_rsp(tags))
+            # scatter-send parts: identical wire bytes, no encode copy
+            self.tp.sendv(c, "CL_RSP", wire.cl_rsp_parts(tags))
 
     # -- batched 2PC round (VOTE protocol; see make_vote_steps) ----------
     def _vote_epoch(self, epoch: int, query, active_np, active_j, ts_j, tl
@@ -1023,7 +1267,13 @@ class ServerNode:
         import jax
 
         t0 = time.monotonic()
-        if group["packed"]:
+        pre = None
+        if group.get("prefetch") is not None:
+            # host pipeline: the retire worker already waited the d2h,
+            # unpacked the planes and split the ack payloads while later
+            # groups were dispatching — collect the finished result
+            done, abort, defer, pre = group["prefetch"].result()
+        elif group["packed"]:
             # uint8 bit-planes [3, C, pb/8]; the d2h copy was started
             # asynchronously at dispatch, so this normally returns fast
             pk = np.asarray(jax.device_get(group["masks"]))
@@ -1037,7 +1287,20 @@ class ServerNode:
                 group["eps"]):
             n = len(block)
             my_commit = done[i, :n]
-            if my_commit.any():
+            if pre is not None:
+                if pre[i] is not None:
+                    tags, rsp_split, retry_inc, wait_inc = pre[i]
+                    self._retry_hist += retry_inc
+                    self._wait_hist += wait_inc
+                    if self._dedup_on and self.logger is None:
+                        self._retire_dedup(tags)
+                    for c, masked in rsp_split:
+                        if self.logger is None:
+                            self.tp.sendv(c, "CL_RSP",
+                                          wire.cl_rsp_parts(masked))
+                        else:
+                            self._held_rsp.append((c, epoch, masked))
+            elif my_commit.any():
                 # TxnStats analogue: whole-life restart/wait counts of
                 # each committed txn (clipped to the 8-bucket family)
                 self._retry_hist += np.bincount(
@@ -1087,6 +1350,14 @@ class ServerNode:
                                 defer_cnt=np.where(
                                     ab, 0, dfc[:n] + df)[idx])
         self._flush_held_rsp()
+        # host pipeline: surface wire-worker errors and recycle the feed
+        # buffer set — the mask fetch above proved the device consumed
+        # its inputs, and the drained wire futures prove the blob/log
+        # sends no longer reference the rows
+        for f in group.get("wire_futs", ()):
+            f.result()
+        if group.get("feed") is not None:
+            self._feed_free.append(group["feed"])
         if tl:
             tl.mark("retire")
 
@@ -1168,6 +1439,12 @@ class ServerNode:
                 # that boundary" (torn tails are exercised separately:
                 # recovery truncates them, tests/test_chaos.py).
                 if self.logger is not None and epoch0 > 0:
+                    # under overlap the log records ride the wire
+                    # worker: drain the in-flight groups' submissions so
+                    # the appends exist before waiting on the flush
+                    for g in inflight:
+                        for f in g.get("wire_futs", ()):
+                            f.result()
                     self.logger.wait_flushed(epoch0 - 1, timeout=10.0)
                 os._exit(17)
             self._drain()
@@ -1205,93 +1482,136 @@ class ServerNode:
                 blob = wire.encode_epoch_blob(e, block, birth_ts)
                 if self._failover:
                     # retained for verbatim resend to a rejoining peer
-                    # (deque append is GIL-atomic; maxlen bounds it)
-                    self._sent_blobs.append((e, blob))
+                    with self._sent_lock:
+                        self._sent_blobs.append((e, blob))
                 for p in range(self.n_srv):
                     if p != self.me:
                         self.tp.send(p, "EPOCH_BLOB", blob)
 
-            futs = []
-            try:
+            fs = None
+            wire_futs: list = []
+            if self._overlap:
+                # host pipeline: admission writes straight into the
+                # reusable flat feed buffers; the ordered wire worker
+                # encodes + broadcasts each blob while the NEXT epoch's
+                # admission (and, below, the device group) proceeds
+                fs = self._feed_acquire()
                 for i in range(C):
                     e = epoch0 + i
                     if i:
                         self._drain()
-                    block, abort_cnt, birth_ts, dfc = self._contribution(e)
-                    if self.codec_pool is not None and self.n_srv > 1:
-                        futs.append(self.codec_pool.submit(
-                            _bcast, e, block, birth_ts))
-                    else:
-                        _bcast(e, block, birth_ts)
+                    block, abort_cnt, birth_ts, dfc = \
+                        self._contribution_into(e, fs, i)
+                    if self.n_srv > 1:
+                        wire_futs.append(self.wire_pool.submit(
+                            self._bcast_views, e, block, birth_ts))
                     eps.append((e, block, abort_cnt, birth_ts, dfc))
-            finally:
-                # drain in-flight _bcast sends before any exception can
-                # unwind past self.tp teardown (they hold the native
-                # transport; an abandoned future would race the close)
-                if futs:
-                    from concurrent.futures import wait as _futs_wait
-                    _futs_wait(futs)
-            for f in futs:
-                f.result()    # surface any _bcast error after the drain
-            self.tp.flush()
+                if self.n_srv > 1:
+                    # peers block on these blobs: push them onto the
+                    # wire behind the group's last bcast (FIFO worker)
+                    wire_futs.append(self.wire_pool.submit(self.tp.flush))
+            else:
+                futs = []
+                try:
+                    for i in range(C):
+                        e = epoch0 + i
+                        if i:
+                            self._drain()
+                        block, abort_cnt, birth_ts, dfc = \
+                            self._contribution(e)
+                        if self.codec_pool is not None and self.n_srv > 1:
+                            futs.append(self.codec_pool.submit(
+                                _bcast, e, block, birth_ts))
+                        else:
+                            _bcast(e, block, birth_ts)
+                        eps.append((e, block, abort_cnt, birth_ts, dfc))
+                finally:
+                    # drain in-flight _bcast sends before any exception
+                    # can unwind past self.tp teardown (they hold the
+                    # native transport; an abandoned future would race
+                    # the close)
+                    if futs:
+                        from concurrent.futures import wait as _futs_wait
+                        _futs_wait(futs)
+                for f in futs:
+                    f.result()   # surface any _bcast error after the drain
+                self.tp.flush()
             if tl:
                 tl.mark("admit")
             # ---- collect every peer's contributions -------------------
             t0 = time.monotonic()
-            merged_parts = []
-            for e, block, _, birth_ts, _ in eps:
-                self._wait_blobs(e)
-                parts = self.blob_buf.pop(e, {})
-                parts[self.me] = (block, birth_ts)
-                merged_parts.append(parts)
-            self._ph["idle"] += time.monotonic() - t0
+            if self._overlap:
+                decode_s = self._collect_into(eps, fs)
+                # decode work is process time, not network wait
+                self._ph["idle"] += time.monotonic() - t0 - decode_s
+                self._ph["process"] += decode_s
+            else:
+                merged_parts = []
+                for e, block, _, birth_ts, _ in eps:
+                    self._wait_blobs(e)
+                    parts = self.blob_buf.pop(e, {})
+                    parts[self.me] = (block, birth_ts)
+                    merged_parts.append(parts)
+                self._ph["idle"] += time.monotonic() - t0
             if tl:
                 tl.mark("collect")
             # ---- build the stacked device feed [C, b] -----------------
-            keys = np.zeros((C, b, self._width), np.int32)
-            types = np.zeros((C, b, self._width), np.int8)
-            scal = np.zeros((C, b, self._n_scalars), np.int32)
-            tags = np.zeros((C, b), np.int64)
-            ts_np = np.zeros((C, b), np.int64)
-            active_np = np.zeros((C, b), bool)
-            def _fill(i, parts):
-                # disjoint row i of every feed buffer: pool-safe
-                for s in range(self.n_srv):
-                    blk_s, ts_s = parts[s]
-                    o = s * self.b_loc
-                    n = len(blk_s)
-                    keys[i, o:o + n] = blk_s.keys
-                    types[i, o:o + n] = blk_s.types
-                    scal[i, o:o + n] = blk_s.scalars
-                    tags[i, o:o + n] = blk_s.tags
-                    ts_np[i, o:o + n] = ts_s
-                    active_np[i, o:o + n] = True
-
-            if self.codec_pool is not None:
-                list(self.codec_pool.map(_fill, range(C), merged_parts))
+            if self._overlap:
+                keys, types, scal = fs["keys"], fs["types"], fs["scal"]
+                tags, ts_np, active_np = fs["tags"], fs["ts"], fs["active"]
             else:
-                for i, parts in enumerate(merged_parts):
-                    _fill(i, parts)
+                keys = np.zeros((C, b, self._width), np.int32)
+                types = np.zeros((C, b, self._width), np.int8)
+                scal = np.zeros((C, b, self._n_scalars), np.int32)
+                tags = np.zeros((C, b), np.int64)
+                ts_np = np.zeros((C, b), np.int64)
+                active_np = np.zeros((C, b), bool)
+                def _fill(i, parts):
+                    # disjoint row i of every feed buffer: pool-safe
+                    for s in range(self.n_srv):
+                        blk_s, ts_s = parts[s]
+                        o = s * self.b_loc
+                        n = len(blk_s)
+                        keys[i, o:o + n] = blk_s.keys
+                        types[i, o:o + n] = blk_s.types
+                        scal[i, o:o + n] = blk_s.scalars
+                        tags[i, o:o + n] = blk_s.tags
+                        ts_np[i, o:o + n] = ts_s
+                        active_np[i, o:o + n] = True
+
+                if self.codec_pool is not None:
+                    list(self.codec_pool.map(_fill, range(C), merged_parts))
+                else:
+                    for i, parts in enumerate(merged_parts):
+                        _fill(i, parts)
             if self.logger is not None:
                 # command log: the MERGED epoch block + active mask is
                 # the log record — deterministic replay = re-execution
                 # of the full command stream; ship the same record to
                 # my replica (LOG_MSG, SURVEY §5.4).  Logged at
                 # dispatch: verdicts are a pure function of the record.
-                from deneva_tpu.runtime.logger import pack_record
-                for i in range(C):
-                    e = eps[i][0]
-                    merged = wire.QueryBlock(keys[i], types[i], scal[i],
-                                             tags[i])
-                    rec = wire.encode_epoch_blob(e, merged, ts_np[i])
-                    # LOG_MSG payload = the framed record verbatim, so
-                    # each replica's log file is byte-identical to the
-                    # primary's by construction (one packing, two
-                    # destinations)
-                    framed = pack_record(e, rec, active_np[i])
-                    self.logger.append(e, rec, active_np[i], framed=framed)
-                    for r in self.repl_ids:
-                        self.tp.send(r, "LOG_MSG", framed)
+                if self._overlap:
+                    # identical bytes, packed once off the dispatch
+                    # thread (pack_record_views == pack_record of the
+                    # encoded blob, fuzz-tested)
+                    wire_futs.append(self.wire_pool.submit(
+                        self._log_group_views, fs, eps))
+                else:
+                    from deneva_tpu.runtime.logger import pack_record
+                    for i in range(C):
+                        e = eps[i][0]
+                        merged = wire.QueryBlock(keys[i], types[i],
+                                                 scal[i], tags[i])
+                        rec = wire.encode_epoch_blob(e, merged, ts_np[i])
+                        # LOG_MSG payload = the framed record verbatim,
+                        # so each replica's log file is byte-identical
+                        # to the primary's by construction (one packing,
+                        # two destinations)
+                        framed = pack_record(e, rec, active_np[i])
+                        self.logger.append(e, rec, active_np[i],
+                                           framed=framed)
+                        for r in self.repl_ids:
+                            self.tp.send(r, "LOG_MSG", framed)
             # ---- dispatch (async for merged mode; the masks are fetched
             # at retirement, K groups later) ----------------------------
             t_step = time.monotonic()
@@ -1317,9 +1637,15 @@ class ServerNode:
                 # call additionally routes h2d through a chunked slow
                 # path (~8 MB/s measured vs ~400 MB/s) — together they
                 # were 3 s vs 90 ms per 32-epoch group.
+                if self._overlap:
+                    # preallocated int32 shadow instead of a fresh
+                    # astype allocation per group
+                    np.copyto(fs["ts32"], ts_np, casting="unsafe")
+                    ts32 = fs["ts32"].reshape(-1)
+                else:
+                    ts32 = ts_np.astype(np.int32).reshape(-1)
                 feed = jax.device_put(
-                    (active_np.reshape(-1),
-                     ts_np.astype(np.int32).reshape(-1),
+                    (active_np.reshape(-1), ts32,
                      keys.reshape(-1), types.reshape(-1),
                      scal.reshape(-1)))
                 out = self.group_step(self.db, self.cc_state,
@@ -1335,7 +1661,15 @@ class ServerNode:
             self._ph["process"] += time.monotonic() - t_step
             if tl:
                 tl.mark("dispatch")
-            inflight.append({"eps": eps, "masks": masks, "packed": packed})
+            group = {"eps": eps, "masks": masks, "packed": packed,
+                     "feed": fs, "wire_futs": wire_futs}
+            if self._overlap:
+                # hand the verdict-plane fetch to the retire worker now:
+                # by the time this group's turn to retire comes (K groups
+                # later) the planes and ack splits are already unpacked
+                group["prefetch"] = self.retire_pool.submit(
+                    self._prefetch_retire, group)
+            inflight.append(group)
             group_end = epoch0 + C
             # ---- measured-window snapshot at the announced boundary ----
             if measured is None and self.measure_epoch is not None \
@@ -1440,6 +1774,11 @@ class ServerNode:
             # wait: an in-flight _bcast still holds self.tp; destroying
             # the native transport under it would be a use-after-free
             self.codec_pool.shutdown(wait=True)
+        if self.wire_pool is not None:
+            # same use-after-free hazard: wire-worker sends hold self.tp
+            self.wire_pool.shutdown(wait=True)
+        if self.retire_pool is not None:
+            self.retire_pool.shutdown(wait=True)
         self.tp.close()
 
 

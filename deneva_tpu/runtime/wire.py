@@ -28,10 +28,14 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from deneva_tpu.runtime.native import decode_qrybatch, encode_qrybatch
+from deneva_tpu.runtime.native import (_QB_HDR, decode_qrybatch,
+                                       decode_qrybatch_into,
+                                       encode_qrybatch)
 
 _HDR = struct.Struct("<q")          # epoch (blob) / stop_epoch (shutdown)
 _RSP = struct.Struct("<II")         # n, pad
+_QHDR = _QB_HDR                     # qrybatch header (n, width, n_scalars):
+#                                     single definition, native.py owns it
 
 
 @dataclass
@@ -100,6 +104,70 @@ def decode_epoch_blob(buf: bytes) -> tuple[int, QueryBlock, np.ndarray]:
     epoch, n = _TS_HDR.unpack_from(buf)
     ts = np.frombuffer(buf, np.int64, count=n, offset=_TS_HDR.size)
     return epoch, decode_qry_block(buf[_TS_HDR.size + 8 * n:]), ts
+
+
+# ---- zero-copy wire fast paths (host-path pipeline PR) -----------------
+# The bytes codecs above build each message through 2-3 intermediate
+# copies (column .tobytes() + joins).  The cluster steady loop instead
+# ships messages as SCATTER-SEND PARTS (NativeTransport.sendv /
+# dt_sendv): the column arrays themselves plus two tiny packed headers —
+# the native layer frames everything in one pass, so the Python side
+# performs zero payload copies.  The parts concatenation is
+# byte-identical to the corresponding encode_* output (fuzz-tested in
+# tests/test_wire_zero_copy.py), which is what keeps log records and
+# replica streams unchanged whichever path produced them.
+
+def epoch_blob_parts(epoch: int, ts: np.ndarray, tags: np.ndarray,
+                     keys: np.ndarray, types: np.ndarray,
+                     scalars: np.ndarray) -> list:
+    """EPOCH_BLOB as sendv parts; concatenated == encode_epoch_blob of
+    the same columns.  All arrays must be C-contiguous row views."""
+    n = len(tags)
+    return [_TS_HDR.pack(epoch, len(ts)), ts,
+            _QHDR.pack(n, keys.shape[1],
+                       scalars.shape[1] if scalars.ndim == 2 else 0),
+            tags, keys, types, scalars]
+
+
+def qry_block_parts(tags: np.ndarray, keys: np.ndarray, types: np.ndarray,
+                    scalars: np.ndarray) -> list:
+    """CL_QRY_BATCH as sendv parts; concatenated == encode_qry_block of
+    the same columns.  The client's hot loop ships its pre-generated
+    ring columns directly — no per-send codec pass."""
+    return [_QHDR.pack(len(tags), keys.shape[1], scalars.shape[1]),
+            np.ascontiguousarray(tags, np.int64), keys, types, scalars]
+
+
+def cl_rsp_parts(tags: np.ndarray) -> list:
+    """CL_RSP as sendv parts; concatenated == encode_cl_rsp(tags)."""
+    tags = np.ascontiguousarray(tags, np.int64)
+    return [_RSP.pack(len(tags), 0), tags]
+
+
+def peek_blob_epoch(buf: bytes) -> int:
+    """Epoch of an EPOCH_BLOB without decoding the body (the overlap
+    path buffers raw payloads and decodes straight into the feed)."""
+    return _TS_HDR.unpack_from(buf)[0]
+
+
+def decode_epoch_blob_into(buf: bytes, tags: np.ndarray, ts: np.ndarray,
+                           keys: np.ndarray, types: np.ndarray,
+                           scalars: np.ndarray) -> tuple[int, int]:
+    """Decode an EPOCH_BLOB straight into feed-slice row views (the
+    assembly path that replaces per-group ``np.concatenate``): birth ts
+    and the query columns land in the caller's arrays; rows past the
+    decoded count are untouched.  Returns (epoch, n)."""
+    epoch, n_ts = _TS_HDR.unpack_from(buf)
+    if len(ts) < n_ts:
+        raise ValueError(f"ts view too small ({len(ts)} < {n_ts})")
+    ts[:n_ts] = np.frombuffer(buf, np.int64, count=n_ts,
+                              offset=_TS_HDR.size)
+    n = decode_qrybatch_into(buf, _TS_HDR.size + 8 * n_ts, tags, keys,
+                             types, scalars)
+    if n != n_ts:
+        raise ValueError(
+            f"corrupt epoch blob: {n_ts} timestamps for {n} txns")
+    return epoch, n
 
 
 # ---- CL_RSP: tags + commit latency echo --------------------------------

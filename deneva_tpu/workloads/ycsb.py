@@ -423,7 +423,8 @@ class YCSBWorkload:
             return (f0, jax.lax.psum(cks, AXIS),
                     jax.lax.psum(wcnt, AXIS), dfr)
 
-        f0, cks, wcnt, dfr = jax.shard_map(
+        from deneva_tpu.parallel.mesh import shard_map_fn
+        f0, cks, wcnt, dfr = shard_map_fn()(
             body, mesh=mesh,
             in_specs=(P(AXIS), P(), P(), P(), P(), P()),
             out_specs=(P(AXIS), P(), P(),

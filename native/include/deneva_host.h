@@ -89,6 +89,21 @@ int dt_start(dt_transport *t, int timeout_ms);
 int dt_send(dt_transport *t, uint32_t dest, uint16_t rtype,
             const uint8_t *payload, uint32_t len);
 
+/* Scatter-gather variant of dt_send (writev-shaped): the payload is the
+ * concatenation of n_iov segments.  The frame (header + all segments) is
+ * assembled ONCE into the transport's internal buffer — callers ship
+ * multi-part bodies (codec header + column arrays) without building a
+ * contiguous payload first, so Python-side framing stops copying bodies.
+ * Segment memory may be reused as soon as the call returns.  A segment
+ * with len 0 is skipped (base may be NULL).  Same fault-injection and
+ * loopback semantics as dt_send.  Returns 0 on success. */
+typedef struct dt_iov {
+  const void *base;
+  size_t len;
+} dt_iov;
+int dt_sendv(dt_transport *t, uint32_t dest, uint16_t rtype,
+             const dt_iov *iov, uint32_t n_iov);
+
 /* Pop one received message.  Returns payload length >= 0 and fills
  * src/rtype, or -1 on timeout, -2 if buf too small (message stays
  * queued; required size in *len_needed if non-NULL). timeout_us < 0

@@ -525,11 +525,31 @@ struct dt_transport {
 
   int enqueue(uint32_t dest, uint16_t rtype, const uint8_t *payload,
               uint32_t len) {
+    dt_iov one{payload, len};
+    return enqueue_v(dest, rtype, &one, 1);
+  }
+
+  // Scatter-gather enqueue: the frame is assembled ONCE (header + every
+  // segment) into the OutFrame — the single unavoidable copy of the
+  // async send path.  Callers pass column arrays / codec headers as
+  // segments and never build a contiguous payload themselves.
+  int enqueue_v(uint32_t dest, uint16_t rtype, const dt_iov *iov,
+                uint32_t n_iov) {
     if (dest >= n_nodes || stop.load()) return -1;
-    FrameHdr h{len, rtype, 0, node_id};
+    size_t len = 0;
+    for (uint32_t i = 0; i < n_iov; ++i) len += iov[i].len;
+    if (len > UINT32_MAX) return -1;
+    FrameHdr h{static_cast<uint32_t>(len), rtype, 0, node_id};
     if (dest == node_id) {
-      // loopback: skip the wire entirely (and the fault model with it)
-      deliver(h, payload);
+      // loopback: skip the wire entirely (and the fault model with it);
+      // gather into a scratch buffer only on this local-delivery path
+      std::vector<uint8_t> pay;
+      pay.reserve(len);
+      for (uint32_t i = 0; i < n_iov; ++i)
+        if (iov[i].len)
+          pay.insert(pay.end(), static_cast<const uint8_t *>(iov[i].base),
+                     static_cast<const uint8_t *>(iov[i].base) + iov[i].len);
+      deliver(h, pay.data());
       bump(DT_STAT_MSG_SENT);
       return 0;
     }
@@ -559,7 +579,12 @@ struct dt_transport {
     f.ready_us = d ? now_us() + d : 0;
     f.bytes.resize(sizeof(h) + len);
     std::memcpy(f.bytes.data(), &h, sizeof(h));
-    if (len) std::memcpy(f.bytes.data() + sizeof(h), payload, len);
+    uint8_t *p = f.bytes.data() + sizeof(h);
+    for (uint32_t i = 0; i < n_iov; ++i) {
+      if (!iov[i].len) continue;
+      std::memcpy(p, iov[i].base, iov[i].len);
+      p += iov[i].len;
+    }
     if (duplicate) {
       OutFrame g = f;  // byte-identical twin rides the same shard queue
       bump(DT_STAT_MSG_DUP);
@@ -681,6 +706,12 @@ int dt_send(dt_transport *t, uint32_t dest, uint16_t rtype,
             const uint8_t *payload, uint32_t len) {
   if (!t) return -1;
   return t->enqueue(dest, rtype, payload, len);
+}
+
+int dt_sendv(dt_transport *t, uint32_t dest, uint16_t rtype,
+             const dt_iov *iov, uint32_t n_iov) {
+  if (!t || (n_iov && !iov)) return -1;
+  return t->enqueue_v(dest, rtype, iov, n_iov);
 }
 
 long dt_recv(dt_transport *t, uint8_t *buf, uint32_t cap, uint32_t *src,

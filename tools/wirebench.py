@@ -1,0 +1,365 @@
+#!/usr/bin/env python
+"""Host-path microbench (host-path pipeline PR).
+
+Measures the per-GROUP cost of every host stage the cluster steady loop
+pays between two device dispatches, old path vs zero-copy path, at real
+cluster shapes:
+
+* assembly  — admission blocks -> stacked [C, b] device feed
+              (QueryBlock.concat + zeros + fill  vs  direct-fill into
+              reused buffers)
+* bcast     — my contribution -> EPOCH_BLOB on the wire per peer
+              (encode_epoch_blob bytes + dt_send  vs  parts + dt_sendv)
+* decode    — peer blobs -> feed slices
+              (decode_epoch_blob alloc + fill  vs  decode_epoch_blob_into)
+* log       — merged feed -> framed log record
+              (encode_epoch_blob + pack_record  vs  pack_record_views)
+* retire    — packed verdict planes -> CL_RSP payloads on the wire
+              (unpackbits + encode_cl_rsp + dt_send  vs  prefetched
+              unpack/split + cl_rsp_parts + dt_sendv)
+* client    — ring block -> CL_QRY_BATCH on the wire
+              (encode_qry_block + dt_send  vs  qry_block_parts + dt_sendv)
+
+The BEFORE critical path is the sum of the stages the serial loop runs
+on the dispatch thread; the AFTER critical path is what stays on the
+dispatch thread under host_overlap (direct-fill assembly + decode-into +
+stage submission), with the wire/retire-worker stage costs reported
+separately — they overlap device compute.  The acceptance bar for this
+PR is AFTER <= BEFORE/2.
+
+Usage: python tools/wirebench.py [--reps N] [--out results/wirebench]
+Writes <out>/WIREBENCH.json (provenance + per-stage ns/group) and prints
+the BASELINE.md markdown table.
+"""
+
+import argparse
+import datetime
+import json
+import os
+import platform
+import sys
+import threading
+import time
+import uuid
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deneva_tpu.runtime import wire                      # noqa: E402
+from deneva_tpu.runtime.logger import (pack_record,      # noqa: E402
+                                       pack_record_views)
+from deneva_tpu.runtime.native import (NativeTransport,  # noqa: E402
+                                       ipc_endpoints)
+
+# (name, n_srv, C, b_merged, W, S): the two shapes the PR's claims rest
+# on — the cluster_scaling N=4 CPU shape and the single-TPU-server
+# cluster shape (BASELINE.md cluster_tpu)
+SHAPES = [
+    ("cluster_scaling_N4", 4, 8, 256, 4, 0),
+    ("cluster_tpu_1srv", 1, 32, 16384, 10, 0),
+]
+
+
+def _bench(fn, reps: int, warm: int = 2, rounds: int = 5,
+           settle=None) -> float:
+    """Best-of-rounds ns/op: the minimum across measurement rounds is
+    the scheduler-noise-resistant estimator on a small shared box (the
+    2-core rig runs bench + native sender + drainer threads).
+    ``settle`` (e.g. transport-queue drain) runs between rounds so a
+    send-heavy stage never measures its own backpressure."""
+    for _ in range(warm):
+        fn()
+    best = float("inf")
+    per_round = max(reps // rounds, 1)
+    for _ in range(rounds):
+        if settle is not None:
+            settle()
+        t0 = time.perf_counter_ns()
+        for _ in range(per_round):
+            fn()
+        best = min(best, (time.perf_counter_ns() - t0) / per_round)
+    return best
+
+
+def _pieces(rng, n, W, S, parts=3):
+    """A contribution as `parts` admission pieces (retry blocks + pending
+    slices), like _contribution sees them."""
+    cuts = sorted(rng.choice(max(n - 1, 1), size=min(parts - 1, n - 1),
+                             replace=False) + 1) if n > 1 else []
+    lo = 0
+    out = []
+    for hi in list(cuts) + [n]:
+        m = hi - lo
+        out.append(wire.QueryBlock(
+            keys=rng.integers(0, 2**20, (m, W)).astype(np.int32),
+            types=rng.integers(1, 4, (m, W)).astype(np.int8),
+            scalars=rng.integers(0, 100, (m, S)).astype(np.int32),
+            tags=rng.integers(0, 2**40, m).astype(np.int64)))
+        lo = hi
+    return out
+
+
+def bench_shape(name, n_srv, C, b, W, S, reps) -> dict:
+    rng = np.random.default_rng(42)
+    b_loc = b // n_srv
+    pieces = [_pieces(rng, b_loc, W, S) for _ in range(C)]
+    my_ts = [rng.integers(1, 2**30, b_loc).astype(np.int64)
+             for _ in range(C)]
+    my_blocks = [wire.QueryBlock.concat(p) for p in pieces]
+    peer_blobs = [[wire.encode_epoch_blob(i, my_blocks[i], my_ts[i])
+                   for _ in range(n_srv - 1)] for i in range(C)]
+
+    # a 2-node mesh so send-side costs are real enqueue+frame work; the
+    # drainer keeps the bounded recv queue from backpressuring the bench
+    eps = ipc_endpoints(2, uuid.uuid4().hex[:8])
+    nodes = [NativeTransport(i, eps, 2, msg_size_max=65536)
+             for i in range(2)]
+    ths = [threading.Thread(target=t.start) for t in nodes]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    tp, sink = nodes
+    stop = threading.Event()
+
+    def drain():
+        while not stop.is_set():
+            sink.recv(20_000)
+
+    drainer = threading.Thread(target=drain)
+    drainer.start()
+
+    def settle():
+        # let the sender/drainer catch up so send-stage rounds measure
+        # enqueue+frame cost, not the bounded queue's backpressure
+        for _ in range(200):
+            if tp.stats()["send_queue_depth"] == 0:
+                break
+            time.sleep(0.005)
+
+    res = {}
+    try:
+        # ---- assembly ------------------------------------------------
+        def assembly_old():
+            keys = np.zeros((C, b, W), np.int32)
+            types = np.zeros((C, b, W), np.int8)
+            scal = np.zeros((C, b, S), np.int32)
+            tags = np.zeros((C, b), np.int64)
+            ts_np = np.zeros((C, b), np.int64)
+            active = np.zeros((C, b), bool)
+            for i in range(C):
+                blk = wire.QueryBlock.concat(pieces[i])
+                for s in range(n_srv):
+                    o = s * b_loc
+                    keys[i, o:o + b_loc] = blk.keys
+                    types[i, o:o + b_loc] = blk.types
+                    scal[i, o:o + b_loc] = blk.scalars
+                    tags[i, o:o + b_loc] = blk.tags
+                    ts_np[i, o:o + b_loc] = my_ts[i]
+                    active[i, o:o + b_loc] = True
+            return keys
+
+        fs = {"keys": np.zeros((C, b, W), np.int32),
+              "types": np.zeros((C, b, W), np.int8),
+              "scal": np.zeros((C, b, S), np.int32),
+              "tags": np.zeros((C, b), np.int64),
+              "ts": np.zeros((C, b), np.int64),
+              "active": np.zeros((C, b), bool)}
+
+        def assembly_new():
+            # mirror of the server path: only the active plane re-zeroes
+            # (full slices here, so there is no tail to pad)
+            fs["active"].fill(False)
+            for i in range(C):
+                n = 0
+                for blk in pieces[i]:          # my slice: direct writes
+                    m = len(blk)
+                    fs["keys"][i, n:n + m] = blk.keys
+                    fs["types"][i, n:n + m] = blk.types
+                    fs["scal"][i, n:n + m] = blk.scalars
+                    fs["tags"][i, n:n + m] = blk.tags
+                    n += m
+                fs["ts"][i, :b_loc] = my_ts[i]
+                fs["active"][i, :b_loc] = True
+                for s in range(1, n_srv):      # peers: decode into slices
+                    o = s * b_loc
+                    wire.decode_epoch_blob_into(
+                        peer_blobs[i][s - 1], fs["tags"][i, o:o + b_loc],
+                        fs["ts"][i, o:o + b_loc],
+                        fs["keys"][i, o:o + b_loc],
+                        fs["types"][i, o:o + b_loc],
+                        fs["scal"][i, o:o + b_loc])
+                    fs["active"][i, o:o + b_loc] = True
+
+        # the old loop decodes peer blobs in _route (alloc) before fill
+        def decode_old():
+            for i in range(C):
+                for blob in peer_blobs[i]:
+                    wire.decode_epoch_blob(blob)
+
+        res["assembly_old"] = _bench(assembly_old, reps) + \
+            _bench(decode_old, reps)
+        res["assembly_new"] = _bench(assembly_new, reps)
+
+        # ---- bcast ---------------------------------------------------
+        peers = max(n_srv - 1, 1)   # 1-server shapes still price the send
+
+        def bcast_old():
+            for i in range(C):
+                blob = wire.encode_epoch_blob(i, my_blocks[i], my_ts[i])
+                for _ in range(peers):
+                    tp.send(1, "EPOCH_BLOB", blob)
+
+        def bcast_new():
+            for i in range(C):
+                parts = wire.epoch_blob_parts(
+                    i, my_ts[i], my_blocks[i].tags, my_blocks[i].keys,
+                    my_blocks[i].types, my_blocks[i].scalars)
+                tp.sendv_many([1] * peers, "EPOCH_BLOB", parts)
+
+        res["bcast_old"] = _bench(bcast_old, reps, settle=settle)
+        res["bcast_new"] = _bench(bcast_new, reps, settle=settle)
+
+        # ---- log record ---------------------------------------------
+        active = np.ones(b, bool)
+        merged = wire.QueryBlock(fs["keys"][0], fs["types"][0],
+                                 fs["scal"][0], fs["tags"][0])
+
+        def log_old():
+            for i in range(C):
+                rec = wire.encode_epoch_blob(i, merged, fs["ts"][0])
+                pack_record(i, rec, active)
+
+        def log_new():
+            for i in range(C):
+                pack_record_views(i, fs["ts"][0], fs["tags"][0],
+                                  fs["keys"][0], fs["types"][0],
+                                  fs["scal"][0], active)
+
+        res["log_old"] = _bench(log_old, reps)
+        res["log_new"] = _bench(log_new, reps)
+
+        # ---- retire --------------------------------------------------
+        pb = (b_loc + 7) // 8 * 8
+        pk = rng.integers(0, 256, (3, C, pb // 8)).astype(np.uint8)
+
+        def unpack_and_split():
+            planes = np.unpackbits(pk, axis=-1, bitorder="little")
+            done = planes[0, :, :b_loc].astype(bool)
+            out = []
+            for i in range(C):
+                tags = my_blocks[i].tags[done[i]]
+                clients = tags >> 40
+                out.append([(int(c), tags[clients == c])
+                            for c in np.unique(clients)])
+            return out
+
+        split = unpack_and_split()
+
+        def retire_old():
+            for per_epoch in unpack_and_split():
+                for c, tags in per_epoch:
+                    tp.send(1, "CL_RSP", wire.encode_cl_rsp(tags))
+
+        def retire_new_dispatch():
+            # under overlap the unpack/split ran on the retire worker;
+            # the dispatch thread only ships the precomputed payloads
+            for per_epoch in split:
+                for c, tags in per_epoch:
+                    tp.sendv(1, "CL_RSP", wire.cl_rsp_parts(tags))
+
+        res["retire_old"] = _bench(retire_old, reps, settle=settle)
+        res["retire_new"] = _bench(retire_new_dispatch, reps, settle=settle)
+        res["retire_prefetch_offthread"] = _bench(
+            lambda: unpack_and_split(), reps)
+
+        # ---- client send (per CL_QRY_BATCH of 1024) ------------------
+        cq = wire.QueryBlock(
+            keys=rng.integers(0, 2**20, (1024, W)).astype(np.int32),
+            types=rng.integers(1, 4, (1024, W)).astype(np.int8),
+            scalars=rng.integers(0, 100, (1024, S)).astype(np.int32),
+            tags=np.arange(1024, dtype=np.int64))
+
+        res["client_old"] = _bench(
+            lambda: tp.send(1, "CL_QRY_BATCH", wire.encode_qry_block(cq)),
+            reps * 4, settle=settle)
+        res["client_new"] = _bench(
+            lambda: tp.sendv(1, "CL_QRY_BATCH", wire.qry_block_parts(
+                cq.tags, cq.keys, cq.types, cq.scalars)), reps * 4,
+            settle=settle)
+    finally:
+        stop.set()
+        drainer.join(timeout=5)
+        tp.close()
+        sink.close()
+
+    res["critical_before"] = (res["assembly_old"] + res["bcast_old"]
+                              + res["log_old"] + res["retire_old"])
+    res["critical_after"] = (res["assembly_new"] + res["retire_new"])
+    res["offthread_after"] = (res["bcast_new"] + res["log_new"]
+                              + res["retire_prefetch_offthread"])
+    res["reduction_x"] = res["critical_before"] / max(
+        res["critical_after"], 1.0)
+    return res
+
+
+def main(argv) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reps", type=int, default=30)
+    ap.add_argument("--out", default="results/wirebench")
+    args = ap.parse_args(argv)
+
+    record = {
+        "bench": "wirebench",
+        "provenance": {
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+            "host": platform.node(),
+            "captured": datetime.datetime.now().isoformat(
+                timespec="seconds"),
+            "capture": "host-CPU microbench (no device involved: these "
+                       "stages run on the host either way)",
+        },
+        "unit": "ns_per_group",
+        "shapes": {},
+    }
+    for name, n_srv, C, b, W, S in SHAPES:
+        reps = args.reps if b <= 4096 else max(args.reps // 5, 3)
+        res = bench_shape(name, n_srv, C, b, W, S, reps)
+        record["shapes"][name] = {
+            "n_srv": n_srv, "C": C, "b_merged": b, "W": W, "S": S,
+            **{k: round(v, 1) for k, v in res.items()}}
+        print(f"\n### wirebench {name} (n_srv={n_srv} C={C} b={b} W={W})")
+        print("| stage | before ns/group | after ns/group | ratio |")
+        print("|---|---|---|---|")
+        for stage in ("assembly", "bcast", "log", "retire", "client"):
+            o, n = res[f"{stage}_old"], res[f"{stage}_new"]
+            print(f"| {stage} | {o:,.0f} | {n:,.0f} | {o / max(n, 1):.1f}x |")
+        print(f"| **dispatch-thread critical path** | "
+              f"**{res['critical_before']:,.0f}** | "
+              f"**{res['critical_after']:,.0f}** | "
+              f"**{res['reduction_x']:.1f}x** |")
+        print(f"| (moved off-thread, overlaps device) | - | "
+              f"{res['offthread_after']:,.0f} | - |")
+    os.makedirs(args.out, exist_ok=True)
+    path = os.path.join(args.out, "WIREBENCH.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"\nwrote {path}")
+    # the gate reads the HOST-BOUND shape (the big-blob cluster shape
+    # where the serial host path actually binds the loop — round-2
+    # measured 430 ms/epoch there).  The small N4 CPU shape is
+    # informational: at 64-row messages per-call overheads bound the
+    # wire stages (~parity) and its whole host path is ~1 ms/group,
+    # 25x below that shape's ~5 ms epochs — not the binder.
+    gated = record["shapes"]["cluster_tpu_1srv"]["reduction_x"]
+    small = record["shapes"]["cluster_scaling_N4"]["reduction_x"]
+    print(f"host-bound-shape critical-path reduction: {gated:.1f}x "
+          f"(acceptance bar: >= 2x); small-shape (informational): "
+          f"{small:.1f}x")
+    return 0 if gated >= 2.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
