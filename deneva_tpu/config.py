@@ -364,6 +364,37 @@ class Config:
     #                                replay the command log, rejoin the
     #                                mesh at the next group boundary
 
+    # ---- elastic membership (slot-map routing + live rebalance;
+    # runtime/membership.py).  All defaults OFF: with elastic=False every
+    # path takes the static modulo-striping code exactly. ----
+    elastic: bool = False          # slot-map ownership: S hash slots ->
+    #                                owner node replace implicit
+    #                                key % node_cnt everywhere.  The boot
+    #                                map degenerates to EXACT modulo
+    #                                striping (S is rounded to a multiple
+    #                                of the boot active count), so with no
+    #                                rebalance triggered all routing,
+    #                                logs, replica streams and acks are
+    #                                bit-identical to elastic=False.
+    #                                Tables hold the FULL keyspace
+    #                                (ownership is the mask, local slot ==
+    #                                key) so acquired slots always have a
+    #                                resident row to install into.
+    elastic_slots: int = 256       # base slot count S (rounded up to a
+    #                                multiple of node_cnt-elastic_spare_cnt)
+    elastic_spare_cnt: int = 0     # trailing servers that boot slotless
+    #                                (warm spares for mid-run scale-out);
+    #                                they join the epoch exchange with
+    #                                empty contributions until a grow
+    #                                rebalance moves slots onto them
+    elastic_plan: str = ""         # controller-driven rebalance:
+    #                                "grow:NODE:EPOCH" | "drain:NODE:EPOCH"
+    #                                — server 0 announces MIGRATE_BEGIN at
+    #                                the first group boundary >= EPOCH,
+    #                                cutover lands 3 groups later (same
+    #                                margin discipline as the measurement
+    #                                window announcement)
+
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
     checkpoint_path: str = ""      # "" = checkpointing off
@@ -390,6 +421,13 @@ class Config:
             return None
         node, epoch = self.fault_kill.split(":")
         return int(node), int(epoch)
+
+    def elastic_plan_spec(self) -> tuple[str, int, int] | None:
+        """Parse elastic_plan 'grow|drain:node:epoch' (None when unset)."""
+        if not self.elastic_plan:
+            return None
+        kind, node, epoch = self.elastic_plan.split(":")
+        return kind, int(node), int(epoch)
 
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw).validate()
@@ -536,6 +574,46 @@ class Config:
             _check(self.logging,
                    "fault_kill/recover need --logging: recovery rebuilds "
                    "state by replaying the command log")
+        if self.elastic:
+            _check(self.workload == WorkloadKind.YCSB,
+                   "elastic membership currently supports YCSB only (the "
+                   "dense keyspace makes slot->rows enumeration and "
+                   "full-residency tables exact); TPCC/PPS keep static "
+                   "striping")
+            _check(self.cc_alg in (CCAlg.CALVIN, CCAlg.TPU_BATCH),
+                   "elastic membership requires a deterministic backend "
+                   "(CALVIN/TPU_BATCH): cutover at a group boundary and "
+                   "failover-by-replay both rely on deterministic merged "
+                   "verdicts")
+            _check(self.dist_protocol != "vote",
+                   "elastic membership runs the merged sequencer "
+                   "exchange; the VOTE protocol's static owner map does "
+                   "not rebalance")
+            _check(self.device_parts == 1,
+                   "elastic (process-level) and device_parts (chip-level) "
+                   "repartitioning do not compose yet")
+            _check(0 <= self.elastic_spare_cnt < self.node_cnt,
+                   "elastic_spare_cnt must leave >= 1 active server")
+            _check(self.elastic_slots >= 1, "elastic_slots must be >= 1")
+        else:
+            _check(self.elastic_spare_cnt == 0 and not self.elastic_plan,
+                   "elastic_spare_cnt/elastic_plan need --elastic=true")
+        if self.elastic_plan:
+            parts = self.elastic_plan.split(":")
+            _check(len(parts) == 3 and parts[0] in ("grow", "drain")
+                   and parts[1].lstrip("-").isdigit()
+                   and parts[2].lstrip("-").isdigit(),
+                   f"elastic_plan must be 'grow|drain:NODE:EPOCH', got "
+                   f"{self.elastic_plan!r}")
+            _check(0 <= int(parts[1]) < self.node_cnt,
+                   "elastic_plan node must name a server node")
+            _check(int(parts[2]) >= 0, "elastic_plan epoch must be >= 0")
+        if self.elastic and self.fault_kill:
+            # failover-with-reassignment: survivors absorb the dead
+            # node's slots by log replay — never restart it
+            _check(int(self.fault_kill.split(":")[0]) != 0,
+                   "elastic reassignment cannot lose node 0 (the "
+                   "measure/stop coordinator); kill node >= 1")
         if self.workload == WorkloadKind.PPS:
             mix = (self.perc_getparts + self.perc_getproducts + self.perc_getsuppliers
                    + self.perc_getpartbyproduct + self.perc_getpartbysupplier

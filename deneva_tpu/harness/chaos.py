@@ -21,9 +21,25 @@ subsystem end to end —
                    log prefix, and each replica log stays a byte prefix
                    of its primary's.
 
+Elastic membership scenarios (runtime/membership.py; `elastic` expands
+to all three):
+
+* **elastic-grow**    N=2 active -> 3: a slotless warm spare absorbs an
+                   even share of slots mid-run (MIGRATE_BEGIN/ROWS
+                   cutover at a group boundary); every server must agree
+                   on commits across the cutover and the spare must end
+                   up owning slots with migrated rows.
+* **elastic-drain**   N=3 -> 2: a node's slots deal onto the survivors;
+                   it ends slotless (ready to retire) with zero lost or
+                   duplicated txns.
+* **elastic-kill-reassign**  a killed server's slots move to the
+                   SURVIVORS (log-replay row rebuild) instead of waiting
+                   for its restart; liveness + exactly-once across the
+                   takeover.
+
 Every scenario runs from a fixed fault_seed, so failures reproduce.
 
-CLI:  python -m deneva_tpu.harness.chaos [scenario ...|all] [--quick]
+CLI:  python -m deneva_tpu.harness.chaos [scenario ...|all|elastic] [--quick]
 """
 
 from __future__ import annotations
@@ -59,7 +75,27 @@ SCENARIOS: dict[str, dict] = {
     "kill-one-server": dict(
         fault_kill="1:64", logging=True, replica_cnt=1, done_secs=4.0,
         fault_recovery_timeout_s=300.0),
+    # elastic membership (log dirs on /dev/shm: /tmp is 9p on the CI
+    # box and the per-epoch fsync would throttle the timed gate)
+    "elastic-grow": dict(
+        node_cnt=3, epoch_batch=256, elastic=True, elastic_spare_cnt=1,
+        elastic_plan="grow:2:16", done_secs=3.0),
+    "elastic-drain": dict(
+        node_cnt=3, epoch_batch=256, elastic=True,
+        elastic_plan="drain:2:16", done_secs=3.0),
+    # done_secs=8: the survivors' replay-jit takeover stall measured
+    # 4.4-4.7 s on the CI box — a 4 s window was intermittently
+    # swallowed whole (zero commits in the measured window)
+    "elastic-kill-reassign": dict(
+        node_cnt=3, epoch_batch=256, elastic=True, fault_kill="2:64",
+        logging=True, done_secs=8.0, log_dir="/dev/shm/deneva_logs",
+        fault_recovery_timeout_s=300.0),
 }
+
+# `elastic` on the CLI expands to the three membership scenarios (the
+# tools/smoke.sh elastic gate)
+ELASTIC_SCENARIOS = ("elastic-grow", "elastic-drain",
+                     "elastic-kill-reassign")
 
 
 class ChaosViolation(AssertionError):
@@ -82,7 +118,11 @@ def run_scenario(name: str, quick: bool = False,
         raise KeyError(f"unknown scenario {name!r} "
                        f"(have {sorted(SCENARIOS)})")
     spec = dict(SCENARIOS[name])
-    if quick:
+    if quick and not name.startswith("elastic-"):
+        # elastic scenarios keep their full window: the cutover stall
+        # (row stream + boundary sync, 1.4-2.2 s measured on the CI box;
+        # ~5 s replay-jit for kill-reassign) would otherwise swallow a
+        # clamped measured window and report zero commits
         spec["done_secs"] = min(spec.get("done_secs", 2.0), 1.5)
     spec.update(overrides)
     cfg = chaos_cfg(**spec)
@@ -109,7 +149,10 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
     _require(set(out) == set(range(n_all)),
              f"{name}: nodes {sorted(set(range(n_all)) - set(out))} "
              "never reported")
-    srv = [parse_summary(out[s][1]) for s in range(n_srv)]
+    # an elastic-reassigned server reports as kind "killed" with no
+    # summary (it was retired in place, never restarted)
+    srv_ids = [s for s in range(n_srv) if out[s][0] == "server"]
+    srv = [parse_summary(out[s][1]) for s in srv_ids]
     cls = [parse_summary(out[n_srv + c][1]) for c in range(n_cl)]
     commits = [s["total_txn_commit_cnt"] for s in srv]
     report["commits"] = commits
@@ -125,8 +168,9 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
                  f"{name}: more unique acks ({c['txn_cnt']}) than unique "
                  f"sends ({c['sent_cnt']}) — a tag was acked twice")
     if name != "kill-one-server":
-        # deterministic replicated validation must survive the faults:
-        # identical [summary] commit counts on every server
+        # deterministic replicated validation must survive the faults
+        # (and any membership cutover): identical [summary] commit
+        # counts on every reporting server
         _require(len(set(commits)) == 1 and commits[0] > 0,
                  f"{name}: server commit counts diverged: {commits}")
     if name == "lossy-net":
@@ -141,6 +185,65 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
         _require(dup_seen > 0, "dup-storm: no duplicate was ever seen")
     if name == "kill-one-server":
         _check_recovery(cfg, out, run_id, report)
+    if name.startswith("elastic-"):
+        _check_elastic(name, cfg, out, report)
+
+
+def _check_elastic(name: str, cfg: Config, out: dict, report: dict) -> None:
+    """Membership invariants: exactly one cutover, full slot coverage
+    after it, rows actually moved, and the subject node's role change
+    (spare -> owner for grow, owner -> slotless for drain, dead ->
+    reassigned for kill)."""
+    from deneva_tpu.runtime.membership import initial_map
+
+    n_slots = initial_map(cfg).n_slots
+    srv = {s: parse_summary(out[s][1]) for s in range(cfg.node_cnt)
+           if out[s][0] == "server"}
+    report["map_version"] = sorted(v.get("map_version", -1)
+                                   for v in srv.values())
+    _require(all(v.get("map_version", -1) == 1 for v in srv.values()),
+             f"{name}: map versions diverged: {report['map_version']}")
+    _require(all(v.get("rebalance_cnt", 0) == 1 for v in srv.values()),
+             f"{name}: expected exactly one rebalance everywhere")
+    owned = {s: v.get("owned_slots", -1) for s, v in srv.items()}
+    report["owned_slots"] = owned
+    report["rows_migrated"] = {s: v.get("rows_migrated", 0)
+                               for s, v in srv.items()}
+    if name == "elastic-grow":
+        node = cfg.elastic_plan_spec()[1]
+        _require(sum(owned.values()) == n_slots,
+                 f"{name}: slot coverage broken: {owned} != {n_slots}")
+        _require(owned[node] > 0,
+                 f"{name}: the spare never absorbed slots: {owned}")
+        _require(srv[node].get("rows_migrated_in", 0) > 0,
+                 f"{name}: no rows streamed onto the grown node")
+        _require(all(srv[s].get("rows_migrated_out", 0) > 0
+                     for s in srv if s != node),
+                 f"{name}: a donor streamed no rows")
+    elif name == "elastic-drain":
+        node = cfg.elastic_plan_spec()[1]
+        _require(sum(owned.values()) == n_slots,
+                 f"{name}: slot coverage broken: {owned} != {n_slots}")
+        _require(owned[node] == 0,
+                 f"{name}: the drained node still owns slots: {owned}")
+        _require(srv[node].get("rows_migrated_out", 0) > 0,
+                 f"{name}: the drained node streamed no rows")
+        _require(all(srv[s].get("rows_migrated_in", 0) > 0
+                     for s in srv if s != node),
+                 f"{name}: a survivor received no rows")
+    elif name == "elastic-kill-reassign":
+        kill_node, _ = cfg.fault_kill_spec()
+        _require(out[kill_node][0] == "killed",
+                 f"{name}: the killed node was restarted instead of "
+                 "reassigned")
+        _require(kill_node not in srv and len(srv) == cfg.node_cnt - 1,
+                 f"{name}: unexpected server reports: {sorted(srv)}")
+        _require(sum(owned.values()) == n_slots,
+                 f"{name}: survivors do not cover the slot space: "
+                 f"{owned} != {n_slots}")
+        _require(all(v.get("rows_migrated_in", 0) > 0
+                     for v in srv.values()),
+                 f"{name}: a survivor rebuilt no rows by replay")
 
 
 def _check_recovery(cfg: Config, out: dict, run_id: str,
@@ -223,6 +326,8 @@ def main(argv: list[str]) -> int:
     names = [a for a in argv if not a.startswith("--")]
     if not names or names == ["all"]:
         names = list(SCENARIOS)
+    names = [x for n in names
+             for x in (ELASTIC_SCENARIOS if n == "elastic" else (n,))]
     rc = 0
     for name in names:
         try:

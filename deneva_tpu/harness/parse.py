@@ -13,6 +13,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import os
+import re
 from typing import Any
 
 from deneva_tpu.config import Config
@@ -110,6 +111,31 @@ def results_table(out_dir: str, x: str, y: str = "tput",
     for pts in table.values():
         pts.sort()
     return table
+
+
+_MEMBER = re.compile(r"\[membership\] (.*)")
+
+
+def parse_membership(lines) -> list[dict[str, Any]]:
+    """Per-cutover ``[membership]`` lines (runtime/membership.py) ->
+    [{node, version, epoch, reason, subject, slots_moved, owned,
+    rows_in, rows_out, stall_ms}].  Logs predating the membership
+    subsystem simply yield [] — and every other parser here ignores
+    ``[membership]`` lines, so old tooling keeps working on new logs
+    (forward/backward compat, tested in tests/test_harness.py)."""
+    out = []
+    for line in lines:
+        m = _MEMBER.search(line)
+        if not m:
+            continue
+        d: dict[str, Any] = {}
+        for kv in m.group(1).split():
+            if "=" not in kv:
+                continue
+            k, v = kv.split("=", 1)
+            d[k] = _auto(v)
+        out.append(d)
+    return out
 
 
 def cfg_header(cfg: Config) -> str:

@@ -9,13 +9,19 @@ servers under ``--debug_timeline=true`` (`deneva_tpu.runtime.server`):
 
 This CLI aggregates those lines into a per-node × per-phase table
 (total / mean / p95 milliseconds) — the where-does-the-epoch-go view the
-reference builds its timeline plots for.
+reference builds its timeline plots for.  ``--trace out.json`` instead
+exports the spans as a Chrome trace (chrome://tracing / Perfetto: one
+process track per node, one complete event per phase span, epoch in the
+args), so a migration cutover or a blob-wait stall shows up as a visible
+gap on a real timeline instead of only an aggregate row.
 
     python -m deneva_tpu.harness.timeline run.log [--node N] [--tsv]
+                                                  [--trace out.json]
 """
 
 from __future__ import annotations
 
+import json
 import re
 import sys
 
@@ -60,6 +66,30 @@ def phase_table(rows: list[dict], node: int | None = None) -> list[list[str]]:
     return table
 
 
+def chrome_trace(rows: list[dict]) -> dict:
+    """Chrome-trace (Perfetto) event JSON from parsed ``[timeline]``
+    rows.  The log lines carry durations, not wall timestamps, so each
+    node's track is the running sum of its spans — phase ORDER and WIDTH
+    are exact; cross-node alignment is epoch-relative (every node starts
+    at t=0), which is what the lockstep epoch exchange makes meaningful.
+    """
+    events: list[dict] = []
+    clock: dict[int, float] = {}          # node -> running time (us)
+    for r in rows:
+        t = clock.get(r["node"], 0.0)
+        for name, ms in r["phases"].items():
+            dur = ms * 1000.0
+            events.append({"name": name, "ph": "X", "pid": r["node"],
+                           "tid": 0, "ts": round(t, 3),
+                           "dur": round(dur, 3),
+                           "args": {"epoch": r["epoch"]}})
+            t += dur
+        clock[r["node"]] = t
+    meta = [{"name": "process_name", "ph": "M", "pid": n, "tid": 0,
+             "args": {"name": f"node {n}"}} for n in sorted(clock)]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
 def render(table: list[list[str]], tsv: bool = False) -> str:
     if len(table) <= 1:
         return "(no [timeline] lines found — run with --debug_timeline=true)"
@@ -73,7 +103,7 @@ def render(table: list[list[str]], tsv: bool = False) -> str:
 def main(argv: list[str]) -> int:
     if not argv or argv[0].startswith("-"):
         print("usage: python -m deneva_tpu.harness.timeline <log-file> "
-              "[--node N] [--tsv]", file=sys.stderr)
+              "[--node N] [--tsv] [--trace out.json]", file=sys.stderr)
         return 2
     node = None
     if "--node" in argv:
@@ -82,8 +112,23 @@ def main(argv: list[str]) -> int:
             print("--node needs a value", file=sys.stderr)
             return 2
         node = int(argv[i + 1])
+    trace_out = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 >= len(argv):
+            print("--trace needs an output path", file=sys.stderr)
+            return 2
+        trace_out = argv[i + 1]
     with open(argv[0]) as f:
         rows = parse_timeline(f)
+    if trace_out is not None:
+        if node is not None:
+            rows = [r for r in rows if r["node"] == node]
+        with open(trace_out, "w") as f:
+            json.dump(chrome_trace(rows), f)
+        print(f"wrote {sum(len(r['phases']) for r in rows)} spans "
+              f"({len(rows)} epochs) to {trace_out}")
+        return 0
     print(render(phase_table(rows, node), tsv="--tsv" in argv))
     return 0
 

@@ -79,6 +79,32 @@ class ClientNode:
         self._sweep_next_us = 0
         self._resend_cnt = 0
         self._dup_acks = 0
+        # ---- elastic membership (runtime/membership.py): target only
+        # servers that own slots; MAP_UPDATE (install broadcast or a
+        # drained server's redirect NACK) refreshes the active set and
+        # the resend sweep retargets unacked tags onto an owner.  With
+        # elastic off (default) every server is active and no code path
+        # below changes. ----
+        self._elastic = cfg.elastic
+        self._map_version = 0
+        self._redirect_resends = 0
+        if self._elastic:
+            from deneva_tpu.runtime.membership import initial_map
+            self._active = np.zeros(self.n_srv, bool)
+            self._active[[n for n in initial_map(cfg).active_nodes()
+                          if n < self.n_srv]] = True
+        else:
+            self._active = np.ones(self.n_srv, bool)
+        self._rr = 0   # rotating retarget cursor
+        # elastic + fault mode: remember which server each tag's inflight
+        # credit is CHARGED to.  After a retarget, the first ack may come
+        # from a different server than the charge (the drained-but-alive
+        # original releasing a held CL_RSP, or the retarget target
+        # re-acking) — decrementing by ack SOURCE would leak credit on
+        # one server and drive another negative; decrementing the charged
+        # server is exact either way.
+        self._tag_srv = (np.zeros(TAG_RING, np.int16)
+                         if (cfg.elastic and self._fault_mode) else None)
         self.inflight = np.zeros(self.n_srv, np.int64)
         self.chunk = cfg.client_batch_size
         # reference: inflight cap is per server pair (client_txn.cpp:25);
@@ -151,7 +177,15 @@ class ClientNode:
                     if not len(tags):
                         return
                 self._unacked[tags % TAG_RING] = False
-            self.inflight[src] -= len(tags)       # src is a server id
+            if self._tag_srv is not None:
+                # release each tag's credit from the server it is
+                # charged to (may differ from the answering server
+                # after a retarget)
+                self.inflight -= np.bincount(
+                    self._tag_srv[tags % TAG_RING], minlength=self.n_srv
+                )[: self.n_srv]
+            else:
+                self.inflight[src] -= len(tags)   # src is a server id
             slot = tags % TAG_RING
             vals = (now - self.send_us[slot]) / 1e6     # seconds
             # append each sample ONCE, into its type family — the
@@ -169,6 +203,15 @@ class ClientNode:
                     self.stats.arr(
                         f"{self.type_names[t]}_latency").extend(vals[m])
             self.stats.incr("txn_cnt", len(tags))
+        elif rtype == "MAP_UPDATE":
+            from deneva_tpu.runtime.membership import decode_map_msg
+            smap, _cut, _reason, _subject = decode_map_msg(payload)
+            if smap.version > self._map_version:
+                self._map_version = smap.version
+                act = np.zeros(self.n_srv, bool)
+                act[[n for n in smap.active_nodes()
+                     if n < self.n_srv]] = True
+                self._active = act
         elif rtype == "SHUTDOWN":
             self.stop = True
 
@@ -200,6 +243,20 @@ class ClientNode:
             if not alive.any():
                 continue
             sub = blk if alive.all() else blk.take(np.where(alive)[0])
+            if self._elastic and not self._active[srv]:
+                # the original target was drained, reassigned, or died:
+                # retarget the unacked tags onto an owner (the server's
+                # idempotent admission dedups / re-acks as usual — the
+                # committed set outlives its admitting server)
+                act = np.where(self._active)[0]
+                if len(act):
+                    old = srv
+                    srv = int(act[self._rr % len(act)])
+                    self._rr += 1
+                    self._redirect_resends += len(sub)
+                    self.inflight[old] -= len(sub)
+                    self.inflight[srv] += len(sub)
+                    self._tag_srv[sub.tags % TAG_RING] = srv
             self.tp.sendv(srv, "CL_QRY_BATCH",
                           wire.qry_block_parts(sub.tags, sub.keys,
                                                sub.types, sub.scalars))
@@ -226,6 +283,8 @@ class ClientNode:
                                  self.cap - self.inflight).astype(np.int64)
             for _ in range(self.n_srv):
                 srv = (srv + 1) % self.n_srv
+                if not self._active[srv]:       # slotless under the map
+                    continue
                 n = int(budgets[srv])
                 if n < 64:                      # not worth a message yet
                     continue
@@ -253,6 +312,8 @@ class ClientNode:
                                                    blk.scalars[:n]))
                 if self._fault_mode:
                     self._unacked[tags] = True
+                    if self._tag_srv is not None:
+                        self._tag_srv[tags] = srv
                     self._resend_q.append((now, srv, wire.QueryBlock(
                         blk.keys[:n], blk.types[:n], blk.scalars[:n],
                         tags)))
@@ -284,6 +345,9 @@ class ClientNode:
             st.set("resend_cnt", float(self._resend_cnt))
             st.set("dup_ack_cnt", float(self._dup_acks))
             st.set("unacked_cnt", float(int(self._unacked.sum())))
+        if self._elastic:
+            st.set("map_version", float(self._map_version))
+            st.set("redirect_resend_cnt", float(self._redirect_resends))
         for k, v in self.tp.stats().items():
             if not self._fault_mode and k in ("msg_dropped", "msg_dup",
                                               "reconnects"):

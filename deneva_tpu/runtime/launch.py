@@ -165,6 +165,20 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
                                 and not p.is_alive()
                                 and p.exitcode not in (0, None)):
                             restarted.add(s)
+                            if cfg.elastic:
+                                # failover-with-reassignment: the
+                                # survivors absorb the dead node's slots
+                                # by log replay — never restart it; its
+                                # report slot closes as "killed". Only
+                                # the deliberate fault_kill exit
+                                # (os._exit(17)) is planned; any other
+                                # code is a genuine crash
+                                if p.exitcode != 17:
+                                    raise RuntimeError(
+                                        f"server {s} crashed (exitcode "
+                                        f"{p.exitcode}) in elastic mode")
+                                out[s] = ("killed", "")
+                                continue
                             rp = ctx.Process(
                                 target=_server_main,
                                 args=(cfg.replace(node_id=s,

@@ -267,12 +267,18 @@ def state_digest(db) -> str:
     """Order-stable sha256 over every pytree leaf of the engine state
     (the bit-for-bit recovery check: a replayed partition must hash
     identically to the state it reconstructs; pytree flattening order is
-    deterministic for a fixed structure)."""
+    deterministic for a fixed structure).  Leaves under ``__*__`` dict
+    keys (control-plane state: the elastic membership owner array) are
+    excluded — the digest covers ROW state, so an elastic run with no
+    rebalance hashes identically to the same tables under static
+    membership."""
     import hashlib
 
     import jax
 
     h = hashlib.sha256()
-    for leaf in jax.tree_util.tree_leaves(db):
+    for path, leaf in jax.tree_util.tree_flatten_with_path(db)[0]:
+        if any(str(getattr(p, "key", "")).startswith("__") for p in path):
+            continue
         h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
     return h.hexdigest()

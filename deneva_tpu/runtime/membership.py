@@ -1,0 +1,271 @@
+"""Elastic membership: slot-map routing + live partition rebalance.
+
+The reference pins ownership at boot with modulo striping (``GET_NODE_ID``,
+`system/global.h:294`): ``node_cnt`` is frozen into every partition mask,
+so the fleet can never grow, shrink, or shed a dead node's keys.  Here
+ownership is a **version-stamped slot map**: ``S`` fixed hash slots, each
+owned by one server node, with ``slot(key) = key % S``.  Everything that
+used ``key % node_cnt`` routes through the map instead, and a rebalance is
+one atomic map-version bump applied at a group boundary — the same
+epoch-boundary cutpoint the durability (PR 1 ack gating) and determinism
+(PR 3 bit-identical overlap) machinery already quantizes on, and exactly
+the hook epoch-based redistribution schemes exploit (PAPERS: epoch-based
+OCC in geo-replicated databases; DGCC's epoch-batched handoff).
+
+Degeneracy contract (the aliasing discipline the escrow gate and
+host_overlap used): the boot map deals slots ``s -> s % active_cnt`` with
+``S`` rounded up to a multiple of the boot active count, so
+``owner(key) = owners[key % S] = key % active_cnt`` — EXACT modulo
+striping.  With no rebalance triggered, every routing decision is
+bit-identical to the static-membership runtime; the whole subsystem is
+one flag (``Config.elastic``) away from the published baselines.
+
+Rebalance plans are deterministic pure functions of (map, subject), so
+every node that applies the same plan at the same boundary installs the
+same new map with no negotiation:
+
+* ``plan_grow``    — a (possibly spare, slotless) node absorbs an even
+                     share of slots from the current owners (scale-out);
+* ``plan_drain``   — a node's slots deal round-robin onto the survivors
+                     (scale-in; the node keeps participating in the epoch
+                     exchange but serves no keys and NACK-redirects new
+                     client batches);
+* ``plan_reassign``— ``plan_drain`` for a DEAD node: survivors absorb its
+                     slots and rebuild the rows by deterministic replay of
+                     their own command logs instead of waiting for the
+                     crashed process to restart.
+
+Wire bodies (ride the native framed transport, see `runtime/native.py`
+rtypes):
+
+* MIGRATE_BEGIN  controller→servers: (cutover_epoch, reason, subject,
+                 new map) announced >= 3 groups ahead, like the
+                 measurement-window announcement.
+* MIGRATE_ROWS   donor→recipient: the moving slots' rows snapshotted from
+                 the donor's `DeviceTable` at the boundary (columnar,
+                 zero-copy sendv parts on the send side).
+* MAP_UPDATE     server→clients: the installed map (also the redirect-
+                 NACK payload a drained server answers stale CL_QRY_BATCH
+                 with — the client retargets the unacked tags).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+import numpy as np
+
+# db pytree key of the device-resident owner array (int32[S]): control-
+# plane state that rides the table pytree so ownership changes never
+# trigger a re-jit (the array is data, not a trace constant).  Leaves
+# named "__*__" are excluded from `logger.state_digest` — the digest
+# covers row state, not the control plane.
+MEMBER_KEY = "__membership__"
+
+# MIGRATE_BEGIN / MAP_UPDATE reasons
+REASON_INSTALL = 0     # plain map install / redirect NACK
+REASON_GROW = 1
+REASON_DRAIN = 2
+REASON_REASSIGN = 3
+REASON_NAME = {REASON_INSTALL: "install", REASON_GROW: "grow",
+               REASON_DRAIN: "drain", REASON_REASSIGN: "reassign"}
+
+
+def n_slots_for(base: int, active_cnt: int) -> int:
+    """Slot count: ``base`` rounded UP to a multiple of the boot active
+    node count, so the boot deal ``s % active_cnt`` degenerates to exact
+    modulo striping (``key % S % active_cnt == key % active_cnt`` holds
+    iff active_cnt divides S)."""
+    a = max(1, active_cnt)
+    return -(-max(base, a) // a) * a
+
+
+@dataclass(frozen=True)
+class SlotMap:
+    """Version-stamped slot → owner map.  Immutable; rebalance plans
+    return a new map with ``version + 1``."""
+
+    version: int
+    owners: np.ndarray          # int32[S]
+
+    def __post_init__(self):
+        object.__setattr__(self, "owners",
+                           np.ascontiguousarray(self.owners, np.int32))
+
+    @property
+    def n_slots(self) -> int:
+        return len(self.owners)
+
+    def owner_of(self, keys: np.ndarray) -> np.ndarray:
+        return self.owners[np.asarray(keys) % self.n_slots]
+
+    def slots_of(self, node: int) -> np.ndarray:
+        return np.where(self.owners == node)[0].astype(np.int32)
+
+    def active_nodes(self) -> list[int]:
+        return sorted(int(o) for o in np.unique(self.owners))
+
+    def counts(self) -> dict[int, int]:
+        u, c = np.unique(self.owners, return_counts=True)
+        return {int(k): int(v) for k, v in zip(u, c)}
+
+
+def initial_map(cfg) -> SlotMap:
+    """Boot map: slots dealt round-robin over the non-spare servers
+    (trailing ``elastic_spare_cnt`` nodes boot slotless — warm spares the
+    controller can grow onto mid-run)."""
+    active = max(1, cfg.node_cnt - cfg.elastic_spare_cnt)
+    s = n_slots_for(cfg.elastic_slots, active)
+    return SlotMap(version=0,
+                   owners=(np.arange(s, dtype=np.int32) % active))
+
+
+def plan_grow(m: SlotMap, node: int) -> SlotMap:
+    """Move an even share of slots onto ``node`` (deterministic greedy:
+    walk slots in order, take from owners above the post-grow fair
+    share).  ``node`` may already own slots (top-up to fair share)."""
+    owners = m.owners.copy()
+    cnt = m.counts()
+    members = sorted(set(cnt) | {node})
+    fair = m.n_slots // len(members)
+    have = cnt.get(node, 0)
+    for s in range(m.n_slots):
+        if have >= fair:
+            break
+        o = int(owners[s])
+        if o != node and cnt[o] > fair:
+            owners[s] = node
+            cnt[o] -= 1
+            have += 1
+    return SlotMap(m.version + 1, owners)
+
+
+def plan_drain(m: SlotMap, node: int) -> SlotMap:
+    """Deal ``node``'s slots round-robin onto the surviving owners."""
+    survivors = [n for n in m.active_nodes() if n != node]
+    if not survivors:
+        raise ValueError(f"cannot drain node {node}: no surviving owner")
+    owners = m.owners.copy()
+    mine = np.where(owners == node)[0]
+    for i, s in enumerate(mine):
+        owners[s] = survivors[i % len(survivors)]
+    return SlotMap(m.version + 1, owners)
+
+
+def plan_reassign(m: SlotMap, dead: int) -> SlotMap:
+    """Failover-with-reassignment: identical slot movement to a drain,
+    but the recipients rebuild rows by log replay (the donor is gone)."""
+    return plan_drain(m, dead)
+
+
+def moves(old: SlotMap, new: SlotMap) -> dict[tuple[int, int], np.ndarray]:
+    """{(donor, recipient): moved slot ids} between two map versions."""
+    if old.n_slots != new.n_slots:
+        raise ValueError("slot count is fixed for the lifetime of a map")
+    out: dict[tuple[int, int], list[int]] = {}
+    changed = np.where(old.owners != new.owners)[0]
+    for s in changed:
+        out.setdefault((int(old.owners[s]), int(new.owners[s])),
+                       []).append(int(s))
+    return {k: np.asarray(v, np.int32) for k, v in sorted(out.items())}
+
+
+def keys_of_slots(slots: np.ndarray, n_rows: int, n_slots: int
+                  ) -> np.ndarray:
+    """All keys of the dense [0, n_rows) keyspace living in ``slots``
+    (``key % n_slots`` slot hashing), ascending."""
+    keys = np.arange(n_rows, dtype=np.int64)
+    return keys[np.isin(keys % n_slots, np.asarray(slots))].astype(np.int32)
+
+
+# ---- wire codecs -------------------------------------------------------
+# MAP_UPDATE / MIGRATE_BEGIN body:
+#   version i64 | cutover i64 | reason u8 | pad u8 | subject i16 | S u32
+#   | owners i32[S]
+_MAP = struct.Struct("<qqBBhI")
+# MIGRATE_ROWS body:
+#   version i64 | n_rows u32 | n_cols u32
+#   | keys i32[n]
+#   | per column: name_len u16 | name | dtype_len u16 | dtype str
+#                 | ndim u16 | dims u32[ndim] | payload bytes
+_ROWS = struct.Struct("<qII")
+_U16 = struct.Struct("<H")
+
+
+def encode_map_msg(m: SlotMap, cutover_epoch: int = -1,
+                   reason: int = REASON_INSTALL, subject: int = -1) -> bytes:
+    return (_MAP.pack(m.version, cutover_epoch, reason, 0, subject,
+                      m.n_slots)
+            + m.owners.tobytes())
+
+
+def decode_map_msg(buf: bytes) -> tuple[SlotMap, int, int, int]:
+    """-> (map, cutover_epoch, reason, subject)."""
+    version, cutover, reason, _pad, subject, s = _MAP.unpack_from(buf)
+    owners = np.frombuffer(buf, np.int32, count=s, offset=_MAP.size).copy()
+    return SlotMap(version, owners), cutover, reason, subject
+
+
+def encode_migrate_rows(version: int, keys: np.ndarray,
+                        cols: dict[str, np.ndarray]) -> bytes:
+    """Donor snapshot of the moving rows: row keys + the named column
+    values (any dtype/shape — full-row byte columns ship as-is)."""
+    keys = np.ascontiguousarray(keys, np.int32)
+    parts = [_ROWS.pack(version, len(keys), len(cols)), keys.tobytes()]
+    for name, v in cols.items():
+        v = np.ascontiguousarray(v)
+        nb = name.encode()
+        db = v.dtype.str.encode()
+        parts.append(_U16.pack(len(nb)) + nb + _U16.pack(len(db)) + db
+                     + _U16.pack(v.ndim)
+                     + np.asarray(v.shape, np.uint32).tobytes()
+                     + v.tobytes())
+    return b"".join(parts)
+
+
+def peek_rows_version(buf: bytes) -> int:
+    """Map version of a MIGRATE_ROWS payload without decoding the body
+    (the server buffers raw payloads keyed by version)."""
+    return _ROWS.unpack_from(buf)[0]
+
+
+def decode_migrate_rows(buf: bytes
+                        ) -> tuple[int, np.ndarray, dict[str, np.ndarray]]:
+    """-> (version, keys, {column name: values})."""
+    version, n, n_cols = _ROWS.unpack_from(buf)
+    off = _ROWS.size
+    keys = np.frombuffer(buf, np.int32, count=n, offset=off).copy()
+    off += 4 * n
+    cols: dict[str, np.ndarray] = {}
+    for _ in range(n_cols):
+        (nl,) = _U16.unpack_from(buf, off)
+        off += _U16.size
+        name = buf[off:off + nl].decode()
+        off += nl
+        (dl,) = _U16.unpack_from(buf, off)
+        off += _U16.size
+        dt = np.dtype(buf[off:off + dl].decode())
+        off += dl
+        (ndim,) = _U16.unpack_from(buf, off)
+        off += _U16.size
+        shape = tuple(np.frombuffer(buf, np.uint32, count=ndim,
+                                    offset=off).astype(int))
+        off += 4 * ndim
+        nbytes = int(np.prod(shape)) * dt.itemsize if ndim else dt.itemsize
+        cols[name] = np.frombuffer(buf, dt, count=int(np.prod(shape)),
+                                   offset=off).reshape(shape).copy()
+        off += nbytes
+    return version, keys, cols
+
+
+def membership_line(node: int, m: SlotMap, epoch: int, reason: int,
+                    subject: int, slots_moved: int, rows_in: int,
+                    rows_out: int, stall_ms: float) -> str:
+    """The per-cutover `[membership]` log line (parsed by
+    `harness.parse.parse_membership`)."""
+    return (f"[membership] node={node} version={m.version} epoch={epoch} "
+            f"reason={REASON_NAME.get(reason, reason)} subject={subject} "
+            f"slots_moved={slots_moved} owned={len(m.slots_of(node))} "
+            f"rows_in={rows_in} rows_out={rows_out} "
+            f"stall_ms={stall_ms:.1f}")

@@ -30,6 +30,12 @@ RTYPE = {
     "INIT_DONE": 1, "CL_QRY_BATCH": 2, "CL_RSP": 3, "RDONE": 4,
     "EPOCH_BLOB": 5, "LOG_MSG": 6, "LOG_RSP": 7, "PING": 8, "PONG": 9,
     "SHUTDOWN": 10, "MEASURE": 11, "VOTE": 12, "VOTE2": 13, "REJOIN": 14,
+    # elastic membership (runtime/membership.py): rebalance announcement,
+    # row migration stream, and the client-facing map install / redirect
+    # NACK.  Deliberately OUTSIDE FAULT_RTYPE_MASK: the migration stream
+    # is control plane, like the epoch exchange — its fault mode is
+    # process death, not silent loss.
+    "MIGRATE_BEGIN": 15, "MIGRATE_ROWS": 16, "MAP_UPDATE": 17,
 }
 RTYPE_NAME = {v: k for k, v in RTYPE.items()}
 

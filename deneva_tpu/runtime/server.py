@@ -173,8 +173,16 @@ def make_dist_group(cfg: Config, wl, be, width: int, n_scalars: int):
     C = max(1, cfg.pipeline_epochs)
     b_loc = b // cfg.node_cnt
     lo = cfg.node_id * b_loc
-    sl = slice(lo, lo + b_loc)
-    pb = (b_loc + 7) // 8 * 8          # bit-pack padding
+    # elastic + faults: verdict planes cover the FULL merged batch, not
+    # just this node's slice — a survivor needs every slice's committed
+    # tags for re-ack takeover after a dead peer's slots are reassigned
+    # (the committed set must outlive its admitting server).  Off this
+    # mode the shapes (and the d2h volume) are exactly the pre-elastic
+    # ones.
+    full_planes = cfg.elastic and cfg.faults_enabled
+    mask_n = b if full_planes else b_loc
+    sl = slice(0, b) if full_planes else slice(lo, lo + b_loc)
+    pb = (mask_n + 7) // 8 * 8          # bit-pack padding
 
     def scan_body(carry, xs):
         db, cc_state, stats = carry
@@ -189,7 +197,7 @@ def make_dist_group(cfg: Config, wl, be, width: int, n_scalars: int):
         # host unpacks with np.unpackbits(bitorder="little")).  The d2h
         # path of the tunneled chip runs at single-digit MB/s, so the
         # verdict planes must cross it as bits, not bools.
-        w = jnp.pad(m, ((0, 0), (0, pb - b_loc))).reshape(m.shape[0], -1, 8)
+        w = jnp.pad(m, ((0, 0), (0, pb - mask_n))).reshape(m.shape[0], -1, 8)
         weights = jnp.left_shift(jnp.ones((8,), jnp.uint8),
                                  jnp.arange(8, dtype=jnp.uint8))
         return (w.astype(jnp.uint8) * weights).sum(-1).astype(jnp.uint8)
@@ -473,6 +481,31 @@ class ServerNode:
         self.dev_stats = init_device_stats(
             len(getattr(self.wl, "txn_type_names", ("txn",))))
 
+        # ---- elastic membership (slot-map routing + live rebalance;
+        # runtime/membership.py — all off on a default config) ----------
+        self._elastic = cfg.elastic
+        self.smap = None
+        self._full_planes = cfg.elastic and cfg.faults_enabled
+        self._plane_lo = self.me * self.b_loc if self._full_planes else 0
+        self._plane_n = self.b_merged if self._full_planes else self.b_loc
+        if self._elastic:
+            from deneva_tpu.runtime import membership as _M
+            self._M = _M
+            self.smap = _M.initial_map(cfg)
+            self._mig_pending: dict | None = None
+            self._mig_rows: dict[int, dict[int, bytes]] = {}
+            self._contrib_gone: dict[int, int] = {}   # node -> 1st dead epoch
+            self._reassigned: set[int] = set()
+            self._plan_sent = False
+            self._rebalance_cnt = 0
+            self._rows_in = 0
+            self._rows_out = 0
+            self._cutover_stall_ms = 0.0
+            self._redirects = 0
+            # full-plane committed ids held until their epoch is durable
+            # (re-ack takeover authority; same gate as held CL_RSPs)
+            self._held_commit: deque[tuple[int, np.ndarray]] = deque()
+
         # ---- chaos / failover gates (all off on a default config) ------
         # _failover: peers tolerate a dead server and wait for its
         # recovered incarnation instead of raising; acks gate on whole-
@@ -698,6 +731,20 @@ class ServerNode:
     # -- message routing (reference InputThread::server_recv_loop) ------
     def _route(self, src: int, rtype: str, payload: bytes) -> None:
         if rtype == "CL_QRY_BATCH":
+            if (self._elastic and self._dedup_on
+                    and len(self.smap.slots_of(self.me)) == 0):
+                # drained/spare node in fault mode: redirect-NACK — the
+                # client's resend sweep retargets the unacked tags onto
+                # an owner (exactly-once holds: nothing was admitted).
+                # Without the fault machinery there is no resend path,
+                # so a slotless node ADMITS instead (admission is
+                # ownership-independent in the merged-deterministic
+                # model; execution stays slot-map-local) — no txn is
+                # ever dropped on the floor.
+                self._redirects += 1
+                self.tp.send(src, "MAP_UPDATE", self._M.encode_map_msg(
+                    self.smap, -1, self._M.REASON_INSTALL, self.me))
+                return
             blk = wire.decode_qry_block(payload)
             # stamp the source client into the tag's high bits? no — tags
             # are opaque to servers; remember src alongside
@@ -759,6 +806,18 @@ class ServerNode:
                 self.tp.send(src, "SHUTDOWN",
                              wire.encode_shutdown(self.stop_epoch))
             self.tp.flush()
+        elif rtype == "MIGRATE_BEGIN":
+            # controller-announced rebalance: install at the cutover
+            # group boundary (applied by _elastic_tick, never mid-group)
+            smap, cutover, reason, subject = self._M.decode_map_msg(payload)
+            if smap.version > self.smap.version:
+                self._mig_pending = dict(map=smap, cutover=cutover,
+                                         reason=reason, subject=subject)
+        elif rtype == "MIGRATE_ROWS":
+            v = self._M.peek_rows_version(payload)
+            self._mig_rows.setdefault(v, {})[src] = payload
+        elif rtype == "MAP_UPDATE":
+            pass  # client-facing; a server learns maps via MIGRATE_BEGIN
         elif rtype == "INIT_DONE":
             pass  # late barrier duplicate; the barrier itself already ran
 
@@ -1019,6 +1078,19 @@ class ServerNode:
         for i, (e, _blk, _cnt, _ts, _dfc) in enumerate(eps):
             self._wait_blobs(e)
             t0 = time.monotonic()
+            if self._elastic and self._contrib_gone:
+                # a retired contributor's slice must read as the serial
+                # path's np.zeros padding (reused buffer hygiene AND
+                # cross-node feed determinism)
+                for p, ge in self._contrib_gone.items():
+                    if ge <= e:
+                        o = p * self.b_loc
+                        hi = o + self.b_loc
+                        fs["keys"][i, o:hi] = 0
+                        fs["types"][i, o:hi] = 0
+                        fs["scal"][i, o:hi] = 0
+                        fs["tags"][i, o:hi] = 0
+                        fs["ts"][i, o:hi] = 0
             for s, payload in self.blob_buf.pop(e, {}).items():
                 o = s * self.b_loc
                 hi = o + self.b_loc
@@ -1063,11 +1135,12 @@ class ServerNode:
 
         pk = np.asarray(jax.device_get(group["masks"]))
         planes = np.unpackbits(pk, axis=-1, bitorder="little")
-        done, abort, defer = planes[:, :, :self.b_loc].astype(bool)
+        done, abort, defer = planes[:, :, :self._plane_n].astype(bool)
+        lo = self._plane_lo
         acks = []
         for i, (_e, block, abort_cnt, _ts, dfc) in enumerate(group["eps"]):
             n = len(block)
-            my_commit = done[i, :n]
+            my_commit = done[i, lo:lo + n]
             if not my_commit.any():
                 acks.append(None)
                 continue
@@ -1115,6 +1188,10 @@ class ServerNode:
                 if self.n_repl:
                     self._drain(timeout_us=10_000)
         durable = self._durable_ack_epoch()
+        if self._full_planes:
+            while self._held_commit and self._held_commit[0][0] <= durable:
+                _, ids = self._held_commit.popleft()
+                self._retire_dedup(ids)
         while self._held_rsp and self._held_rsp[0][1] <= durable:
             c, _, tags = self._held_rsp.popleft()
             if self._dedup_on:
@@ -1220,28 +1297,44 @@ class ServerNode:
         self._ph["process"] -= wait
 
     # -- blob barrier ----------------------------------------------------
+    def _exp_peers(self, epoch: int) -> list[int]:
+        """Peer servers expected to contribute to ``epoch``: everyone,
+        minus peers whose contribution is retired from a reassignment
+        cutover on (their merged-batch slice stays inactive)."""
+        if not self._elastic:
+            return [p for p in range(self.n_srv) if p != self.me]
+        return [p for p in range(self.n_srv) if p != self.me
+                and self._contrib_gone.get(p, 1 << 62) > epoch]
+
     def _wait_blobs(self, epoch: int) -> None:
-        """Block until every peer's contribution for ``epoch`` arrived
-        (the RDONE analogue), with dead-peer detection (SURVEY §5.3: the
-        reference has none — it would hang on its 1s recv timeouts).
-        In failover mode a dead peer is NOT fatal: the supervisor
-        restarts it in recovery mode, it replays its log, rejoins the
-        mesh and re-broadcasts — we keep waiting up to the recovery
-        timeout instead of aborting the whole cluster."""
+        """Block until every expected peer's contribution for ``epoch``
+        arrived (the RDONE analogue), with dead-peer detection (SURVEY
+        §5.3: the reference has none — it would hang on its 1s recv
+        timeouts).  In failover mode a dead peer is NOT fatal: the
+        supervisor restarts it in recovery mode, it replays its log,
+        rejoins the mesh and re-broadcasts — we keep waiting up to the
+        recovery timeout.  In ELASTIC failover mode the dead peer is
+        instead retired in place: every survivor deterministically
+        reassigns its slots (plan_reassign) at this stalled boundary,
+        rebuilds the acquired rows by replaying its own command log, and
+        the barrier proceeds without it."""
         t0 = time.monotonic()
         timeout = (self.cfg.fault_recovery_timeout_s if self._failover
                    else 60.0)
-        while len(self.blob_buf.get(epoch, {})) < self.n_srv - 1:
+        while True:
+            have = self.blob_buf.get(epoch, {})
+            missing = [p for p in self._exp_peers(epoch) if p not in have]
+            if not missing:
+                return
             self._drain(timeout_us=5_000)
             have = self.blob_buf.get(epoch, {})
-            if len(have) >= self.n_srv - 1:
-                break
+            missing = [p for p in self._exp_peers(epoch) if p not in have]
+            if not missing:
+                return
             # check liveness only AFTER draining: a peer may have
             # flushed this epoch's blob (now in our recv queue) and
             # then exited — that epoch is completable, not failed
-            dead = [p for p in range(self.n_srv)
-                    if p != self.me and p not in have
-                    and not self.tp.peer_alive(p)]
+            dead = [p for p in missing if not self.tp.peer_alive(p)]
             if dead:
                 # the dead flag is set by the receiver thread, which
                 # may have delivered the final blob between our drain
@@ -1250,7 +1343,15 @@ class ServerNode:
                 self._drain(timeout_us=50_000)
                 have = self.blob_buf.get(epoch, {})
                 dead = [p for p in dead if p not in have]
-            if dead and len(have) < self.n_srv - 1 and not self._failover:
+            if dead and self._elastic and self._failover:
+                # failover-with-reassignment: the kill path flushes its
+                # transport at the boundary, so every survivor stalls at
+                # the SAME first-missing epoch and derives the same new
+                # map — no negotiation round needed
+                for p in dead:
+                    self._elastic_reassign(p, epoch)
+                continue
+            if dead and not self._failover:
                 raise RuntimeError(
                     f"server {self.me}: peer server(s) {dead} died "
                     f"waiting for epoch {epoch} blobs")
@@ -1258,6 +1359,245 @@ class ServerNode:
                 raise TimeoutError(
                     f"server {self.me}: epoch {epoch} blob wait: have "
                     f"{sorted(have)}")
+
+    # -- elastic membership: live rebalance protocol ---------------------
+    # All of it runs at GROUP BOUNDARIES only (the durability +
+    # determinism cutpoint the ack gating and the overlap pipeline
+    # already quantize on): a cutover is one atomic map-version bump,
+    # identical on every node at the identical epoch, so the merged
+    # verdict stream never observes a half-installed map.
+    def _elastic_tick(self, epoch0: int) -> bool:
+        """Top-of-loop membership work: (controller) announce a planned
+        rebalance; (everyone) apply a pending cutover when its boundary
+        arrives.  Returns True when a cutover was applied this tick (the
+        caller carves a ``membership`` span out of the timeline)."""
+        cfg = self.cfg
+        plan = cfg.elastic_plan_spec()
+        if (self.me == 0 and plan is not None and not self._plan_sent
+                and epoch0 >= plan[2]):
+            kind, node, _ = plan
+            M = self._M
+            new_map = (M.plan_grow if kind == "grow"
+                       else M.plan_drain)(self.smap, node)
+            # cutover 3 groups out — the measure-epoch margin: peers
+            # dispatch at most ~1 group ahead (their group g needs our
+            # g blobs) and per-link FIFO lands this announcement before
+            # the boundary group's blobs
+            cutover = (epoch0 // self.C + 3) * self.C
+            reason = M.REASON_GROW if kind == "grow" else M.REASON_DRAIN
+            msg = M.encode_map_msg(new_map, cutover, reason, node)
+            for p in range(self.n_srv):
+                if p != self.me:
+                    self.tp.send(p, "MIGRATE_BEGIN", msg)
+            self.tp.flush()
+            self._plan_sent = True
+            self._mig_pending = dict(map=new_map, cutover=cutover,
+                                     reason=reason, subject=node)
+        mp = self._mig_pending
+        if mp is not None and epoch0 >= mp["cutover"]:
+            if epoch0 > mp["cutover"]:
+                raise RuntimeError(
+                    f"server {self.me}: missed rebalance cutover "
+                    f"{mp['cutover']} (at epoch {epoch0}): announcement "
+                    "margin violated")
+            self._apply_cutover(mp)
+            self._mig_pending = None
+            return True
+        return False
+
+    def _apply_cutover(self, mp: dict) -> None:
+        """Planned grow/drain cutover at its group boundary: donors
+        snapshot + stream the moving slots' rows, recipients install
+        them, and everyone bumps the map version — the committed state
+        through ``cutover - 1`` is exactly what the pipelined loop has
+        already dispatched, so the snapshot is the handoff point."""
+        t0 = time.monotonic()
+        M = self._M
+        new_map = mp["map"]
+        mv = M.moves(self.smap, new_map)
+        rows_out = rows_in = 0
+        for (d, r), slots in mv.items():
+            if d == self.me:
+                rows_out += self._send_rows(r, new_map.version, slots)
+        if rows_out:
+            self.tp.flush()
+        donors = sorted({d for (d, r) in mv if r == self.me})
+        for d in donors:
+            rows_in += self._install_rows(
+                self._wait_rows(new_map.version, d))
+        self._install_map(new_map, mp["cutover"], mp["reason"],
+                          mp["subject"], rows_in, rows_out,
+                          (time.monotonic() - t0) * 1e3)
+
+    def _send_rows(self, recipient: int, version: int,
+                   slots: np.ndarray) -> int:
+        """Donor half: gather the moving slots' rows from the device
+        tables and stream them to the recipient."""
+        import jax
+        import jax.numpy as jnp
+
+        M = self._M
+        keys = M.keys_of_slots(slots, self.wl.n_rows, self.smap.n_slots)
+        kj = jnp.asarray(keys)
+        gathered = {f"{name}/{cn}": jnp.take(v, kj, axis=0)
+                    for name, tab in self.db.items()
+                    if not name.startswith("__")
+                    for cn, v in tab.columns.items()}
+        # ONE batched d2h fetch: per-column device_get would serialize a
+        # full tunnel round trip per column (the d2h path is the
+        # documented single-digit-MB/s bottleneck) straight into the
+        # cutover stall every node pays
+        cols = {k: np.asarray(v)
+                for k, v in zip(gathered, jax.device_get(
+                    list(gathered.values())))}
+        self.tp.send(recipient, "MIGRATE_ROWS",
+                     M.encode_migrate_rows(version, keys, cols))
+        return len(keys)
+
+    def _wait_rows(self, version: int, donor: int) -> bytes:
+        """Recipient half: block (bounded) for one donor's row stream."""
+        t0 = time.monotonic()
+        while True:
+            buf = self._mig_rows.get(version, {})
+            if donor in buf:
+                return buf.pop(donor)
+            self._drain(timeout_us=10_000)
+            if time.monotonic() - t0 > 60.0:
+                raise TimeoutError(
+                    f"server {self.me}: MIGRATE_ROWS v{version} from "
+                    f"donor {donor} never arrived")
+
+    def _scatter_rows(self, kj, get_col) -> None:
+        """Scatter per-column values into the local full-residency
+        tables at row indices ``kj`` (``get_col(name, cn, col)`` supplies
+        the replacement rows; ``__``-prefixed control-plane leaves are
+        skipped)."""
+        newdb = dict(self.db)
+        for name, tab in self.db.items():
+            if name.startswith("__"):
+                continue
+            tc = dict(tab.columns)
+            for cn in tc:
+                tc[cn] = tc[cn].at[kj].set(get_col(name, cn, tc[cn]))
+            newdb[name] = tab._replace(columns=tc)
+        self.db = newdb
+
+    def _install_rows(self, payload: bytes) -> int:
+        """Scatter a donor's row stream into the local tables (elastic
+        tables are full-residency, so local slot == key)."""
+        import jax.numpy as jnp
+
+        _v, keys, cols = self._M.decode_migrate_rows(payload)
+        self._scatter_rows(
+            jnp.asarray(keys),
+            lambda name, cn, col: jnp.asarray(cols[f"{name}/{cn}"],
+                                              col.dtype))
+        return len(keys)
+
+    def _elastic_reassign(self, dead: int, epoch: int) -> None:
+        """Failover-with-reassignment: retire a dead peer in place.  The
+        plan is a deterministic pure function of (map, dead) and every
+        survivor stalls at the same first-missing epoch, so all
+        survivors install the identical new map at the identical
+        boundary with no negotiation.  Acquired rows are rebuilt by
+        deterministic replay of THIS node's own command log — the
+        merged command stream is identical on every node, so replaying
+        it under the acquired-slot ownership mask reproduces the dead
+        node's rows bit for bit."""
+        if dead in self._reassigned:
+            return
+        t0 = time.monotonic()
+        M = self._M
+        self._reassigned.add(dead)
+        new_map = M.plan_reassign(self.smap, dead)
+        acquired = np.concatenate(
+            [s for (d, r), s in M.moves(self.smap, new_map).items()
+             if r == self.me] or [np.zeros(0, np.int32)])
+        rows_in = 0
+        if len(acquired) and epoch > 0:
+            rows_in = self._adopt_by_replay(acquired, epoch)
+        self._contrib_gone[dead] = epoch
+        # drop any buffered blobs of the dead incarnation at/past the
+        # boundary (there should be none — it died at its boundary)
+        for ep, blobs in self.blob_buf.items():
+            if ep >= epoch:
+                blobs.pop(dead, None)
+        self._install_map(new_map, epoch, M.REASON_REASSIGN, dead,
+                          rows_in, 0, (time.monotonic() - t0) * 1e3)
+
+    def _adopt_by_replay(self, acquired: np.ndarray, stop_epoch: int
+                         ) -> int:
+        """Rebuild the acquired slots' rows by replaying the local
+        command log through ``stop_epoch`` with ownership restricted to
+        exactly those slots, then merge the rows into the live tables.
+        This is PR 1's recovery replay pointed at a different owner
+        mask — catch-up without the dead process."""
+        import jax.numpy as jnp
+
+        from deneva_tpu.engine.step import init_device_stats
+        from deneva_tpu.runtime.logger import replay_into
+
+        M = self._M
+        if self.logger is None:
+            raise RuntimeError(
+                f"server {self.me}: slot reassignment needs --logging "
+                "(acquired rows are rebuilt by log replay)")
+        # records for every epoch < stop_epoch were appended at their
+        # group's dispatch; drain in-flight wire submissions (overlap
+        # rides the wire worker) before waiting out the flush
+        for g in getattr(self, "_inflight", ()):
+            for f in g.get("wire_futs", ()):
+                f.result()
+        self.logger.wait_flushed(stop_epoch - 1, timeout=30.0)
+        step = make_dist_step(self.cfg, self.wl, self.be)
+        db0 = self.wl.load()
+        owners = np.full(self.smap.n_slots, -1, np.int32)
+        owners[acquired] = self.me
+        db0[M.MEMBER_KEY] = jnp.asarray(owners)
+        stats0 = init_device_stats(
+            len(getattr(self.wl, "txn_type_names", ("txn",))))
+        db0, _, _, last = replay_into(
+            self.log_path, self.cfg, self.wl, step, db0,
+            self.be.init_state(self.cfg), stats0, stop_epoch=stop_epoch)
+        if last != stop_epoch - 1:
+            raise RuntimeError(
+                f"server {self.me}: reassignment replay ended at epoch "
+                f"{last}, needed {stop_epoch - 1}")
+        keys = M.keys_of_slots(acquired, self.wl.n_rows,
+                               self.smap.n_slots)
+        kj = jnp.asarray(keys)
+        self._scatter_rows(
+            kj, lambda name, cn, col: jnp.take(db0[name].columns[cn],
+                                               kj, axis=0))
+        return len(keys)
+
+    def _install_map(self, new_map, epoch: int, reason: int, subject: int,
+                     rows_in: int, rows_out: int, stall_ms: float) -> None:
+        """The atomic cutover: swap the host map AND the device-resident
+        owner array (a data update between group dispatches — no
+        re-jit), bump the counters, emit the [membership] line, and (the
+        lowest live server) announce the map to every client."""
+        import jax.numpy as jnp
+
+        M = self._M
+        mv_total = int((self.smap.owners != new_map.owners).sum())
+        self.smap = new_map
+        db = dict(self.db)
+        db[M.MEMBER_KEY] = jnp.asarray(new_map.owners)
+        self.db = db
+        self._rebalance_cnt += 1
+        self._rows_in += rows_in
+        self._rows_out += rows_out
+        self._cutover_stall_ms += stall_ms
+        print(M.membership_line(self.me, new_map, epoch, reason, subject,
+                                mv_total, rows_in, rows_out, stall_ms),
+              flush=True)
+        alive = [p for p in range(self.n_srv) if p not in self._reassigned]
+        if self.me == min(alive):
+            msg = M.encode_map_msg(new_map, epoch, reason, subject)
+            for c in range(self.n_cl):
+                self.tp.send(self.n_srv + c, "MAP_UPDATE", msg)
+            self.tp.flush()
 
     # -- verdict retirement (the back half of an epoch) ------------------
     def _retire(self, group: dict, tl) -> None:
@@ -1278,15 +1618,32 @@ class ServerNode:
             # asynchronously at dispatch, so this normally returns fast
             pk = np.asarray(jax.device_get(group["masks"]))
             planes = np.unpackbits(pk, axis=-1, bitorder="little")
-            done, abort, defer = planes[:, :, :self.b_loc].astype(bool)
+            done, abort, defer = planes[:, :, :self._plane_n].astype(bool)
         else:
             done, abort, defer = (np.asarray(m)
                                   for m in jax.device_get(group["masks"]))
         self._ph["process"] += time.monotonic() - t0
+        lo = self._plane_lo if group["packed"] else 0
         for i, (epoch, block, abort_cnt, birth_ts, dfc) in enumerate(
                 group["eps"]):
             n = len(block)
-            my_commit = done[i, :n]
+            my_commit = done[i, lo:lo + n]
+            if self._full_planes and group["packed"]:
+                # re-ack takeover authority: every PEER slice's committed
+                # packed ids survive their admitting server (held to the
+                # same durability gate as the CL_RSPs they answer).  The
+                # own slice is excluded — the normal retire/held-rsp path
+                # already moves those ids, and doubling them would run a
+                # redundant O(b_loc) dedup pass per epoch
+                at = group["all_tags"][i]
+                full = done[i, :self.b_merged] & (at != 0)
+                full[self._plane_lo:self._plane_lo + self.b_loc] = False
+                ids = at[full]
+                if len(ids):
+                    if self.logger is None:
+                        self._retire_dedup(ids)
+                    else:
+                        self._held_commit.append((epoch, ids))
             if pre is not None:
                 if pre[i] is not None:
                     tags, rsp_split, retry_inc, wait_inc = pre[i]
@@ -1326,8 +1683,8 @@ class ServerNode:
                     else:
                         # group commit: hold until epoch is durable
                         self._held_rsp.append(rsp)
-            ab = abort[i, :n]
-            df = defer[i, :n]
+            ab = abort[i, lo:lo + n]
+            df = defer[i, lo:lo + n]
             if self.defer_budget:
                 # defer budget (engine/step.py analogue): past the
                 # budget a wait force-restarts as an abort.  Host-side
@@ -1428,6 +1785,7 @@ class ServerNode:
         # `statistics/stats.h:116` worker_idle_time etc.)
         self._ph = {"idle": 0.0, "process": 0.0}
         inflight: deque[dict] = deque()
+        self._inflight = inflight   # reassignment replay drains wire futs
         while True:
             if tl:
                 tl.mark("loop")
@@ -1446,6 +1804,12 @@ class ServerNode:
                         for f in g.get("wire_futs", ()):
                             f.result()
                     self.logger.wait_flushed(epoch0 - 1, timeout=10.0)
+                if self._elastic:
+                    # reassignment (instead of restart) needs every
+                    # survivor to stall at the SAME first-missing epoch:
+                    # drain the queued boundary sends so the departure
+                    # is clean at this group boundary
+                    self.tp.flush()
                 os._exit(17)
             self._drain()
             now = time.monotonic()
@@ -1471,6 +1835,10 @@ class ServerNode:
                     if p != self.me:
                         self.tp.send(p, "SHUTDOWN", sd)
                 self.tp.flush()
+            # elastic membership: announce planned rebalances
+            # (controller) and apply pending cutovers at their boundary
+            if self._elastic and self._elastic_tick(epoch0) and tl:
+                tl.mark("membership")
             # ---- assemble + broadcast contributions for the group -----
             eps: list[tuple[int, wire.QueryBlock, np.ndarray, np.ndarray,
                             np.ndarray]] = []
@@ -1567,8 +1935,12 @@ class ServerNode:
                 ts_np = np.zeros((C, b), np.int64)
                 active_np = np.zeros((C, b), bool)
                 def _fill(i, parts):
-                    # disjoint row i of every feed buffer: pool-safe
+                    # disjoint row i of every feed buffer: pool-safe.
+                    # A retired elastic contributor has no part — its
+                    # slice stays the fresh buffer's zeros/inactive.
                     for s in range(self.n_srv):
+                        if s not in parts:
+                            continue
                         blk_s, ts_s = parts[s]
                         o = s * self.b_loc
                         n = len(blk_s)
@@ -1663,6 +2035,10 @@ class ServerNode:
                 tl.mark("dispatch")
             group = {"eps": eps, "masks": masks, "packed": packed,
                      "feed": fs, "wire_futs": wire_futs}
+            if self._full_planes and packed:
+                # full-plane retirement needs every slice's packed tags
+                # (copied: overlap feed buffers recycle under the group)
+                group["all_tags"] = tags.copy()
             if self._overlap:
                 # hand the verdict-plane fetch to the retire worker now:
                 # by the time this group's turn to retire comes (K groups
@@ -1720,6 +2096,17 @@ class ServerNode:
                          wire.encode_shutdown(epochs_run))
         for r in self.repl_ids:
             self.tp.send(r, "SHUTDOWN", wire.encode_shutdown(epochs_run))
+        if self._elastic and self._reassigned:
+            # takeover duty: a reassigned (dead, never-restarted) node
+            # cannot release its own replicas — the lowest survivor does
+            alive = [p for p in range(self.n_srv)
+                     if p not in self._reassigned]
+            if self.me == min(alive):
+                for d in self._reassigned:
+                    for k in range(self.cfg.replica_cnt):
+                        rid = self.n_srv + self.n_cl + d + k * self.n_srv
+                        self.tp.send(rid, "SHUTDOWN",
+                                     wire.encode_shutdown(epochs_run))
         self.tp.flush()
         if self.logger is not None:
             self.stats.set("log_records", float(self.logger.records))
@@ -1763,6 +2150,17 @@ class ServerNode:
             st.set("dup_admit_cnt", float(self._dup_admits))
             st.set("reack_cnt", float(self._reacks))
             st.set("recovered", 1.0 if cfg.recover else 0.0)
+        if self._elastic:
+            # membership counters ([summary] satellite): how much the
+            # control plane moved and what the cutovers cost
+            st.set("map_version", float(self.smap.version))
+            st.set("owned_slots", float(len(self.smap.slots_of(self.me))))
+            st.set("rebalance_cnt", float(self._rebalance_cnt))
+            st.set("rows_migrated", float(self._rows_in + self._rows_out))
+            st.set("rows_migrated_in", float(self._rows_in))
+            st.set("rows_migrated_out", float(self._rows_out))
+            st.set("cutover_stall_ms", self._cutover_stall_ms)
+            st.set("redirect_nack_cnt", float(self._redirects))
         for k, v in self.tp.stats().items():
             if not chaos and k in ("msg_dropped", "msg_dup", "reconnects"):
                 continue   # keep the default-config summary line as-is

@@ -44,6 +44,17 @@ def partition_owned(key: jax.Array, n_parts: int, me: int) -> jax.Array:
     return key % n_parts == me
 
 
+def slot_map_owned(key: jax.Array, owners: jax.Array, me: int) -> jax.Array:
+    """bool mask: does this node own ``key`` under the elastic slot map
+    (`runtime/membership.py`)?  ``owners`` is the device-resident
+    int32[S] owner array carried in the db pytree (MEMBER_KEY), so a
+    rebalance is a data update, never a re-jit.  With the boot map this
+    is EXACTLY ``partition_owned`` (S is a multiple of the active count;
+    the degeneracy contract)."""
+    slot = key.astype(jnp.int32) % jnp.int32(owners.shape[0])
+    return jnp.take(owners, slot, axis=0) == jnp.int32(me)
+
+
 def partition_slot(key: jax.Array, n_parts: int, me: int,
                    n_local: int) -> jax.Array:
     """Local storage slot for a striped global key; keys this node does

@@ -153,7 +153,25 @@ class YCSBWorkload:
         # only keys ≡ node_id (mod part_cnt); the strided index steers
         # remote keys to the trash slot so execution is local-only.
         self.n_parts = max(cfg.part_cnt, 1)
-        if self.n_parts > 1:
+        self.elastic = cfg.elastic
+        if self.elastic:
+            # elastic membership (runtime/membership.py): ownership is
+            # the slot-map MASK, not the storage layout.  Every node
+            # holds the FULL keyspace (local slot == key, identity
+            # index) so a slot acquired mid-run always has a resident
+            # row to install the migrated value into; non-owned lanes
+            # steer to the trash slot via `slot_map_owned` at access
+            # time (`_local_slots`).  The boot map degenerates to exact
+            # modulo striping, so the mask — and therefore every verdict
+            # and every ack — is bit-identical to the striped layout
+            # until a rebalance moves a slot.
+            from deneva_tpu.runtime.membership import initial_map
+            self.n_local = self.n_rows
+            self.index = DenseIndex(base=0, stride=1, size=self.n_rows,
+                                    miss_slot=self.n_rows)
+            self._boot_map = initial_map(cfg)
+            self.n_slots = self._boot_map.n_slots
+        elif self.n_parts > 1:
             assert self.n_rows % self.n_parts == 0, \
                 "synth_table_size must divide evenly over part_cnt"
             self.n_local = self.n_rows // self.n_parts
@@ -185,7 +203,11 @@ class YCSBWorkload:
     def _owned_keys(self) -> np.ndarray:
         """Global keys owned by this node, in slot order — the single
         definition of the `key % part_cnt` partition layout
-        (ycsb_wl.cpp:70-74); shared by both index kinds and the loader."""
+        (ycsb_wl.cpp:70-74); shared by both index kinds and the loader.
+        Elastic mode is full-residency: every key has a local row (the
+        ownership mask lives in the slot map, not the layout)."""
+        if self.elastic:
+            return np.arange(self.n_local, dtype=np.int32)
         base = self.cfg.node_id if self.n_parts > 1 else 0
         stride = self.n_parts if self.n_parts > 1 else 1
         return (base + np.arange(self.n_local, dtype=np.int64)
@@ -217,6 +239,12 @@ class YCSBWorkload:
             # partition (ycsb_wl.cpp:70-74) across CHIPS
             tab = to_mc_layout(tab, self.cfg.device_parts)
         db = {TABLE: tab}
+        if self.elastic:
+            # device-resident owner array: ownership changes are a data
+            # update between group dispatches, never a re-jit.  Excluded
+            # from state_digest (control plane, not row state).
+            from deneva_tpu.runtime.membership import MEMBER_KEY
+            db[MEMBER_KEY] = jnp.asarray(self._boot_map.owners)
         if self.cfg.cc_alg == CCAlg.MVCC and self.cfg.device_parts == 1:
             # per-row overwrite-ts ring (row_mvcc.cpp:172-196): stale
             # reads of read-write txns return HISTORICAL bytes of the
@@ -437,6 +465,20 @@ class YCSBWorkload:
         db[TABLE] = tab._replace(columns={**tab.columns, "F0": f0})
         return db, dfr
 
+    def _local_slots(self, db, keys: jax.Array) -> jax.Array:
+        """key -> local slot with ownership applied.  Static striping
+        resolves ownership inside the index arithmetic (non-owned keys
+        miss); elastic mode indexes the full keyspace and masks by the
+        slot map carried in ``db`` instead."""
+        slots = self.index.lookup(keys)
+        if self.elastic:
+            from deneva_tpu.runtime.membership import MEMBER_KEY
+            from deneva_tpu.workloads.base import slot_map_owned
+            owned = slot_map_owned(keys, db[MEMBER_KEY],
+                                   self.cfg.node_id)
+            slots = jnp.where(owned, slots, jnp.int32(self.n_local))
+        return slots
+
     # -- execution (ycsb_txn.cpp:177-209 collapsed to one batch) -------
     def execute(self, db, q: YCSBQuery, mask: jax.Array, order: jax.Array,
                 stats: dict, fwd_rank=None, level_exec: bool = False):
@@ -461,12 +503,13 @@ class YCSBWorkload:
             assert mask is None, \
                 "ForwardPlan embodies the commit set; pass mask=None"
             p = fwd_rank
-            slots = self.index.lookup(p.keys)                  # [N]
+            slots = self._local_slots(db, p.keys)              # [N]
             # mono: with one partition every valid key is owned, so the
             # slot map follows sorted-key order (DenseIndex identity /
             # SortedIndex rank) and misses steer to capacity at the top;
-            # under part_cnt striping non-owned keys hit miss_slot
-            # INTERLEAVED between owned slots — not monotone
+            # under part_cnt striping (or an elastic mask at n_parts>1)
+            # non-owned keys hit miss_slot INTERLEAVED between owned
+            # slots — not monotone
             f0, cks, wcnt = _forward_execute_f0(
                 tab.columns["F0"], p, slots, tab.capacity,
                 mono=self.n_parts == 1)
@@ -476,7 +519,7 @@ class YCSBWorkload:
             db[TABLE] = tab._replace(columns={**tab.columns, "F0": f0})
             return db
         full = self.cfg.sim_full_row
-        slots = self.index.lookup(q.keys)                      # [n, R]
+        slots = self._local_slots(db, q.keys)                  # [n, R]
         act = mask[:, None] & jnp.ones_like(q.is_write)
         # reads: gather F0, fold into checksum (keeps the load alive);
         # through .gather so the multi-chip McTableView can interpose

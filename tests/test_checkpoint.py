@@ -50,6 +50,47 @@ def test_resume_is_bit_exact(tmp_path):
         assert (a == b).all()
 
 
+def test_stale_schema_version_fails_with_clear_message(tmp_path,
+                                                       monkeypatch):
+    """The module docstring's promise: a checkpoint from an older
+    EngineState LAYOUT (lower SCHEMA_VERSION) must fail with the
+    explicit bumped-version message — naming both versions and the
+    remedy — not an opaque pytree/shape error (even when the layout
+    difference would ALSO trip the leaf checks)."""
+    import deneva_tpu.engine.checkpoint as cp
+
+    path = str(tmp_path / "stale.npz")
+    state = {"tab": {"F0": jax.numpy.arange(8)},
+             "rng": jax.numpy.zeros(2, jax.numpy.uint32)}
+    monkeypatch.setattr(cp, "SCHEMA_VERSION", cp.SCHEMA_VERSION - 1)
+    cp.save_state(path, state)
+    monkeypatch.undo()
+    # template with a DIFFERENT layout too: the schema check must win
+    template = {"tab": {"F0": jax.numpy.arange(8),
+                        "F1": jax.numpy.arange(8)},
+                "rng": jax.numpy.zeros(2, jax.numpy.uint32)}
+    with pytest.raises(ValueError) as ei:
+        cp.load_state(path, template)
+    msg = str(ei.value)
+    assert "incompatible checkpoint" in msg
+    assert f"schema v{cp.SCHEMA_VERSION - 1}" in msg
+    assert f"writes v{cp.SCHEMA_VERSION}" in msg
+    assert "re-run from scratch" in msg
+
+
+def test_preschema_checkpoint_reports_v0(tmp_path):
+    """A checkpoint predating the schema stamp entirely (no __schema__
+    key) reads as v0 and fails with the same clear message."""
+    import deneva_tpu.engine.checkpoint as cp
+
+    path = str(tmp_path / "v0.npz")
+    state = {"a": jax.numpy.arange(4)}
+    np.savez(path, leaf_0000=np.arange(4),
+             __paths__=np.array(["['a']"]))
+    with pytest.raises(ValueError, match="schema v0"):
+        cp.load_state(path, state)
+
+
 def test_load_rejects_config_mismatch(tmp_path):
     path = str(tmp_path / "ck.npz")
     eng = _engine()

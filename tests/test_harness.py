@@ -124,3 +124,70 @@ def test_timeline_parse_and_render(tmp_path):
     assert render(phase_table([])).startswith("(no [timeline]")
     # node filter
     assert all(r[0] == "1" for r in phase_table(rows, node=1)[1:])
+
+
+def test_timeline_chrome_trace_export(tmp_path):
+    """--trace: [timeline] spans export as Chrome-trace complete events
+    — one process track per node, per-node running clock, epoch in the
+    args — so cutover/migration stalls are visible on a real timeline."""
+    import json
+
+    from deneva_tpu.harness.timeline import chrome_trace, main, \
+        parse_timeline
+
+    lines = ["[timeline] node=0 epoch=1 loop=1.0ms admit=2.0ms\n",
+             "[timeline] node=0 epoch=2 loop=0.5ms membership=12.0ms\n",
+             "[timeline] node=1 epoch=1 loop=4.0ms\n"]
+    trace = chrome_trace(parse_timeline(lines))
+    ev = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert {m["pid"] for m in meta} == {0, 1}
+    # node 0's clock runs: loop@0 (1ms), admit@1000us (2ms), then epoch 2
+    # continues at 3000us
+    n0 = [e for e in ev if e["pid"] == 0]
+    assert [e["name"] for e in n0] == ["loop", "admit", "loop",
+                                      "membership"]
+    assert n0[0]["ts"] == 0 and n0[1]["ts"] == 1000.0
+    assert n0[2]["ts"] == 3000.0 and n0[3]["dur"] == 12000.0
+    assert n0[3]["args"]["epoch"] == 2
+    # node 1 has its own track starting at 0
+    assert [e["ts"] for e in ev if e["pid"] == 1] == [0]
+    # CLI round trip writes valid JSON
+    log = tmp_path / "run.log"
+    log.write_text("".join(lines))
+    out = tmp_path / "trace.json"
+    assert main([str(log), "--trace", str(out)]) == 0
+    with open(out) as f:
+        loaded = json.load(f)
+    assert loaded["traceEvents"] and loaded["displayTimeUnit"] == "ms"
+
+
+def test_parse_tolerates_membership_lines(tmp_path):
+    """Forward/backward compat (membership [summary]/[membership]
+    satellite): old logs (no membership lines) still parse, and new logs
+    with [membership] lines neither crash nor perturb the summary,
+    timeline, or cfg-echo parsers."""
+    from deneva_tpu.harness.parse import parse_file, parse_membership
+    from deneva_tpu.harness.timeline import parse_timeline
+
+    new_log = tmp_path / "new.out"
+    new_log.write_text(
+        "# cfg node_cnt=3\n"
+        "[membership] node=0 version=1 epoch=40 reason=grow subject=2 "
+        "slots_moved=85 owned=85 rows_in=0 rows_out=688 stall_ms=112.9\n"
+        "[timeline] node=0 epoch=41 loop=1.0ms membership=112.9ms\n"
+        "[summary] total_runtime=1.5,tput=100,txn_cnt=150,"
+        "rebalance_cnt=1,rows_migrated=688,cutover_stall_ms=112.9,"
+        "redirect_resend_cnt=0\n")
+    row = parse_file(str(new_log))
+    assert row["tput"] == 100 and row["rebalance_cnt"] == 1
+    assert row["rows_migrated"] == 688 and row["cutover_stall_ms"] == 112.9
+    text = new_log.read_text().splitlines()
+    mem = parse_membership(text)
+    assert len(mem) == 1 and mem[0]["reason"] == "grow"
+    assert len(parse_timeline(text)) == 1   # [membership] didn't confuse it
+    # old log: no membership lines anywhere -> [] and unchanged parsing
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_membership(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5

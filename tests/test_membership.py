@@ -1,0 +1,360 @@
+"""Elastic membership tests (runtime/membership.py).
+
+Three layers:
+
+* pure units — slot-map degeneracy (boot map == exact modulo striping),
+  rebalance-plan properties (deterministic, balanced, covering), wire
+  codec roundtrips, dense slot->keys enumeration;
+* workload/routing units — elastic YCSB full-residency load, slot-map
+  ownership masks vs the striped baseline, control-plane exclusion from
+  `state_digest`;
+* runtime integration — the rebalance-off bit-identity bar (an elastic
+  run with no rebalance triggered must produce byte-identical command
+  logs, replica streams, state digests and acked tags vs elastic=off;
+  same harness as ``test_host_overlap_bit_identical``) and the live
+  grow/drain/kill-with-reassignment chaos scenarios (slow marks).
+"""
+
+import os
+import threading
+import time as _time
+import uuid
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import CCAlg, Config, WorkloadKind
+from deneva_tpu.runtime import membership as M
+
+
+def elastic_cfg(**kw):
+    base = dict(
+        workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+        epoch_batch=128, conflict_buckets=512, synth_table_size=4096,
+        max_txn_in_flight=1024, req_per_query=4, max_accesses=4,
+        zipf_theta=0.6, warmup_secs=0.5, done_secs=1.5, elastic=True)
+    base.update(kw)
+    return Config(**base)
+
+
+# ---- slot map ----------------------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 8])
+def test_boot_map_degenerates_to_exact_modulo(n):
+    """The aliasing contract: owners[key % S] == key % node_cnt for every
+    key — S is rounded to a multiple of the boot active count, so the
+    membership subsystem is routing-identical to GET_NODE_ID striping
+    until a rebalance moves a slot."""
+    cfg = elastic_cfg(node_cnt=n, part_cnt=n)
+    m = M.initial_map(cfg)
+    assert m.version == 0
+    assert m.n_slots % n == 0 and m.n_slots >= 256
+    keys = np.arange(100_000)
+    np.testing.assert_array_equal(m.owner_of(keys), keys % n)
+
+
+def test_boot_map_spares_are_slotless():
+    cfg = elastic_cfg(node_cnt=3, part_cnt=3, elastic_spare_cnt=1)
+    m = M.initial_map(cfg)
+    assert m.active_nodes() == [0, 1]
+    assert len(m.slots_of(2)) == 0
+    keys = np.arange(10_000)
+    np.testing.assert_array_equal(m.owner_of(keys), keys % 2)
+
+
+def test_plan_grow_is_deterministic_balanced_and_covering():
+    cfg = elastic_cfg(node_cnt=3, part_cnt=3, elastic_spare_cnt=1)
+    m = M.initial_map(cfg)
+    g1, g2 = M.plan_grow(m, 2), M.plan_grow(m, 2)
+    np.testing.assert_array_equal(g1.owners, g2.owners)   # deterministic
+    assert g1.version == 1
+    cnt = g1.counts()
+    assert set(cnt) == {0, 1, 2}
+    assert max(cnt.values()) - min(cnt.values()) <= 1     # balanced
+    assert sum(cnt.values()) == m.n_slots                 # covering
+    # only slots that MOVED changed owner; every move targets node 2
+    for (d, r), slots in M.moves(m, g1).items():
+        assert r == 2 and d in (0, 1) and len(slots) > 0
+
+
+def test_plan_drain_and_reassign_empty_the_subject():
+    cfg = elastic_cfg(node_cnt=3, part_cnt=3)
+    m = M.initial_map(cfg)
+    d = M.plan_drain(m, 1)
+    assert d.version == 1
+    assert len(d.slots_of(1)) == 0
+    assert sum(d.counts().values()) == m.n_slots
+    # reassign is the same movement (recipients rebuild by replay)
+    np.testing.assert_array_equal(M.plan_reassign(m, 1).owners, d.owners)
+    with pytest.raises(ValueError):
+        M.plan_drain(M.plan_drain(M.initial_map(
+            elastic_cfg(node_cnt=2, part_cnt=2)), 1), 0)  # last owner
+
+
+def test_map_msg_roundtrip():
+    cfg = elastic_cfg(node_cnt=3, part_cnt=3)
+    m = M.plan_grow(M.initial_map(cfg), 2)
+    buf = M.encode_map_msg(m, cutover_epoch=64, reason=M.REASON_GROW,
+                           subject=2)
+    m2, cut, reason, subject = M.decode_map_msg(buf)
+    assert (m2.owners == m.owners).all() and m2.version == m.version
+    assert (cut, reason, subject) == (64, M.REASON_GROW, 2)
+
+
+def test_migrate_rows_roundtrip_preserves_dtype_and_shape():
+    keys = np.arange(7, dtype=np.int32) * 3
+    cols = {"MAIN_TABLE/F0": (np.arange(7) * 11).astype(np.uint32),
+            "T/bytes": np.arange(7 * 4, dtype=np.uint8).reshape(7, 4),
+            "T/f": np.linspace(0, 1, 7, dtype=np.float32)}
+    buf = M.encode_migrate_rows(9, keys, cols)
+    assert M.peek_rows_version(buf) == 9
+    v, k2, c2 = M.decode_migrate_rows(buf)
+    assert v == 9
+    np.testing.assert_array_equal(k2, keys)
+    assert set(c2) == set(cols)
+    for n in cols:
+        assert c2[n].dtype == cols[n].dtype
+        np.testing.assert_array_equal(c2[n], cols[n])
+
+
+def test_keys_of_slots_enumerates_the_dense_keyspace():
+    ks = M.keys_of_slots(np.array([1, 2]), n_rows=11, n_slots=4)
+    assert ks.tolist() == [1, 2, 5, 6, 9, 10]
+    # a full slot cover enumerates every key exactly once
+    all_k = M.keys_of_slots(np.arange(4), 11, 4)
+    assert sorted(all_k.tolist()) == list(range(11))
+
+
+def test_membership_line_parses_back():
+    from deneva_tpu.harness.parse import parse_membership
+
+    cfg = elastic_cfg(node_cnt=2, part_cnt=2)
+    m = M.plan_drain(M.initial_map(cfg), 1)
+    line = M.membership_line(0, m, epoch=32, reason=M.REASON_DRAIN,
+                             subject=1, slots_moved=128, rows_in=2048,
+                             rows_out=0, stall_ms=12.5)
+    rows = parse_membership([line, "unrelated line", "[summary] tput=1"])
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["node"] == 0 and r["version"] == 1 and r["epoch"] == 32
+    assert r["reason"] == "drain" and r["subject"] == 1
+    assert r["rows_in"] == 2048 and r["stall_ms"] == 12.5
+    # logs predating the subsystem parse to []
+    assert parse_membership(["[summary] tput=1", "[timeline] x"]) == []
+
+
+# ---- config gates ------------------------------------------------------
+
+def test_config_rejects_unsupported_elastic_combos():
+    with pytest.raises(ValueError, match="deterministic backend"):
+        Config(elastic=True, cc_alg=CCAlg.OCC).validate()
+    with pytest.raises(ValueError, match="YCSB"):
+        Config(elastic=True, workload=WorkloadKind.TPCC,
+               max_accesses=18, cc_alg=CCAlg.CALVIN).validate()
+    with pytest.raises(ValueError, match="elastic"):
+        Config(elastic_spare_cnt=1, node_cnt=2).validate()
+    with pytest.raises(ValueError, match="elastic_plan"):
+        Config(elastic=True, cc_alg=CCAlg.CALVIN, node_cnt=2,
+               elastic_plan="shrink:1:0").validate()
+    with pytest.raises(ValueError, match="node 0"):
+        Config(elastic=True, cc_alg=CCAlg.CALVIN, node_cnt=2,
+               fault_kill="0:8", logging=True).validate()
+    # supported shapes validate
+    Config(elastic=True, cc_alg=CCAlg.CALVIN, node_cnt=3,
+           elastic_spare_cnt=1, elastic_plan="grow:2:16").validate()
+
+
+# ---- workload routing --------------------------------------------------
+
+def test_elastic_ycsb_full_residency_and_slot_mask():
+    import jax.numpy as jnp
+
+    from deneva_tpu.workloads import get_workload
+
+    cfg = elastic_cfg(node_cnt=2, part_cnt=2, node_id=1,
+                      synth_table_size=1024)
+    wl = get_workload(cfg)
+    assert wl.n_local == 1024           # full residency
+    db = wl.load()
+    assert M.MEMBER_KEY in db
+    keys = jnp.arange(64, dtype=jnp.int32)
+    slots = np.asarray(wl._local_slots(db, keys))
+    # boot map == modulo striping: node 1 owns odd keys at slot == key,
+    # even keys steer to the trash slot
+    np.testing.assert_array_equal(slots[1::2], np.arange(64)[1::2])
+    assert (slots[0::2] == wl.n_local).all()
+    # a rebalance is a data update: hand slot (key%S)==0 to node 1
+    owners = np.asarray(db[M.MEMBER_KEY]).copy()
+    owners[0] = 1
+    db[M.MEMBER_KEY] = jnp.asarray(owners)
+    slots2 = np.asarray(wl._local_slots(db, keys))
+    assert slots2[0] == 0               # key 0 now local
+    np.testing.assert_array_equal(slots2[1::2], np.arange(64)[1::2])
+
+
+def test_state_digest_excludes_the_control_plane():
+    import jax.numpy as jnp
+
+    from deneva_tpu.runtime.logger import state_digest
+    from deneva_tpu.workloads import get_workload
+
+    cfg = elastic_cfg(node_cnt=2, part_cnt=2, synth_table_size=512)
+    wl = get_workload(cfg)
+    db = wl.load()
+    d0 = state_digest(db)
+    db[M.MEMBER_KEY] = jnp.asarray(
+        np.roll(np.asarray(db[M.MEMBER_KEY]), 1))
+    assert state_digest(db) == d0       # ownership is not row state
+    # ...but row state still changes the digest
+    tab = db["MAIN_TABLE"]
+    db["MAIN_TABLE"] = tab._replace(
+        columns={**tab.columns,
+                 "F0": tab.columns["F0"].at[0].add(1)})
+    assert state_digest(db) != d0
+
+
+# ---- rebalance-off bit-identity (the acceptance bar) -------------------
+
+def _drive_elastic_run(tmp_path, elastic: bool):
+    """One single-server + replica run driven by a raw transport client
+    (the ``test_host_overlap_bit_identical`` harness), elastic on/off."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deneva_tpu.runtime import wire
+    from deneva_tpu.runtime.logger import state_digest
+    from deneva_tpu.runtime.native import NativeTransport, ipc_endpoints
+    from deneva_tpu.runtime.replica import ReplicaNode
+    from deneva_tpu.runtime.server import ServerNode
+    from deneva_tpu.workloads import get_workload
+
+    log_dir = str(tmp_path / f"logs_elastic_{elastic}")
+    cfg = Config(workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+                 node_cnt=1, client_node_cnt=1, epoch_batch=64,
+                 conflict_buckets=512, synth_table_size=512,
+                 req_per_query=4, max_accesses=4, zipf_theta=0.9,
+                 pipeline_epochs=2, pipeline_groups=2, logging=True,
+                 replica_cnt=1, log_dir=log_dir, warmup_secs=0.0,
+                 done_secs=0.0, host_overlap="off", elastic=elastic)
+    eps = ipc_endpoints(3, uuid.uuid4().hex[:8])
+    wl = get_workload(cfg.replace(elastic=False))
+    batches = []
+    for s in range(4):          # 256 txns, distinct tag ranges
+        q = wl.generate(jax.random.PRNGKey(100 + s), 64)
+        k, t, sc = wl.to_wire(q)
+        batches.append((np.arange(64, dtype=np.int64) + 64 * s, k, t, sc))
+
+    out: dict = {}
+
+    def run_server():
+        node = ServerNode(cfg.replace(node_id=0, part_cnt=1), eps, "cpu")
+        try:
+            node.run()
+            out["digest"] = state_digest(node.db)
+        except Exception as e:      # surface instead of hanging the test
+            out["err"] = repr(e)
+        finally:
+            node.close()
+
+    def run_replica():
+        node = ReplicaNode(cfg.replace(node_id=2, part_cnt=1), eps)
+        try:
+            node.run()
+        finally:
+            node.close()
+
+    ts_srv = threading.Thread(target=run_server)
+    ts_rep = threading.Thread(target=run_replica)
+    ts_srv.start()
+    ts_rep.start()
+    cl = NativeTransport(1, eps, 3)
+    cl.start()
+    acked: list[int] = []
+    try:
+        for tags, k, t, sc in batches:
+            cl.sendv(0, "CL_QRY_BATCH", wire.qry_block_parts(tags, k, t, sc))
+        cl.flush()
+
+        def on_other(src, rtype, payload):
+            if rtype == "CL_RSP":
+                acked.extend(wire.decode_cl_rsp(payload).tolist())
+
+        wire.run_barrier(cl, 1, 3, on_other, "elastic-test client", 300.0)
+        t0 = _time.monotonic()
+        stopped = False
+        while not stopped and _time.monotonic() - t0 < 300:
+            m = cl.recv(50_000)
+            if m is None:
+                continue
+            if m[1] == "CL_RSP":
+                acked.extend(wire.decode_cl_rsp(m[2]).tolist())
+            elif m[1] == "SHUTDOWN":
+                stopped = True
+        assert stopped, "server never announced SHUTDOWN"
+    finally:
+        ts_srv.join(timeout=300)
+        ts_rep.join(timeout=60)
+        cl.close()
+    assert "err" not in out, out["err"]
+    with open(os.path.join(log_dir, "node0.log.bin"), "rb") as f:
+        out["log"] = f.read()
+    with open(os.path.join(log_dir, "replica2.log.bin"), "rb") as f:
+        out["rlog"] = f.read()
+    out["acked"] = sorted(acked)
+    return out
+
+
+def test_elastic_no_rebalance_bit_identical(tmp_path):
+    """The rebalance-off acceptance bar: the membership subsystem
+    compiled in (elastic=True) with NO rebalance triggered must produce
+    byte-identical command logs, byte-identical replica streams,
+    identical state digests (the control plane is excluded by contract)
+    and the same acked-tag multiset as elastic=False — under a retrying
+    backend shape (zipf 0.9) so admission feedback is exercised."""
+    on = _drive_elastic_run(tmp_path, True)
+    off = _drive_elastic_run(tmp_path, False)
+    assert len(on["log"]) > 0
+    assert on["log"] == off["log"]
+    assert on["rlog"] == off["rlog"]
+    assert on["digest"] == off["digest"]
+    assert on["acked"] == off["acked"] and len(on["acked"]) > 0
+
+
+# ---- live rebalance scenarios (real IPC clusters) ----------------------
+
+def test_elastic_drain_scenario_short():
+    """Mid-run scale-in N=3 -> 2 on a real cluster: one cutover, the
+    drained node ends slotless, rows stream to both survivors, commit
+    counts agree across the cutover, zero lost/duplicated txns."""
+    from deneva_tpu.harness.chaos import run_scenario
+
+    report = run_scenario("elastic-drain", quick=True, quiet=True)
+    assert len(set(report["commits"])) == 1 and report["commits"][0] > 0
+    assert report["owned_slots"][2] == 0
+    assert all(a > 0 for a in report["client_acked"])
+
+
+@pytest.mark.slow
+def test_elastic_grow_scenario():
+    """Mid-run scale-out N=2 active -> 3: the slotless warm spare
+    absorbs an even share of slots (rows streamed over MIGRATE_ROWS) and
+    serves them; every server agrees on commits across the cutover."""
+    from deneva_tpu.harness.chaos import run_scenario
+
+    report = run_scenario("elastic-grow", quiet=True)
+    assert len(set(report["commits"])) == 1 and report["commits"][0] > 0
+    assert report["owned_slots"][2] > 0
+    assert report["rows_migrated"][2] > 0
+
+
+@pytest.mark.slow
+def test_elastic_kill_with_reassignment():
+    """Failover-with-reassignment: a killed server's slots move to the
+    survivors (rows rebuilt by log replay) WITHOUT restarting the dead
+    node; the run reaches liveness and exactly-once holds across the
+    takeover (resends re-ack from the survivors' committed sets)."""
+    from deneva_tpu.harness.chaos import run_scenario
+
+    report = run_scenario("elastic-kill-reassign", quiet=True)
+    assert len(set(report["commits"])) == 1 and report["commits"][0] > 0
+    assert 2 not in report["owned_slots"]   # the dead node never reports
+    assert all(a > 0 for a in report["client_acked"])

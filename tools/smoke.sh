@@ -1,0 +1,54 @@
+#!/usr/bin/env bash
+# Shared smoke-gate runner: ONE timeout/reporting path for every timed
+# gate (the former smoke_chaos.sh / smoke_escrow.sh / smoke_overlap.sh
+# are now thin delegates into this script).
+#
+#   tools/smoke.sh chaos [scenario ...]   chaos harness (default lossy-net)
+#   tools/smoke.sh escrow                 TPC-C escrow floor gate
+#   tools/smoke.sh overlap                host-pipeline bit-identity + wirebench
+#   tools/smoke.sh elastic                membership gate: elastic-grow /
+#                                         elastic-drain / elastic-kill-reassign
+#                                         (liveness + exactly-once invariants)
+#
+# Timeout: SMOKE_TIMEOUT_SECS overrides for any scenario; the legacy
+# per-gate envs (CHAOS_TIMEOUT_SECS, ESCROW_TIMEOUT_SECS,
+# OVERLAP_TIMEOUT_SECS, ELASTIC_TIMEOUT_SECS) still win when set.
+# Exits nonzero on an invariant violation, a node error, or the timeout.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SCEN="${1:-}"
+[ $# -gt 0 ] && shift
+
+run() {
+    local t="$1"; shift
+    timeout -k 10 "$t" env JAX_PLATFORMS=cpu "$@"
+}
+
+case "$SCEN" in
+  chaos)
+    T="${SMOKE_TIMEOUT_SECS:-${CHAOS_TIMEOUT_SECS:-300}}"
+    run "$T" python -m deneva_tpu.harness.chaos "${@:-lossy-net}" --quick
+    ;;
+  escrow)
+    T="${SMOKE_TIMEOUT_SECS:-${ESCROW_TIMEOUT_SECS:-600}}"
+    run "$T" python -m pytest \
+        tests/test_escrow.py::test_tpcc_escrow_smoke_above_floor \
+        -q -p no:cacheprovider
+    ;;
+  overlap)
+    T="${SMOKE_TIMEOUT_SECS:-${OVERLAP_TIMEOUT_SECS:-600}}"
+    run "$T" python -m pytest tests/test_wire_zero_copy.py \
+        "tests/test_runtime.py::test_host_overlap_bit_identical" \
+        -q -p no:cacheprovider
+    run "$T" python tools/wirebench.py --out /tmp/wirebench_smoke
+    ;;
+  elastic)
+    T="${SMOKE_TIMEOUT_SECS:-${ELASTIC_TIMEOUT_SECS:-600}}"
+    run "$T" python -m deneva_tpu.harness.chaos elastic --quick
+    ;;
+  *)
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic> [args...]" >&2
+    exit 2
+    ;;
+esac

@@ -1,18 +1,3 @@
 #!/usr/bin/env bash
-# Chaos smoke gate: a 2-server + 1-client IPC cluster must survive the
-# seeded lossy-net scenario (drops on the open-loop traffic, client
-# resend + server idempotent admission repairing them) under a hard
-# timeout — the liveness property the reference never had (SURVEY §5.3:
-# a dead/lossy link hangs it forever).
-#
-# Usage: tools/smoke_chaos.sh [scenario ...]   (default: lossy-net)
-# Exits nonzero on an invariant violation, a node error, or the timeout.
-set -euo pipefail
-cd "$(dirname "$0")/.."
-
-SCENARIOS=("${@:-lossy-net}")
-HARD_TIMEOUT="${CHAOS_TIMEOUT_SECS:-300}"
-
-exec timeout -k 10 "$HARD_TIMEOUT" \
-    env JAX_PLATFORMS=cpu \
-    python -m deneva_tpu.harness.chaos "${SCENARIOS[@]}" --quick
+# Delegate kept for back-compat: the shared runner is tools/smoke.sh.
+exec "$(dirname "$0")/smoke.sh" chaos "$@"

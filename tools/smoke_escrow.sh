@@ -1,22 +1,3 @@
 #!/usr/bin/env bash
-# Escrow smoke gate (smoke_chaos.sh-style timed gate): 4-warehouse mixed
-# TPC-C must clear the old ~1-winner-per-hot-row floor for one lock
-# backend, one ts backend and OCC (the acceptance pair of the escrow-
-# commutative sweep PR) — each backend's escrow-on commit count must be
-# >= 5x its escrow-off run on identical admission, and far above the
-# per-epoch floor signature (~num_wh payments/epoch).
-#
-# The assertions live in the tier-1 slow marker set
-# (tests/test_escrow.py::test_tpcc_escrow_smoke_above_floor); this
-# wrapper is the hard-timeout gate a campaign can call standalone.
-#
-# Usage: tools/smoke_escrow.sh     (ESCROW_TIMEOUT_SECS to override)
-set -euo pipefail
-cd "$(dirname "$0")/.."
-
-HARD_TIMEOUT="${ESCROW_TIMEOUT_SECS:-600}"
-
-exec timeout -k 10 "$HARD_TIMEOUT" \
-    env JAX_PLATFORMS=cpu \
-    python -m pytest tests/test_escrow.py::test_tpcc_escrow_smoke_above_floor \
-    -q -p no:cacheprovider
+# Delegate kept for back-compat: the shared runner is tools/smoke.sh.
+exec "$(dirname "$0")/smoke.sh" escrow "$@"

@@ -1,25 +1,3 @@
 #!/usr/bin/env bash
-# Host-pipeline smoke gate (smoke_chaos.sh-style timed gate): the
-# overlap-on and overlap-off cluster runs must produce bit-identical
-# command logs / replica streams / state digests / acked-tag sets
-# (tests/test_runtime.py::test_host_overlap_bit_identical), the
-# zero-copy codec paths must stay byte-identical to the bytes codecs
-# (tests/test_wire_zero_copy.py), and tools/wirebench.py must show the
-# >= 2x dispatch-thread critical-path reduction the PR's acceptance
-# names (wirebench exits nonzero below the bar).
-#
-# Usage: tools/smoke_overlap.sh     (OVERLAP_TIMEOUT_SECS to override)
-set -euo pipefail
-cd "$(dirname "$0")/.."
-
-HARD_TIMEOUT="${OVERLAP_TIMEOUT_SECS:-600}"
-
-timeout -k 10 "$HARD_TIMEOUT" \
-    env JAX_PLATFORMS=cpu \
-    python -m pytest tests/test_wire_zero_copy.py \
-    "tests/test_runtime.py::test_host_overlap_bit_identical" \
-    -q -p no:cacheprovider
-
-exec timeout -k 10 "$HARD_TIMEOUT" \
-    env JAX_PLATFORMS=cpu \
-    python tools/wirebench.py --out /tmp/wirebench_smoke
+# Delegate kept for back-compat: the shared runner is tools/smoke.sh.
+exec "$(dirname "$0")/smoke.sh" overlap "$@"
