@@ -47,7 +47,6 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 import jax
-import jax.numpy as jnp
 
 from deneva_tpu.ops import access_incidence, bucket_hash, combine_key
 

@@ -404,6 +404,16 @@ class Config:
     # ---- misc ----
     seed: int = 0
     debug_timeline: bool = False
+    owner_check: bool = False      # debug mode: wrap the dispatch-owned
+    #                                host collections (runtime/
+    #                                ownercheck.GUARDED) in subclasses
+    #                                whose mutators assert the calling
+    #                                thread is the dispatch thread — the
+    #                                runtime half of the graftlint
+    #                                thread-ownership checker (our
+    #                                substitute for TSAN, broken on this
+    #                                box).  Default off: nothing is
+    #                                wrapped and no code path changes.
 
     # ------------------------------------------------------------------
     @property

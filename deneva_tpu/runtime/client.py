@@ -138,7 +138,6 @@ class ClientNode:
         # pre-generate a query ring (client_query.cpp pre-generation):
         # enough blocks that wraparound reuse is harmless (fresh zipf draws
         # per block; the reference wraps the same way)
-        import jax
         rng = jax.random.PRNGKey(cfg.seed + 7919 * cfg.node_id)
         n_pregen = 64
         self.ring: list[wire.QueryBlock] = []

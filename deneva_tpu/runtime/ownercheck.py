@@ -1,0 +1,198 @@
+"""Thread-ownership declarations + debug-mode runtime asserts for the
+server's shared state (our substitute for the broken TSAN on this box).
+
+The host-path pipeline (PR 3) runs three thread roles inside a server
+process — the DISPATCH thread (the epoch loop: admission, feed build,
+device dispatch, retirement, all state mutation), ONE ordered WIRE
+worker (blob encode+broadcast, log pack/append, replica sends), and ONE
+RETIRE worker (verdict d2h wait + pure unpacking) — plus the CODEC pool
+(thread_cnt > 1: blob bcast + feed fill closures).  The bit-identity
+contract is that workers stage PURE work and every state mutation stays
+at the dispatch thread's serial-loop positions.
+
+This module is the single source of truth for who owns what:
+
+* ``OWNER`` maps every ServerNode attribute to its owning role.  The
+  graftlint ownership checker (tools/graftlint/ownership.py) walks each
+  worker's call graph and reports writes to state the worker does not
+  own; an attribute missing from this map is itself a finding, so the
+  map cannot silently rot.
+* ``install(server)`` — the ``owner_check=true`` runtime mode — stamps
+  the dispatch thread on the mutable collections in ``GUARDED`` by
+  wrapping them in subclasses whose mutators assert the calling thread.
+  With ``owner_check=false`` (default) nothing is wrapped and no code
+  path changes: the flag is checked once at ``ServerNode.run()`` entry,
+  after recovery/replay has populated the collections.
+
+Kept import-light (stdlib only): the linter imports these declarations
+without pulling in jax or the runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+DISPATCH = "dispatch"   # the epoch loop thread (owns all state mutation)
+WIRE = "wire"           # ordered wire worker (host_overlap)
+RETIRE = "retire"       # verdict prefetch worker (host_overlap)
+CODEC = "codec"         # codec pool closures (thread_cnt > 1)
+SHARED = "shared"       # internally synchronized (lock / thread-safe impl)
+
+# ---- ServerNode attribute -> owning role ------------------------------
+# Workers may READ anything (staged work is pure given its inputs); a
+# WRITE from a non-owning role is the bug class this map exists to catch.
+OWNER: dict[str, str] = {
+    # static shape/config (written once in __init__, read-only after)
+    "cfg": DISPATCH, "me": DISPATCH, "n_srv": DISPATCH, "n_cl": DISPATCH,
+    "n_repl": DISPATCH, "b_loc": DISPATCH, "b_merged": DISPATCH,
+    "wl": DISPATCH, "be": DISPATCH, "vote_mode": DISPATCH,
+    "defer_budget": DISPATCH, "C": DISPATCH, "K": DISPATCH,
+    "_width": DISPATCH, "_n_scalars": DISPATCH,
+    "vote_step": DISPATCH, "check_step": DISPATCH, "apply_step": DISPATCH,
+    "maat_vote": DISPATCH, "group_step": DISPATCH,
+    "_elastic": DISPATCH, "_M": DISPATCH, "_full_planes": DISPATCH,
+    "_plane_lo": DISPATCH, "_plane_n": DISPATCH,
+    "_failover": DISPATCH, "_dedup_on": DISPATCH, "_kill_at": DISPATCH,
+    "_committed_cap": DISPATCH, "log_path": DISPATCH,
+    "repl_ids": DISPATCH, "_overlap": DISPATCH, "_own_installed": DISPATCH,
+    # engine state + counters (dispatch-loop positions only)
+    "db": DISPATCH, "cc_state": DISPATCH, "dev_stats": DISPATCH,
+    "stats": DISPATCH, "_ph": DISPATCH, "_retry_hist": DISPATCH,
+    "_wait_hist": DISPATCH, "_uniq_aborts": DISPATCH,
+    "_dup_admits": DISPATCH, "_reacks": DISPATCH,
+    "stop_epoch": DISPATCH, "measure_epoch": DISPATCH,
+    "_resume_epoch": DISPATCH, "_inflight": DISPATCH,
+    "_t_meas": DISPATCH, "_uniq_meas": DISPATCH, "_retry_meas": DISPATCH,
+    "_wait_meas": DISPATCH,
+    # admission / retirement queues and dedup state
+    "pending": DISPATCH, "retry": DISPATCH,
+    "blob_buf": DISPATCH, "vote_buf": DISPATCH, "vote2_buf": DISPATCH,
+    "_in_system": DISPATCH, "_committed_set": DISPATCH,
+    "_committed_recent": DISPATCH, "_held_rsp": DISPATCH,
+    "_held_commit": DISPATCH, "repl_acked": DISPATCH,
+    "_rejoin_pending": DISPATCH, "_feed_free": DISPATCH,
+    # elastic membership control plane (cutovers at group boundaries,
+    # always applied on the dispatch thread)
+    "smap": DISPATCH, "_mig_pending": DISPATCH, "_mig_rows": DISPATCH,
+    "_contrib_gone": DISPATCH, "_reassigned": DISPATCH,
+    "_plan_sent": DISPATCH, "_rebalance_cnt": DISPATCH,
+    "_rows_in": DISPATCH, "_rows_out": DISPATCH,
+    "_cutover_stall_ms": DISPATCH, "_redirects": DISPATCH,
+    # internally synchronized / thread-safe objects
+    "tp": SHARED,            # native transport: MPMC queues
+    "logger": SHARED,        # EpochLogger: queue + writer thread
+    "_sent_blobs": SHARED,   # deque guarded by _sent_lock (REJOIN resend)
+    "_sent_lock": SHARED,
+    "codec_pool": SHARED, "wire_pool": SHARED, "retire_pool": SHARED,
+}
+
+# worker role -> function names whose call graphs run on that role
+# (_bcast_views/_log_group_views submit to wire_pool; _prefetch_retire to
+# retire_pool; _bcast/_fill are the codec-pool closures inside run())
+WORKER_ENTRY: dict[str, tuple[str, ...]] = {
+    WIRE: ("_bcast_views", "_log_group_views"),
+    RETIRE: ("_prefetch_retire",),
+    CODEC: ("_bcast", "_fill"),
+}
+
+# method names that mutate their receiver (the static checker flags
+# `self.X.<mutator>(...)` from a non-owning worker; the runtime guard
+# intercepts the same set)
+MUTATORS = frozenset((
+    "append", "appendleft", "extend", "extendleft", "insert", "pop",
+    "popleft", "popitem", "remove", "discard", "clear", "add", "update",
+    "setdefault", "sort", "reverse",
+    "__setitem__", "__delitem__",
+    # augmented in-place operators: `buf = self._in_system; buf |= ...`
+    # from a worker is exactly the aliased mutation only the runtime
+    # guard can see, so the wrappers must intercept these too
+    "__ior__", "__iand__", "__ixor__", "__isub__", "__iadd__", "__imul__",
+))
+
+# dispatch-owned attrs wrapped by install(): plain host collections only.
+# db/cc_state/dev_stats are jax pytrees (a dict subclass would turn them
+# into opaque leaves) and numpy buffers are mutated via views — both are
+# covered by the static checker instead.
+GUARDED = (
+    "pending", "blob_buf", "vote_buf", "vote2_buf", "_in_system",
+    "_committed_set", "_committed_recent", "_held_rsp", "_held_commit",
+    "_feed_free", "_mig_rows", "_reassigned", "_rejoin_pending",
+    "_contrib_gone", "repl_acked",
+)
+
+
+class OwnershipViolation(AssertionError):
+    """A thread mutated state owned by a different thread role."""
+
+
+_guard_cache: dict[type, type] = {}
+
+
+def _guarded_class(base: type) -> type:
+    """Subclass of ``base`` whose mutators assert the stamped owner."""
+    cls = _guard_cache.get(base)
+    if cls is not None:
+        return cls
+
+    def _check(self):
+        t = threading.current_thread()
+        if t is not self._own_thread:
+            raise OwnershipViolation(
+                f"{self._own_name}: mutated from thread {t.name!r}; "
+                f"owner is {self._own_thread.name!r} (dispatch). "
+                f"Staged worker code must stay pure — see "
+                f"runtime/ownercheck.py")
+
+    ns = {"_check_owner": _check, "_own_thread": None, "_own_name": "?"}
+
+    def _make(m, base_m):
+        def f(self, *a, **kw):
+            self._check_owner()
+            return base_m(self, *a, **kw)
+        f.__name__ = m
+        return f
+
+    for m in MUTATORS:
+        base_m = getattr(base, m, None)
+        if base_m is not None:
+            ns[m] = _make(m, base_m)
+    cls = type(f"Guarded{base.__name__}", (base,), ns)
+    _guard_cache[base] = cls
+    return cls
+
+
+def _guard_value(val, owner: threading.Thread, name: str):
+    """Wrapped copy of a plain collection (None when not wrappable)."""
+    for base in (deque, dict, set, list):
+        if type(val) is base:            # exact type: never re-wrap
+            cls = _guarded_class(base)
+            if base is deque and val.maxlen is not None:
+                g = cls(val, val.maxlen)
+            else:
+                g = cls(val)
+            g._own_thread = owner
+            g._own_name = name
+            return g
+    return None
+
+
+def install(server) -> int:
+    """Stamp the calling thread (the dispatch thread — ServerNode is
+    constructed and run on it) as owner of the GUARDED collections and
+    wrap them with asserting subclasses.  Returns the number wrapped.
+    Called only under ``owner_check=true``; the default config never
+    reaches this function."""
+    owner = threading.current_thread()
+    wrapped = 0
+    for attr in GUARDED:
+        val = getattr(server, attr, None)
+        if val is None:
+            continue
+        g = _guard_value(val, owner,
+                         f"srv{getattr(server, 'me', '?')}.{attr}")
+        if g is not None:
+            setattr(server, attr, g)
+            wrapped += 1
+    server._own_installed = wrapped
+    return wrapped

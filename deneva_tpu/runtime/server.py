@@ -706,7 +706,10 @@ class ServerNode:
         for p in range(self.n_srv):
             if p != self.me:
                 self.tp.send(p, "REJOIN", msg)
-        self._rejoin_pending = set(self.repl_ids)
+        # mutate in place: rebinding would shed the owner_check guard
+        # installed over this set at run() entry
+        self._rejoin_pending.clear()
+        self._rejoin_pending.update(self.repl_ids)
         for r in self.repl_ids:
             self.tp.send(r, "REJOIN", msg)
         self.tp.flush()
@@ -1740,6 +1743,13 @@ class ServerNode:
         import jax.numpy as jnp
 
         cfg = self.cfg
+        if cfg.owner_check:
+            # debug mode: stamp this (dispatch) thread as owner of the
+            # mutable host collections and assert every mutation comes
+            # from it (runtime/ownercheck.py; the static half is
+            # tools/graftlint's ownership checker)
+            from deneva_tpu.runtime import ownercheck
+            ownercheck.install(self)
         b, C, K = self.b_merged, self.C, self.K
         W, S = self._width, self._n_scalars
         # compile before the barrier so no node's first epoch stalls the
@@ -2102,7 +2112,7 @@ class ServerNode:
             alive = [p for p in range(self.n_srv)
                      if p not in self._reassigned]
             if self.me == min(alive):
-                for d in self._reassigned:
+                for d in sorted(self._reassigned):
                     for k in range(self.cfg.replica_cnt):
                         rid = self.n_srv + self.n_cl + d + k * self.n_srv
                         self.tp.send(rid, "SHUTDOWN",

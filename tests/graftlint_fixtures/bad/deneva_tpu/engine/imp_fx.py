@@ -1,0 +1,9 @@
+"""Seeded graftlint violations: imports family (never imported)."""
+
+import os                            # EXPECT[imp-unused]
+import json
+import json                          # EXPECT[imp-redefined]
+
+
+def use():
+    return json.dumps({})

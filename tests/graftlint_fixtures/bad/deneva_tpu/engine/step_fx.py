@@ -1,0 +1,61 @@
+"""Seeded graftlint violations: trace + det families.
+
+One violation per EXPECT-marker line; tests/test_graftlint.py
+asserts each rule fires exactly at its marker and nowhere else.  This
+file is never imported — it only has to parse.  Its path mimics
+deneva_tpu/engine/ so the determinism family (which is scoped to
+replay-relevant module prefixes) treats it as in-scope.
+"""
+
+import functools
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def bad_branch(db, x):
+    if x > 0:                        # EXPECT[trace-branch]
+        x = x + 1
+    y = np.abs(x)                    # EXPECT[trace-np-call]
+    z = float(x)                     # EXPECT[trace-host-sync]
+    return db, x, y, z
+
+
+def helper(v):
+    return v.item()                  # EXPECT[trace-host-sync]
+
+
+@jax.jit
+def entry(x):
+    return helper(x)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def run_fx(db, spec):
+    return db
+
+
+def call_run_fx(db):
+    return run_fx(db, {"mode": 1})   # EXPECT[trace-unstable-static]
+
+
+def draw_fx():
+    a = random.random()              # EXPECT[det-unseeded-rng]
+    b = np.random.rand(3)            # EXPECT[det-unseeded-rng]
+    t = time.time()                  # EXPECT[det-wallclock]
+    return a, b, t
+
+
+def emit_fx(tp, peers):
+    for p, payload in peers.items():     # EXPECT[det-unordered-iter]
+        tp.send(p, "EPOCH_BLOB", payload)
+
+
+def emit_wrapped_fx(tp):
+    gone = {4, 7}
+    # list()/enumerate() copy the set's order, they don't fix it
+    for i, p in enumerate(list(gone)):   # EXPECT[det-unordered-iter]
+        tp.send(p, "EPOCH_BLOB", bytes([i]))

@@ -1,0 +1,27 @@
+"""Seeded graftlint violations: thread-ownership family.
+
+A miniature ServerNode whose worker-entry methods (names taken from the
+real runtime/ownercheck.WORKER_ENTRY declarations) mutate dispatch-owned
+state.  The path mimics deneva_tpu/runtime/server.py because the
+ownership checker anchors there; it is never imported.
+"""
+
+
+class ServerNode:
+    def __init__(self):
+        self.stats = None
+        self.pending = []
+        self._held_rsp = []
+        self.mystery_attr = 0            # EXPECT[own-undeclared-attr]
+
+    def _bcast_views(self, item):
+        self.stats = item                # EXPECT[own-cross-thread-write]
+        self.pending.append(item)        # EXPECT[own-cross-thread-write]
+
+    def _prefetch_retire(self, item):
+        self._held_rsp.append(item)      # EXPECT[own-cross-thread-write]
+
+    def _dispatch_ok(self, item):
+        # not reachable from any worker entry: dispatch-loop code may
+        # mutate freely
+        self.pending.append(item)

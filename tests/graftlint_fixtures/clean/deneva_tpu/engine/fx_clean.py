@@ -1,0 +1,37 @@
+"""Clean fixture: jit-reachable code, replay-relevant path shape, wire
+sends — written the way the rules demand.  Every graftlint family must
+stay silent on this tree (tests/test_graftlint.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def good_step(db, x):
+    # data-dependent decisions stay on the device
+    y = jnp.where(x > 0, x, -x)
+    return db, y
+
+
+def host_emit(tp, peers):
+    # deterministic iteration order into the transport
+    for p in sorted(peers):
+        tp.send(p, "EPOCH_BLOB", peers[p])
+
+
+def host_stats(arr):
+    # numpy on host values (not jit-reachable) is fine
+    return np.asarray(arr).sum()
+
+
+def seeded_draw(seed):
+    # seeded generator RNG is replay-safe
+    return np.random.default_rng(seed).integers(0, 10, 4)
+
+
+def annotated_emit(tp, ds: "Dataset"):
+    # "set" as a SUBSTRING of a type name must not mark `ds` as a set
+    # (insertion-ordered mapping: iteration is deterministic)
+    for p in ds:
+        tp.send(p, "EPOCH_BLOB", ds[p])

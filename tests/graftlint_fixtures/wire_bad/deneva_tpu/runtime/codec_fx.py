@@ -1,0 +1,24 @@
+# EXPECT[wire-missing-route] -- route() below has no branch for DATA,
+# which the MINI model (tests/test_graftlint.py) says it must consume;
+# the checker anchors that finding at line 1 of the handler's module.
+"""Codec/handler fixture for the wire family (never imported)."""
+
+
+def encode_data(x):
+    return bytes(x)
+
+
+def decode_data(buf):
+    return buf
+
+
+def route(self, src, rtype, payload):
+    if rtype == "PING":
+        return payload
+    if rtype == "TYPO":              # EXPECT[wire-unknown-rtype]
+        return None                  # dead branch: not in the registry
+    return None
+
+
+def bogus_send(tp):
+    tp.send(0, "BOGUS", b"")         # EXPECT[wire-unknown-rtype]

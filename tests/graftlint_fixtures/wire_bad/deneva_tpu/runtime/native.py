@@ -1,0 +1,12 @@
+"""Seeded graftlint violations: wire family registry fixture.
+
+A miniature RTYPE registry that disagrees with the MINI model declared
+in tests/test_graftlint.py on every axis the checker covers: EXTRA is
+registered but unmodeled, the model's GHOST is unregistered, PING sits
+inside the fault mask though the model classifies it outside, and the
+model declares a decoder (decode_data_gone) that codec_fx.py does not
+define.  Never imported.
+"""
+
+RTYPE = {"PING": 1, "DATA": 2, "EXTRA": 3}
+FAULT_RTYPE_MASK = (1 << RTYPE["PING"]) | (1 << RTYPE["DATA"])  # EXPECT[wire-registry-drift] EXPECT[wire-registry-drift] EXPECT[wire-missing-codec] EXPECT[wire-fault-mask]

@@ -278,10 +278,12 @@ def test_chaos_kill_one_server_recovers_by_replay():
     resend, replica resync) and the run completes.  Safety: recovered
     state is bit-identical to an independent replay of the same log
     prefix, logs stay epoch-contiguous, replica logs stay byte
-    prefixes."""
+    prefixes.  Runs with owner_check=true: the thread-ownership runtime
+    asserts (runtime/ownercheck.py, the graftlint `own` family's dynamic
+    half) are armed for the whole kill/recover/rejoin path."""
     from deneva_tpu.harness.chaos import run_scenario
 
-    report = run_scenario("kill-one-server", quiet=True)
+    report = run_scenario("kill-one-server", quiet=True, owner_check=True)
     assert report["digest_match"]
     assert report["replica_prefix_ok"]
     assert report["resume_epoch"] > 0

@@ -1,0 +1,247 @@
+"""graftlint self-tests (PR 6).
+
+Fixture trees under tests/graftlint_fixtures/ carry one seeded violation
+per `EXPECT[rule]` marker; each rule must fire exactly at its marker
+lines and nowhere else, stay silent on the clean tree, and the real repo
+tree must be lint-clean.  The runtime half (ownercheck.install guards)
+is unit-tested at the bottom.
+"""
+
+import os
+import re
+import subprocess
+import sys
+import threading
+from collections import Counter, deque
+
+from tools.graftlint import wireproto
+from tools.graftlint.core import Tree, run_checkers
+from tools.graftlint.wiremodel import RtypeSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(REPO, "tests", "graftlint_fixtures")
+
+_EXPECT = re.compile(r"EXPECT\[([a-z-]+)\]")
+
+
+def _expected(root):
+    """Multiset of (rel path, line, rule) from EXPECT[...] markers."""
+    out = Counter()
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            with open(path) as f:
+                for i, ln in enumerate(f, 1):
+                    for rule in _EXPECT.findall(ln):
+                        out[(rel, i, rule)] += 1
+    return out
+
+
+def _got(findings):
+    return Counter((f.path, f.line, f.rule) for f in findings)
+
+
+# ---- each rule fires exactly at its seeded marker ----------------------
+
+def test_bad_fixture_rules_fire_exactly():
+    """trace / det / own / imports: the bad tree produces exactly the
+    marked findings (right rule, right file, right line — no extras)."""
+    root = os.path.join(FIX, "bad")
+    tree = Tree(root, ["."])
+    findings = run_checkers(tree, {"trace", "det", "own", "imports"})
+    assert _got(findings) == _expected(root), \
+        "\n".join(f.render() for f in findings)
+
+
+# the wire fixture is checked against its own miniature model (the real
+# WIRE_MODEL describes the real runtime, not the fixture registry)
+_MINI = {s.name: s for s in (
+    RtypeSpec("PING", False),
+    RtypeSpec("DATA", True, ("encode_data",),
+              ("decode_data", "decode_data_gone"), ("handler",)),
+    RtypeSpec("GHOST", False),
+)}
+
+
+def test_wire_fixture_rules_fire_exactly():
+    root = os.path.join(FIX, "wire_bad")
+    tree = Tree(root, ["."])
+    findings = tree.filter(wireproto.check(
+        tree, model=_MINI,
+        codec_modules=("deneva_tpu/runtime/codec_fx.py",),
+        route_funcs={"handler": ("deneva_tpu/runtime/codec_fx.py",
+                                 "route")}))
+    assert _got(findings) == _expected(root), \
+        "\n".join(f.render() for f in findings)
+
+
+def test_clean_fixture_is_silent():
+    root = os.path.join(FIX, "clean")
+    tree = Tree(root, ["."])
+    findings = run_checkers(tree, {"trace", "det", "wire", "own",
+                                   "imports"})
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_repo_tree_is_lint_clean():
+    """The acceptance gate: the real tree ends the PR clean (every true
+    finding fixed or explicitly suppressed with a reason)."""
+    tree = Tree(REPO, ["deneva_tpu", "tools"])
+    findings = run_checkers(tree, {"trace", "det", "wire", "own",
+                                   "imports"})
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---- CLI exit codes (the smoke-gate contract) --------------------------
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", *args],
+        cwd=REPO, capture_output=True, text=True).returncode
+
+
+def test_cli_exit_codes():
+    assert _cli(f"--root={os.path.join(FIX, 'bad')}", ".") == 1
+    assert _cli(f"--root={os.path.join(FIX, 'wire_bad')}", ".") == 1
+    assert _cli(f"--root={os.path.join(FIX, 'clean')}", ".") == 0
+    assert _cli("deneva_tpu/") == 0
+    # the gate fails CLOSED on a typo'd path (never "clean, 0 files")
+    assert _cli("deneva_tpuu/") == 2
+
+
+# ---- suppression syntax ------------------------------------------------
+
+_SUPPRESSED = """import jax
+
+
+@jax.jit
+def f(x):
+    # device-side decision is deliberate here (fixture reason)
+    if x > 0:  # graftlint: ignore[trace-branch]
+        x = x + 1
+    return x
+"""
+
+
+def test_suppression_marker(tmp_path):
+    d = tmp_path / "pkg"
+    d.mkdir()
+    (d / "sup_fx.py").write_text(_SUPPRESSED)
+    tree = Tree(str(tmp_path), ["."])
+    assert run_checkers(tree, {"trace"}) == []
+    # control: without the marker the same code fires
+    (d / "sup_fx.py").write_text(_SUPPRESSED.replace(
+        "  # graftlint: ignore[trace-branch]", ""))
+    tree = Tree(str(tmp_path), ["."])
+    assert [f.rule for f in run_checkers(tree, {"trace"})] \
+        == ["trace-branch"]
+
+
+def test_skip_file_marker(tmp_path):
+    d = tmp_path / "pkg"
+    d.mkdir()
+    (d / "skip_fx.py").write_text(
+        "# graftlint: skip-file (generated fixture)\n"
+        + _SUPPRESSED.replace("  # graftlint: ignore[trace-branch]", ""))
+    tree = Tree(str(tmp_path), ["."])
+    assert run_checkers(tree, {"trace"}) == []
+
+
+# ---- runtime half: ownercheck.install guards ---------------------------
+
+class _Srv:
+    pass
+
+
+def _guarded_server():
+    from deneva_tpu.runtime import ownercheck
+
+    s = _Srv()
+    s.me = 0
+    s.pending = deque([("c", "blk")])
+    s._in_system = {11}
+    s.repl_acked = {3: -1}
+    s._feed_free = [{}]
+    n = ownercheck.install(s)
+    assert n == 4        # exactly the wrappable GUARDED attrs present
+    return ownercheck, s
+
+
+def test_ownercheck_owner_thread_mutates_freely():
+    _oc, s = _guarded_server()
+    s.pending.append(("c", "blk2"))
+    s._in_system.add(12)
+    s.repl_acked[3] = 5
+    s._feed_free.pop()
+    assert len(s.pending) == 2 and s.repl_acked[3] == 5
+
+
+def test_ownercheck_cross_thread_mutation_raises():
+    oc, s = _guarded_server()
+    def _ior():
+        buf = s._in_system           # aliased in-place mutation: the
+        buf |= {97, 98}              # case only the runtime half sees
+
+    ops = [lambda: s.pending.append(("x", "y")),
+           lambda: s._in_system.discard(11),
+           lambda: s.repl_acked.update({3: 9}),
+           lambda: s.repl_acked.__setitem__(3, 9),
+           lambda: s._feed_free.pop(),
+           _ior]
+    caught = []
+
+    def hostile():
+        for op in ops:
+            try:
+                op()
+            except oc.OwnershipViolation as e:
+                caught.append(str(e))
+
+    t = threading.Thread(target=hostile, name="wire-worker-fx")
+    t.start()
+    t.join()
+    assert len(caught) == len(ops)
+    assert "wire-worker-fx" in caught[0]
+    # the guard rejects BEFORE mutating: state is untouched
+    assert len(s.pending) == 1 and s.repl_acked[3] == -1
+    assert s._in_system == {11} and len(s._feed_free) == 1
+
+
+def test_ownercheck_cross_thread_reads_are_free():
+    _oc, s = _guarded_server()
+    got = []
+
+    def reader():
+        got.append((len(s.pending), 3 in s.repl_acked,
+                    sorted(s._in_system), list(s.pending)))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    t.join()
+    assert got == [(1, True, [11], [("c", "blk")])]
+
+
+def test_ownercheck_preserves_deque_maxlen():
+    from deneva_tpu.runtime import ownercheck
+
+    s = _Srv()
+    s.me = 1
+    s._committed_recent = deque([1, 2], maxlen=2)
+    assert ownercheck.install(s) == 1
+    s._committed_recent.append(3)
+    assert list(s._committed_recent) == [2, 3]
+    assert s._committed_recent.maxlen == 2
+
+
+def test_ownercheck_owner_map_covers_guarded():
+    """Every GUARDED attr must have a declared owner (the static checker
+    enforces the server side; this pins the declarations file itself)."""
+    from deneva_tpu.runtime import ownercheck as oc
+
+    assert set(oc.GUARDED) <= set(oc.OWNER)
+    assert all(oc.OWNER[a] == oc.DISPATCH for a in oc.GUARDED)
+    for role in oc.WORKER_ENTRY:
+        assert role in (oc.WIRE, oc.RETIRE, oc.CODEC)

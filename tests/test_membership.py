@@ -327,7 +327,10 @@ def test_elastic_drain_scenario_short():
     counts agree across the cutover, zero lost/duplicated txns."""
     from deneva_tpu.harness.chaos import run_scenario
 
-    report = run_scenario("elastic-drain", quick=True, quiet=True)
+    # owner_check=true arms the thread-ownership runtime asserts on a
+    # live cluster in tier-1 (cheap: wrap-at-init + per-mutator check)
+    report = run_scenario("elastic-drain", quick=True, quiet=True,
+                          owner_check=True)
     assert len(set(report["commits"])) == 1 and report["commits"][0] > 0
     assert report["owned_slots"][2] == 0
     assert all(a > 0 for a in report["client_acked"])
@@ -351,10 +354,13 @@ def test_elastic_kill_with_reassignment():
     """Failover-with-reassignment: a killed server's slots move to the
     survivors (rows rebuilt by log replay) WITHOUT restarting the dead
     node; the run reaches liveness and exactly-once holds across the
-    takeover (resends re-ack from the survivors' committed sets)."""
+    takeover (resends re-ack from the survivors' committed sets).
+    Runs with owner_check=true: the thread-ownership runtime asserts
+    (runtime/ownercheck.py) are armed across the reassignment replay."""
     from deneva_tpu.harness.chaos import run_scenario
 
-    report = run_scenario("elastic-kill-reassign", quiet=True)
+    report = run_scenario("elastic-kill-reassign", quiet=True,
+                          owner_check=True)
     assert len(set(report["commits"])) == 1 and report["commits"][0] > 0
     assert 2 not in report["owned_slots"]   # the dead node never reports
     assert all(a > 0 for a in report["client_acked"])

@@ -411,7 +411,14 @@ def _drive_overlap_run(tmp_path, overlap: bool) -> dict:
                     pipeline_epochs=2, pipeline_groups=2, logging=True,
                     replica_cnt=1, log_dir=log_dir, warmup_secs=0.0,
                     done_secs=0.0,
-                    host_overlap="on" if overlap else "off")
+                    host_overlap="on" if overlap else "off",
+                    # arm the thread-ownership runtime asserts on BOTH
+                    # sides: with overlap on, the wire/retire workers run
+                    # for real against the guards (any staged-work
+                    # mutation of dispatch-owned state raises), and the
+                    # on==off byte-compare doubles as proof the guards
+                    # themselves change nothing
+                    owner_check=True)
     eps = ipc_endpoints(3, uuid.uuid4().hex[:8])
     wl = get_workload(cfg)
     batches = []

@@ -1,0 +1,204 @@
+"""Registry-completeness contract (PR 6 satellite): the executable half
+of the graftlint wire-consistency model.
+
+Every rtype declared in native.RTYPE must (a) have a WIRE_MODEL row,
+(b) carry an EXPLICIT in/out fault-mask classification that matches
+native.FAULT_RTYPE_MASK (the PR 4 "rtypes 15-17 outside the mask" rule,
+machine-checked), (c) name only codecs that actually exist, and (d) —
+when it carries a payload — round-trip encode → decode bit-exactly.
+The ROUNDTRIP table below must stay total over the registry: adding an
+rtype without extending it fails test_every_rtype_covered.
+"""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.runtime import membership as M
+from deneva_tpu.runtime import logger, native, wire
+from tools.graftlint.wiremodel import WIRE_MODEL
+
+# ---- model <-> registry agreement --------------------------------------
+
+def test_registry_and_model_agree():
+    assert set(native.RTYPE) == set(WIRE_MODEL)
+
+
+def test_fault_mask_classification_is_explicit_and_matches():
+    for name, spec in WIRE_MODEL.items():
+        in_mask = bool(native.FAULT_RTYPE_MASK >> native.RTYPE[name] & 1)
+        assert in_mask == spec.fault_mask, (
+            f"{name}: FAULT_RTYPE_MASK says {in_mask}, model says "
+            f"{spec.fault_mask} ({spec.note})")
+    # the chaos-harness contract: exactly the open-loop client traffic
+    assert {n for n, s in WIRE_MODEL.items() if s.fault_mask} \
+        == {"CL_QRY_BATCH", "CL_RSP"}
+
+
+def test_declared_codecs_exist():
+    for spec in WIRE_MODEL.values():
+        for fn in (*spec.codec_encode, *spec.codec_decode):
+            assert any(hasattr(m, fn) for m in (wire, M, logger)), \
+                f"{spec.name}: declared codec {fn} not found"
+
+
+# ---- per-rtype round trips ---------------------------------------------
+
+def _qb(n=6, w=3, s=2, seed=7):
+    r = np.random.default_rng(seed)
+    return wire.QueryBlock(
+        keys=r.integers(0, 1 << 20, (n, w)).astype(np.int32),
+        types=r.integers(0, 4, (n, w)).astype(np.int8),
+        scalars=r.integers(0, 99, (n, s)).astype(np.int32),
+        tags=r.integers(0, 1 << 40, n).astype(np.int64))
+
+
+def _assert_qb_equal(a, b):
+    np.testing.assert_array_equal(a.keys, b.keys)
+    np.testing.assert_array_equal(a.types, b.types)
+    np.testing.assert_array_equal(a.scalars, b.scalars)
+    np.testing.assert_array_equal(a.tags, b.tags)
+
+
+def _rt_qry_batch():
+    b = _qb()
+    _assert_qb_equal(b, wire.decode_qry_block(wire.encode_qry_block(b)))
+    # the zero-copy parts path must be byte-identical to the codec
+    parts = wire.qry_block_parts(b.tags, b.keys, b.types, b.scalars)
+    assert b"".join(bytes(p) for p in parts) == wire.encode_qry_block(b)
+
+
+def _rt_cl_rsp():
+    tags = np.arange(5, dtype=np.int64) * 977
+    np.testing.assert_array_equal(
+        tags, wire.decode_cl_rsp(wire.encode_cl_rsp(tags)))
+    assert b"".join(bytes(p) for p in wire.cl_rsp_parts(tags)) \
+        == wire.encode_cl_rsp(tags)
+
+
+def _rt_epoch_blob():
+    b = _qb()
+    ts = np.arange(len(b), dtype=np.int64) + 100
+    buf = wire.encode_epoch_blob(42, b, ts)
+    epoch, b2, ts2 = wire.decode_epoch_blob(buf)
+    assert epoch == 42 and wire.peek_blob_epoch(buf) == 42
+    _assert_qb_equal(b, b2)
+    np.testing.assert_array_equal(ts, ts2)
+    # in-place decode into oversized feed views
+    n, w, s = len(b), b.keys.shape[1], b.scalars.shape[1]
+    tags = np.zeros(n + 3, np.int64)
+    ts3 = np.zeros(n + 3, np.int64)
+    keys = np.zeros((n + 3, w), np.int32)
+    types = np.zeros((n + 3, w), np.int8)
+    scalars = np.zeros((n + 3, s), np.int32)
+    e2, n2 = wire.decode_epoch_blob_into(buf, tags, ts3, keys, types,
+                                         scalars)
+    assert (e2, n2) == (42, n)
+    np.testing.assert_array_equal(keys[:n], b.keys)
+    np.testing.assert_array_equal(tags[:n], b.tags)
+    # parts path byte-identity
+    parts = wire.epoch_blob_parts(42, ts, b.tags, b.keys, b.types,
+                                  b.scalars)
+    assert b"".join(bytes(p) for p in parts) == buf
+
+
+def _rt_log_msg():
+    b = _qb()
+    ts = np.arange(len(b), dtype=np.int64)
+    blob = wire.encode_epoch_blob(3, b, ts)
+    active = np.array([1, 0, 1, 1, 0, 1], np.uint8)
+    rec = logger.pack_record(3, blob, active)
+    [(e, blob2, bits)] = list(logger.unpack_records(rec))
+    assert e == 3 and blob2 == blob
+    np.testing.assert_array_equal(
+        bits, np.packbits(active))
+    # one-pass views packer is byte-identical
+    rec2 = logger.pack_record_views(3, ts, b.tags, b.keys, b.types,
+                                    b.scalars, active)
+    assert rec2.tobytes() == rec
+    [(e3, lo, hi)] = list(logger.iter_record_spans(rec))
+    assert e3 == 3 and (lo, hi) == (0, len(rec))
+
+
+def _rt_shutdown():
+    assert wire.decode_shutdown(wire.encode_shutdown(1234)) == 1234
+
+
+def _rt_vote():
+    r = np.random.default_rng(3)
+    commit = r.integers(0, 2, 19).astype(bool)
+    abort = ~commit & r.integers(0, 2, 19).astype(bool)
+    for bounds in (None, r.integers(0, 999, 19).astype(np.int32)):
+        e, c2, a2, b2 = wire.decode_vote(
+            wire.encode_vote(9, commit, abort, bounds))
+        assert e == 9
+        np.testing.assert_array_equal(commit, c2)
+        np.testing.assert_array_equal(abort, a2)
+        if bounds is None:
+            assert b2 is None
+        else:
+            np.testing.assert_array_equal(bounds, b2)
+
+
+def _rt_map_msg():
+    m = M.SlotMap(5, np.arange(12, dtype=np.int32) % 3)
+    buf = M.encode_map_msg(m, cutover_epoch=77, reason=M.REASON_DRAIN,
+                           subject=2)
+    m2, cutover, reason, subject = M.decode_map_msg(buf)
+    assert (m2.version, cutover, reason, subject) \
+        == (5, 77, M.REASON_DRAIN, 2)
+    np.testing.assert_array_equal(m.owners, m2.owners)
+
+
+def _rt_migrate_rows():
+    keys = np.array([4, 16, 28], np.int32)
+    cols = {"val": np.arange(6, dtype=np.int64).reshape(3, 2),
+            "flag": np.array([1, 0, 1], np.uint8)}
+    buf = M.encode_migrate_rows(8, keys, cols)
+    assert M.peek_rows_version(buf) == 8
+    v, keys2, cols2 = M.decode_migrate_rows(buf)
+    assert v == 8 and set(cols2) == {"val", "flag"}
+    np.testing.assert_array_equal(keys, keys2)
+    for name in cols:
+        np.testing.assert_array_equal(cols[name], cols2[name])
+
+
+def _rt_payload_free():
+    return None     # no payload on the wire: nothing to round-trip
+
+
+ROUNDTRIP = {
+    "INIT_DONE": _rt_payload_free,      # setup barrier
+    "CL_QRY_BATCH": _rt_qry_batch,
+    "CL_RSP": _rt_cl_rsp,
+    "RDONE": _rt_payload_free,          # reserved (EPOCH_BLOB doubles)
+    "EPOCH_BLOB": _rt_epoch_blob,
+    "LOG_MSG": _rt_log_msg,
+    "LOG_RSP": _rt_shutdown,            # epoch-watermark ack
+    "PING": _rt_payload_free,           # native-level
+    "PONG": _rt_payload_free,           # native-level
+    "SHUTDOWN": _rt_shutdown,
+    "MEASURE": _rt_shutdown,
+    "VOTE": _rt_vote,
+    "VOTE2": _rt_vote,
+    "REJOIN": _rt_shutdown,
+    "MIGRATE_BEGIN": _rt_map_msg,
+    "MIGRATE_ROWS": _rt_migrate_rows,
+    "MAP_UPDATE": _rt_map_msg,
+}
+
+
+def test_every_rtype_covered():
+    assert set(ROUNDTRIP) == set(native.RTYPE)
+    # payload-free entries must declare no codecs in the model; payload
+    # entries must declare at least an encoder or decoder
+    for name, fn in ROUNDTRIP.items():
+        spec = WIRE_MODEL[name]
+        if fn is _rt_payload_free:
+            assert spec.codec_encode == () and spec.codec_decode == (), name
+        else:
+            assert spec.codec_encode or spec.codec_decode, name
+
+
+@pytest.mark.parametrize("name", sorted(native.RTYPE))
+def test_rtype_round_trips(name):
+    ROUNDTRIP[name]()

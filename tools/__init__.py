@@ -1,0 +1,2 @@
+# tools/ is an importable package so `python -m tools.graftlint` works
+# from the repo root (the same way the harness modules run with -m).

@@ -1,0 +1,30 @@
+"""graftlint: repo-specific static analysis for the jax_graft runtime.
+
+Four invariant checker families plus generic import hygiene protect the
+invariants the headline results rest on (README "Invariants & lint",
+COVERAGE §2.12):
+
+* **trace**  — trace-safety inside jit/shard_map-reachable code: no
+  Python branching on tracer values, no `np.*` on traced arrays, no
+  `.item()`/`float()` host syncs, no hash-unstable static args that
+  re-trace per epoch.
+* **det**    — determinism in replay-relevant modules: no unseeded RNG
+  or wall-clock feeding state/digests, no set/dict-ordered iteration
+  reaching wire encoders or log records.
+* **wire**   — the rtype registry, the wire codecs, the route branches
+  and the fault-mask classification must agree with one declared model
+  (`wiremodel.py`).
+* **own**    — thread-ownership of ServerNode state (dispatch / wire
+  worker / retire worker / codec pool): no worker writes state it does
+  not own (`deneva_tpu/runtime/ownercheck.py` is the declarations
+  file; the same decls drive the `owner_check=true` runtime asserts).
+* **imports** — generic import hygiene (unused/duplicate imports), the
+  in-repo stand-in for the ruff pyflakes baseline on boxes without ruff.
+
+Run:      python -m tools.graftlint deneva_tpu/
+Suppress: trailing `# graftlint: ignore[rule-id]` (same or previous
+line), with a comment explaining why; `# graftlint: skip-file` in the
+first five lines skips a file (fixtures only).
+"""
+
+from tools.graftlint.core import Finding, Tree, run_checkers  # noqa: F401

@@ -1,0 +1,90 @@
+"""CLI: python -m tools.graftlint [paths...] [options]
+
+Exit status: 0 = clean, 1 = findings, 2 = usage/parse failure.
+
+Options
+-------
+--select=fam[,fam...]   run only these families
+                        (trace, det, wire, own, imports; default all)
+--root=DIR              tree root for repo-relative paths (default: the
+                        repo root containing this tools/ package)
+--json                  machine-readable output (one object per line)
+--list-rules            print the rule catalogue and exit
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+from tools.graftlint.core import FAMILIES, Tree, run_checkers
+
+_RULES = {
+    "trace": ("trace-branch", "trace-np-call", "trace-host-sync",
+              "trace-unstable-static"),
+    "det": ("det-unseeded-rng", "det-wallclock", "det-unordered-iter"),
+    "wire": ("wire-registry-drift", "wire-missing-codec",
+             "wire-missing-route", "wire-fault-mask", "wire-unknown-rtype"),
+    "own": ("own-cross-thread-write", "own-undeclared-attr"),
+    "imports": ("imp-unused", "imp-redefined"),
+}
+
+
+def main(argv: list[str]) -> int:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    families = set(FAMILIES)
+    paths: list[str] = []
+    as_json = False
+    for a in argv:
+        if a == "--list-rules":
+            for fam in FAMILIES:
+                for r in _RULES[fam]:
+                    print(f"{fam:8s} {r}")
+            return 0
+        if a.startswith("--select="):
+            families = set(a.split("=", 1)[1].split(","))
+            bad = families - set(FAMILIES)
+            if bad:
+                print(f"graftlint: unknown families {sorted(bad)} "
+                      f"(have {FAMILIES})", file=sys.stderr)
+                return 2
+        elif a.startswith("--root="):
+            root = a.split("=", 1)[1]
+        elif a == "--json":
+            as_json = True
+        elif a.startswith("-"):
+            print(__doc__, file=sys.stderr)
+            return 2
+        else:
+            paths.append(a)
+    if not paths:
+        paths = ["deneva_tpu", "tools"]
+    # repo root on sys.path so the ownership checker can import the
+    # declarations module (pure data, no jax)
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    try:
+        tree = Tree(root, paths)
+    except FileNotFoundError as e:
+        print(e, file=sys.stderr)
+        return 2
+    findings = run_checkers(tree, families)
+    for f in findings:
+        if as_json:
+            print(json.dumps(f.__dict__))
+        else:
+            print(f.render())
+    n_parse = sum(1 for f in findings if f.rule == "parse-error")
+    if findings:
+        print(f"graftlint: {len(findings)} finding(s) over "
+              f"{len(tree.modules)} files", file=sys.stderr)
+        return 2 if n_parse else 1
+    print(f"graftlint: clean ({len(tree.modules)} files, "
+          f"families={','.join(sorted(families))})", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

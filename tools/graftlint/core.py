@@ -1,0 +1,214 @@
+"""graftlint core: module tree, findings, suppressions, shared AST utils.
+
+Everything here is plain `ast` — no imports of the analyzed code (the
+one exception is the ownership declarations module, which is pure data
+and is imported by the ownership checker so the linter and the runtime
+asserts can never drift apart).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+
+SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*ignore(?:\[([A-Za-z0-9_,\- ]+)\])?")
+SKIP_FILE_RE = re.compile(r"#\s*graftlint:\s*skip-file")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str       # e.g. "trace-branch"
+    path: str       # repo-relative
+    line: int
+    msg: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.msg}"
+
+
+class Module:
+    """One parsed source file + its suppression table and import map."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        # line -> suppressed rule set (None = all rules)
+        self.suppress: dict[int, set[str] | None] = {}
+        for i, ln in enumerate(self.lines, 1):
+            m = SUPPRESS_RE.search(ln)
+            if m:
+                rules = m.group(1)
+                self.suppress[i] = (set(r.strip() for r in rules.split(","))
+                                    if rules else None)
+        self.skip = any(SKIP_FILE_RE.search(ln) for ln in self.lines[:5])
+        # import aliases: local name -> dotted module/thing it names
+        self.imports: dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    local = a.asname or a.name.split(".")[0]
+                    self.imports[local] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.imports[a.asname or a.name] = f"{mod}.{a.name}"
+
+    def alias_of(self, name: str) -> str | None:
+        """Dotted import target of a local name (None if not imported)."""
+        return self.imports.get(name)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        """A finding is suppressed by a marker on its own line or the
+        line directly above (for findings wider than one line)."""
+        for ln in (line, line - 1):
+            rules = self.suppress.get(ln, False)
+            if rules is False:
+                continue
+            if rules is None or rule in rules:
+                return True
+        return False
+
+
+class Tree:
+    """The analyzed file set + indexes the checkers share."""
+
+    def __init__(self, root: str, paths: list[str] | None = None):
+        self.root = os.path.abspath(root)
+        self.modules: list[Module] = []
+        self.errors: list[Finding] = []
+        for path in sorted(self._collect(paths or ["."])):
+            rel = os.path.relpath(path, self.root)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+                self.modules.append(Module(path, rel, src))
+            except (SyntaxError, UnicodeDecodeError, ValueError,
+                    OSError) as e:
+                # ValueError: ast.parse on NUL bytes; OSError: unreadable
+                # file — both must surface as parse-error (exit 2), not
+                # a traceback
+                line = getattr(e, "lineno", 1) or 1
+                self.errors.append(Finding("parse-error", rel, line, str(e)))
+        # indexes
+        self.by_rel: dict[str, Module] = {m.rel: m for m in self.modules}
+        # function defs by bare name -> [(module, def node, enclosing class name|None)]
+        self.funcs: dict[str, list[tuple[Module, ast.AST, str | None]]] = {}
+        # module-level funcs per module: {rel: {name: def}}
+        self.mod_funcs: dict[str, dict[str, ast.AST]] = {}
+        self.classes: dict[str, list[tuple[Module, ast.ClassDef]]] = {}
+        for m in self.modules:
+            self.mod_funcs[m.rel] = {}
+            for node in m.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self.mod_funcs[m.rel][node.name] = node
+            for node in ast.walk(m.tree):
+                if isinstance(node, ast.ClassDef):
+                    self.classes.setdefault(node.name, []).append((m, node))
+            for node, cls in walk_funcs(m.tree):
+                self.funcs.setdefault(node.name, []).append((m, node, cls))
+
+    def _collect(self, paths: list[str]) -> list[str]:
+        out = []
+        for p in paths:
+            ap = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if not os.path.exists(ap):
+                # fail CLOSED: a typo'd path in a CI config must not
+                # turn the gate into "clean (0 files)" forever
+                raise FileNotFoundError(f"graftlint: no such path: {p}")
+            if os.path.isfile(ap) and ap.endswith(".py"):
+                out.append(ap)
+                continue
+            for dirpath, dirnames, filenames in os.walk(ap):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git", "build",
+                                            ".claude", "node_modules")]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        return out
+
+    def module(self, rel: str) -> Module | None:
+        return self.by_rel.get(rel)
+
+    def filter(self, findings: list[Finding]) -> list[Finding]:
+        """Drop suppressed findings; stable order by (path, line, rule)."""
+        out = []
+        for f in findings:
+            m = self.by_rel.get(f.path)
+            if m is not None and (m.skip or m.suppressed(f.rule, f.line)):
+                continue
+            out.append(f)
+        return sorted(set(out), key=lambda f: (f.path, f.line, f.rule))
+
+
+def walk_funcs(tree: ast.AST):
+    """Yield (FunctionDef, enclosing class name | None) for every def,
+    including nested ones."""
+    stack: list[tuple[ast.AST, str | None]] = [(tree, None)]
+    while stack:
+        node, cls = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            ccls = cls
+            if isinstance(child, ast.ClassDef):
+                ccls = child.name
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield child, cls
+            stack.append((child, ccls))
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`a.b.c` attribute chain as a dotted string (None if not a pure
+    Name/Attribute chain)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolved_dotted(mod: Module, node: ast.AST) -> str | None:
+    """Dotted chain with the leading local alias resolved through the
+    module's import map: `jnp.arange` -> `jax.numpy.arange`."""
+    d = dotted(node)
+    if d is None:
+        return None
+    head, _, rest = d.partition(".")
+    target = mod.alias_of(head)
+    if target is None:
+        return d
+    return f"{target}.{rest}" if rest else target
+
+
+def run_checkers(tree: Tree, families: set[str]) -> list[Finding]:
+    """Run the selected checker families over a tree (repo layout
+    assumed for wire/own; they no-op when their anchor files are not in
+    the tree, so fixture runs stay self-contained)."""
+    from tools.graftlint import (determinism, imports, ownership,
+                                 tracesafety, wireproto)
+
+    findings: list[Finding] = list(tree.errors)
+    if "trace" in families:
+        findings += tracesafety.check(tree)
+    if "det" in families:
+        findings += determinism.check(tree)
+    if "wire" in families:
+        findings += wireproto.check(tree)
+    if "own" in families:
+        findings += ownership.check(tree)
+    if "imports" in families:
+        findings += imports.check(tree)
+    return tree.filter(findings)
+
+
+FAMILIES = ("trace", "det", "wire", "own", "imports")

@@ -1,0 +1,221 @@
+"""det family: determinism in replay-relevant modules.
+
+The command log + deterministic re-execution IS the failover story
+(PR 1), overlap on/off and elastic on/off are bit-identity contracts
+(PR 3/4), and `logger.state_digest` is the cross-node equality oracle.
+Any nondeterminism that feeds engine state, wire bytes, or digests
+breaks all of them silently.  Rules:
+
+det-unseeded-rng    `random.*` / `np.random.*` module-state RNG (or a
+                    seedless `default_rng()`) in a replay-relevant
+                    module.  Only `jax.random` keyed by config seeds is
+                    replay-safe here.
+det-wallclock       `time.time`/`time_ns`/`datetime.now` in a replay-
+                    relevant module — wall-clock values differ across
+                    runs and nodes (use `time.monotonic` for intervals,
+                    epoch-anchored stamps for protocol state).
+det-unordered-iter  a `for` loop over a set (or dict view) whose body
+                    reaches an order-sensitive sink (transport send,
+                    wire encoder, log record packing, state digest):
+                    set order is hash-seed/arrival dependent, so the
+                    emitted byte order diverges across runs/nodes.
+                    Wrap the iterable in `sorted(...)`.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (Finding, Module, Tree, dotted,
+                                  resolved_dotted)
+
+# replay-relevant module prefixes (repo-relative)
+REPLAY_MODULES = (
+    "deneva_tpu/engine/",
+    "deneva_tpu/cc/",
+    "deneva_tpu/runtime/server.py",
+    "deneva_tpu/runtime/membership.py",
+    "deneva_tpu/runtime/logger.py",
+    "deneva_tpu/runtime/wire.py",
+)
+
+_SEND_SINKS = frozenset(("send", "sendv", "sendv_many"))
+_NAME_SINKS = frozenset(("pack_record", "pack_record_views",
+                         "state_digest"))
+
+
+def _relevant(rel: str, prefixes) -> bool:
+    return any(rel.startswith(p) or rel == p for p in prefixes)
+
+
+def _rng_finding(mod: Module, node: ast.Call) -> Finding | None:
+    fd = resolved_dotted(mod, node.func)
+    if fd is None:
+        return None
+    if fd.startswith("random.") or fd == "random":
+        return Finding("det-unseeded-rng", mod.rel, node.lineno,
+                       f"stdlib `{dotted(node.func)}` draws from hidden "
+                       f"module state — replay cannot reproduce it; use "
+                       f"jax.random keyed on cfg.seed")
+    if fd.startswith("numpy.random."):
+        leaf = fd.rsplit(".", 1)[1]
+        if leaf in ("default_rng", "Generator", "SeedSequence", "RandomState"):
+            if node.args or node.keywords:
+                return None          # explicitly seeded generator
+        return Finding("det-unseeded-rng", mod.rel, node.lineno,
+                       f"`{dotted(node.func)}` is module-state / unseeded "
+                       f"RNG in a replay-relevant module")
+    return None
+
+
+def _wallclock_finding(mod: Module, node: ast.Call) -> Finding | None:
+    fd = resolved_dotted(mod, node.func)
+    if fd in ("time.time", "time.time_ns", "datetime.datetime.now",
+              "datetime.datetime.utcnow", "datetime.now", "datetime.utcnow",
+              "datetime.datetime.today"):
+        return Finding("det-wallclock", mod.rel, node.lineno,
+                       f"wall-clock `{dotted(node.func)}` in a replay-"
+                       f"relevant module — differs across runs/nodes; use "
+                       f"time.monotonic for intervals or epoch-anchored "
+                       f"stamps for state")
+    return None
+
+
+class _SetVars:
+    """Names / self-attributes assigned a set in this module."""
+
+    def __init__(self, mod: Module):
+        self.names: set[str] = set()
+        for node in ast.walk(mod.tree):
+            value = None
+            targets = []
+            if isinstance(node, ast.Assign):
+                value, targets = node.value, node.targets
+            elif isinstance(node, ast.AnnAssign):
+                value, targets = node.value, [node.target]
+                if node.annotation is not None \
+                        and self._ann_is_set(node.annotation):
+                    self._add(node.target)
+            if value is None:
+                continue
+            if self._is_set_expr(value):
+                for t in targets:
+                    self._add(t)
+
+    _SET_ANN_HEADS = frozenset(("set", "frozenset", "Set", "FrozenSet",
+                                "MutableSet", "AbstractSet"))
+
+    @classmethod
+    def _ann_is_set(cls, node: ast.AST) -> bool:
+        """Exact annotation-head match: `ds: Dataset` must not count
+        just because "set" is a substring of the type name."""
+        if isinstance(node, ast.Subscript):       # set[int], Set[str]
+            return cls._ann_is_set(node.value)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+            return cls._ann_is_set(node.left) or cls._ann_is_set(node.right)
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            head = node.value.split("[", 1)[0].strip()
+            return head.rsplit(".", 1)[-1] in cls._SET_ANN_HEADS
+        d = dotted(node)
+        return d is not None and d.rsplit(".", 1)[-1] in cls._SET_ANN_HEADS
+
+    @staticmethod
+    def _is_set_expr(node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id in ("set", "frozenset"):
+            return True
+        return False
+
+    def _add(self, target: ast.AST) -> None:
+        d = dotted(target)
+        if d is not None:
+            self.names.add(d)
+
+    def is_set(self, node: ast.AST) -> bool:
+        d = dotted(node)
+        return d is not None and d in self.names
+
+
+# wrappers that COPY their input's order rather than fixing it: a set
+# iterated through them is still hash-history-ordered
+_ORDER_COPYING = ("enumerate", "list", "tuple", "zip", "reversed")
+
+
+def _unwrap_iter(it: ast.AST) -> list[ast.AST]:
+    """Peel order-copying wrappers down to the underlying iterable(s);
+    [] means the expression generates its own stable order."""
+    if isinstance(it, ast.Call) and isinstance(it.func, ast.Name):
+        if it.func.id in ("sorted", "range"):
+            return []
+        if it.func.id in _ORDER_COPYING:
+            out: list[ast.AST] = []
+            for a in it.args:
+                out.extend(_unwrap_iter(a))
+            return out
+    return [it]
+
+
+def _body_sink(body: list[ast.stmt]) -> ast.Call | None:
+    """First order-sensitive sink call in a loop body."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                if f.attr in _SEND_SINKS:
+                    return node
+                if f.attr == "append" and "logger" in (dotted(f.value) or ""):
+                    return node
+            if isinstance(f, ast.Name):
+                if f.id in _NAME_SINKS or f.id.startswith("encode_"):
+                    return node
+            d = dotted(f)
+            if d is not None and (d.split(".")[-1] in _NAME_SINKS
+                                  or d.split(".")[-1].startswith("encode_")):
+                return node
+    return None
+
+
+def check(tree: Tree, prefixes=REPLAY_MODULES) -> list[Finding]:
+    findings: list[Finding] = []
+    for m in tree.modules:
+        if not _relevant(m.rel, prefixes):
+            continue
+        setvars = _SetVars(m)
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call):
+                for f in (_rng_finding(m, node), _wallclock_finding(m, node)):
+                    if f is not None:
+                        findings.append(f)
+            elif isinstance(node, ast.For):
+                unordered = None
+                for it in _unwrap_iter(node.iter):
+                    if setvars.is_set(it) or _SetVars._is_set_expr(it):
+                        unordered = "set"
+                    elif isinstance(it, ast.Call) \
+                            and isinstance(it.func, ast.Attribute) \
+                            and it.func.attr in ("items", "values", "keys") \
+                            and setvars.is_set(it.func.value):
+                        unordered = "set"    # set has no .items, but be safe
+                    elif isinstance(it, ast.Call) \
+                            and isinstance(it.func, ast.Attribute) \
+                            and it.func.attr in ("items", "values", "keys"):
+                        unordered = "dict"
+                    if unordered is not None:
+                        break
+                if unordered is None:
+                    continue
+                it = node.iter
+                sink = _body_sink(node.body)
+                if sink is None:
+                    continue
+                what = ast.unparse(it)
+                findings.append(Finding(
+                    "det-unordered-iter", m.rel, node.lineno,
+                    f"iteration over {unordered} `{what}` reaches an "
+                    f"order-sensitive sink (line {sink.lineno}) — {unordered} "
+                    f"order is not replay-stable; wrap in sorted(...)"))
+    return findings

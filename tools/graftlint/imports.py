@@ -1,0 +1,102 @@
+"""imports family: generic import hygiene (the pyflakes slice that
+matters for this repo), so the lint gate has a baseline even on boxes
+where ruff is not installed (ruff.toml carries the same policy for
+boxes that have it).
+
+Rules
+-----
+imp-unused      an imported name is never referenced in the module
+                (module `__init__.py` re-exports and `__all__` entries
+                are exempt; so are conventional side-effect imports).
+imp-redefined   the same name is imported twice in one module.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Finding, Tree
+
+# side-effect / convention imports that are legitimately "unused"
+_SIDE_EFFECT = frozenset(("__future__",))
+
+
+def _used_names(tree: ast.AST) -> set[str]:
+    used: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+    return used
+
+
+def check(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    for m in tree.modules:
+        is_pkg_init = m.rel.endswith("__init__.py")
+        used = _used_names(m.tree)
+        exported: set[str] = set()
+        for node in m.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and t.id == "__all__" \
+                            and isinstance(node.value, (ast.List, ast.Tuple)):
+                        exported |= {e.value for e in node.value.elts
+                                     if isinstance(e, ast.Constant)}
+        # scope-aware walk: function-local lazy imports (this repo's
+        # jax-deferral idiom) are a separate scope from module level —
+        # only a re-import within the SAME scope is a real redefinition
+        for scope_node, imports in _scoped_imports(m.tree):
+            seen: dict[str, int] = {}
+            for node in imports:
+                names = []
+                if isinstance(node, ast.Import):
+                    names = [a.asname or a.name.split(".")[0]
+                             for a in node.names]
+                elif isinstance(node, ast.ImportFrom):
+                    if (node.module or "") in _SIDE_EFFECT:
+                        continue
+                    names = [a.asname or a.name
+                             for a in node.names if a.name != "*"]
+                for local in names:
+                    if local in seen and seen[local] != node.lineno:
+                        findings.append(Finding(
+                            "imp-redefined", m.rel, node.lineno,
+                            f"`{local}` re-imported in the same scope "
+                            f"(first import at line {seen[local]})"))
+                    seen.setdefault(local, node.lineno)
+                    if is_pkg_init or local in exported:
+                        continue      # package re-export surface
+                    if local not in used:
+                        findings.append(Finding(
+                            "imp-unused", m.rel, node.lineno,
+                            f"`{local}` imported but unused"))
+    return findings
+
+
+def _scoped_imports(tree: ast.AST):
+    """[(scope node, [import nodes directly in that scope])] — nested
+    function/class bodies are their own scopes."""
+    out = []
+    stack = [tree]
+    while stack:
+        scope = stack.pop()
+        imports = []
+        inner = [scope]
+        while inner:
+            node = inner.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef)):
+                    stack.append(child)
+                    continue
+                if isinstance(child, (ast.Import, ast.ImportFrom)):
+                    imports.append(child)
+                inner.append(child)
+        out.append((scope, imports))
+    return out

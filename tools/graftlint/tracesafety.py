@@ -1,0 +1,524 @@
+"""trace family: trace-safety inside jit/shard_map-reachable code.
+
+Rules
+-----
+trace-branch          Python `if`/`while`/ternary on a tracer-tainted
+                      value (a data-dependent host branch re-traces or
+                      crashes under jit; use lax.cond/select/where).
+trace-np-call         `np.*` call on a tracer-tainted value (numpy
+                      forces a host sync / concretization under trace).
+trace-host-sync       `.item()`/`.tolist()`/`float()`/`int()`/`bool()`
+                      or `jax.device_get` on a tracer-tainted value.
+trace-unstable-static jit-wrapped function with static argnums/names
+                      called with a freshly-constructed (hash-unstable)
+                      object in a static position — re-traces per call.
+
+Model: jit entry points are functions decorated with `jax.jit` /
+`functools.partial(jax.jit, ...)` / `shard_map`, functions wrapped by a
+direct `jax.jit(f)` call, and functions passed as the body of
+`lax.scan`/`while_loop`/`cond`/`fori_loop` within reachable code.  The
+checker walks the call graph from the entries (bare-name calls,
+module-alias calls like `wire.foo()`, and method calls resolved by
+name — builtin collection/array method names are never resolved).
+Inside a reachable function, taint seeds are the function's parameters
+(minus `self`/`cls`/`cfg`/`config` — config and bound state are static
+under trace in this codebase) and results of `jax.numpy`/`jax.lax`/
+`jax.random` calls; identity tests (`is None`), `isinstance`, `len` and
+shape/dtype attributes are exempt (they are static under trace).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (Finding, Module, Tree, dotted,
+                                  resolved_dotted, walk_funcs)
+
+# jax modules whose call results are tracers under trace
+_TRACED_MODULES = ("jax.numpy", "jax.lax", "jax.random", "jax.nn", "jax.ops")
+_JIT_WRAPPERS = ("jax.jit", "jax.pmap", "jax.experimental.shard_map.shard_map",
+                 "jax.experimental.pjit.pjit", "shard_map")
+# control-flow combinators whose function-valued args are traced bodies
+_BODY_TAKERS = ("jax.lax.scan", "jax.lax.while_loop", "jax.lax.cond",
+                "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+                "jax.vmap", "jax.checkpoint", "jax.remat")
+
+# method names never resolved through the by-name index (builtin
+# collection / ndarray / stdlib methods shared with analyzed classes)
+_METHOD_BLACKLIST = frozenset("""
+append appendleft add extend extendleft insert pop popleft popitem remove
+discard clear update get setdefault keys values items join split rsplit
+strip lstrip rstrip encode read write close flush put get_nowait submit
+result map shutdown sort sorted index count copy format startswith
+endswith replace mark emit incr set arr search match group sum any all
+astype asarray reshape item tobytes tolist min max mean argmax argmin
+take ravel flatten view fill nonzero cumsum dot pack unpack pack_into
+unpack_from send sendv sendv_many recv start stats ping seek tell
+done cancel wait acquire release notify notify_all empty full qsize
+is_alive terminate kill degree lower upper title isdigit
+""".split())
+
+_UNTAINT_PARAMS = frozenset(("self", "cls", "cfg", "config"))
+# attributes that are static under trace even on traced objects: array
+# shape metadata, plus DeviceTable's pytree-aux fields (storage/table.py
+# declares name/capacity/full_row/ring/anchor_rows as static metadata)
+_STATIC_ATTRS = frozenset(("shape", "ndim", "dtype", "size", "nbytes",
+                           "name", "value", "capacity", "full_row",
+                           "ring", "anchor_rows"))
+_HOST_CASTS = frozenset(("float", "int", "bool", "complex"))
+_EXEMPT_CALLS = frozenset(("len", "isinstance", "hasattr", "getattr",
+                           "type", "repr", "str", "print", "id",
+                           "issubclass"))
+
+
+def _is_jit_expr(mod: Module, node: ast.AST) -> bool:
+    """Is this expression `jax.jit` / `partial(jax.jit, ...)` / etc.?"""
+    d = resolved_dotted(mod, node)
+    if d is not None and (d in _JIT_WRAPPERS or d.endswith(".jit")):
+        return True
+    if isinstance(node, ast.Call):
+        fd = resolved_dotted(mod, node.func)
+        if fd in ("functools.partial", "partial") and node.args:
+            return _is_jit_expr(mod, node.args[0])
+    return False
+
+
+def _jit_static_spec(mod: Module, node: ast.AST):
+    """(static_argnums tuple, static_argnames tuple) of a jit wrap
+    expression, or None when it declares no statics."""
+    call = None
+    if isinstance(node, ast.Call):
+        fd = resolved_dotted(mod, node.func)
+        if fd in ("functools.partial", "partial") and node.args \
+                and _is_jit_expr(mod, node.args[0]):
+            call = node
+        elif _is_jit_expr(mod, node.func):
+            call = node
+    if call is None:
+        return None
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.append(n.value)
+        elif kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.append(n.value)
+    return (tuple(nums), tuple(names)) if (nums or names) else None
+
+
+def _find_entries(tree: Tree):
+    """Jit entry FunctionDefs: [(module, def node)], plus jit-wrapped
+    names with static args: {bound name: (nums, names, module)}."""
+    entries: list[tuple[Module, ast.AST]] = []
+    statics: dict[str, list[tuple]] = {}
+    for m in tree.modules:
+        for fn, _cls in walk_funcs(m.tree):
+            for dec in fn.decorator_list:
+                if _is_jit_expr(m, dec):
+                    entries.append((m, fn))
+                    spec = _jit_static_spec(m, dec)
+                    if spec:
+                        statics.setdefault(fn.name, []).append((*spec, m))
+        # direct wraps: x = jax.jit(f, ...) / return jax.jit(f) — resolve
+        # f when it names a def in the same module (incl. methods)
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.Call) and _is_jit_expr(m, node.func)):
+                continue
+            if not node.args:
+                continue
+            target = node.args[0]
+            fname = None
+            if isinstance(target, ast.Name):
+                fname = target.id
+            elif isinstance(target, ast.Attribute):
+                fname = target.attr          # jax.jit(self.step)
+            if fname and fname in tree.funcs:
+                for fm, fdef, _c in tree.funcs[fname]:
+                    entries.append((fm, fdef))
+            spec = _jit_static_spec(m, node)
+            if spec and fname:
+                statics.setdefault(fname, []).append((*spec, m))
+    return entries, statics
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    a = fn.args
+    return [p.arg for p in (*a.posonlyargs, *a.args)]
+
+
+def _call_targets(tree: Tree, m: Module, call: ast.Call):
+    """Resolve a call (and function-valued args of lax combinators) to
+    candidate FunctionDefs in the tree."""
+    out = []
+    func = call.func
+    if isinstance(func, ast.Name):
+        name = func.id
+        if name in tree.mod_funcs.get(m.rel, {}):
+            out.append((m, tree.mod_funcs[m.rel][name]))
+        else:
+            # nested defs in this module, else unique cross-module name
+            local = [(fm, fd) for fm, fd, _c in tree.funcs.get(name, ())
+                     if fm is m]
+            if local:
+                out.extend(local)
+            else:
+                hits = tree.funcs.get(name, ())
+                if len(hits) <= 4:
+                    out.extend((fm, fd) for fm, fd, _c in hits)
+    elif isinstance(func, ast.Attribute):
+        attr = func.attr
+        root = func.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        root_alias = (m.alias_of(root.id)
+                      if isinstance(root, ast.Name) else None)
+        if root_alias is not None:
+            # module-alias call (`wire.encode_epoch_blob(...)`): resolve
+            # inside that module when it is part of the analyzed tree;
+            # deeper chains on library modules (np.random.x) are skipped
+            if isinstance(func.value, ast.Name):
+                rel = root_alias.replace(".", "/") + ".py"
+                tm = tree.module(rel)
+                if tm is not None and attr in tree.mod_funcs.get(tm.rel, {}):
+                    out.append((tm, tree.mod_funcs[tm.rel][attr]))
+        elif attr not in _METHOD_BLACKLIST:
+            # instance method call (incl. `self.pool.refill(...)`):
+            # resolve by method name across the tree
+            hits = tree.funcs.get(attr, ())
+            if 0 < len(hits) <= 10:
+                out.extend((fm, fd) for fm, fd, _c in hits)
+    # lax combinator bodies: function-valued Name args
+    fd = resolved_dotted(m, func)
+    if fd in _BODY_TAKERS or (fd or "").startswith("jax.lax."):
+        for a in call.args:
+            if isinstance(a, ast.Name):
+                local = [(fm, f) for fm, f, _c in tree.funcs.get(a.id, ())
+                         if fm is m]
+                out.extend(local)
+    return out
+
+
+# ---- taint analysis within one function --------------------------------
+
+class _Taint:
+    def __init__(self, mod: Module, seeds: set[str]):
+        self.mod = mod
+        self.names: set[str] = set(seeds)
+
+    def expr(self, node: ast.AST) -> bool:
+        """Is the expression tracer-tainted?"""
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value) or self.expr(node.slice)
+        if isinstance(node, ast.Call):
+            fd = resolved_dotted(self.mod, node.func)
+            if fd in _EXEMPT_CALLS:
+                return False
+            if fd is not None and fd.startswith(_TRACED_MODULES):
+                return True
+            if fd in _HOST_CASTS:
+                return False        # host cast result is concrete
+            return any(self.expr(a) for a in node.args) \
+                or any(self.expr(k.value) for k in node.keywords) \
+                or self.expr(node.func)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False        # identity tests are static
+            if all(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops) \
+                    and isinstance(node.left, ast.Constant) \
+                    and isinstance(node.left.value, str):
+                return False        # string-key membership in a pytree
+                #                     dict is structural, hence static
+            return self.expr(node.left) \
+                or any(self.expr(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.IfExp):
+            return (self.expr(node.test) or self.expr(node.body)
+                    or self.expr(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.expr(v) for v in node.values if v is not None)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp,
+                             ast.DictComp)):
+            return any(self.expr(g.iter) for g in node.generators)
+        return False
+
+    def propagate(self, fn: ast.AST) -> None:
+        """Two forward passes over the statements (enough for the
+        straight-line + simple-loop shapes of jitted code).  Nested def
+        bodies are excluded — they get their own seeded pass."""
+        for _ in range(2):
+            for node in _walk_own(fn):
+                if isinstance(node, ast.Assign):
+                    if self.expr(node.value):
+                        for t in node.targets:
+                            self._mark(t)
+                elif isinstance(node, ast.AnnAssign):
+                    if node.value is not None and self.expr(node.value):
+                        self._mark(node.target)
+                elif isinstance(node, ast.AugAssign):
+                    if self.expr(node.value) or self.expr(node.target):
+                        self._mark(node.target)
+                elif isinstance(node, ast.For):
+                    if self.expr(node.iter):
+                        self._mark(node.target)
+                elif isinstance(node, (ast.withitem,)):
+                    if node.optional_vars is not None \
+                            and self.expr(node.context_expr):
+                        self._mark(node.optional_vars)
+
+    def _mark(self, target: ast.AST) -> None:
+        # taint the assigned container, never subscript INDEX names
+        # (`cols[cn] = traced` taints cols, not cn)
+        if isinstance(target, ast.Name):
+            self.names.add(target.id)
+        elif isinstance(target, (ast.Attribute, ast.Subscript)):
+            self._mark(target.value)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._mark(e)
+        elif isinstance(target, ast.Starred):
+            self._mark(target.value)
+
+
+def _walk_own(fn: ast.AST):
+    """ast.walk over a function's own body, skipping nested defs and
+    lambdas (they are analyzed as their own reachable functions)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _exempt_test(node: ast.AST) -> bool:
+    """Static-under-trace tests: identity compares, isinstance, and
+    boolean combinations thereof."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _exempt_test(node.operand)
+    if isinstance(node, ast.BoolOp):
+        return all(_exempt_test(v) for v in node.values)
+    if isinstance(node, ast.Compare):
+        return all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops)
+    if isinstance(node, ast.Call):
+        return isinstance(node.func, ast.Name) \
+            and node.func.id in ("isinstance", "hasattr", "len")
+    return False
+
+
+def _entry_seeds(fn: ast.AST) -> set[str]:
+    """At a jit entry every parameter is a tracer pytree (minus the
+    conventional static names)."""
+    seeds = set()
+    a = fn.args
+    for p in (*a.posonlyargs, *a.args, *a.kwonlyargs):
+        if p.arg not in _UNTAINT_PARAMS:
+            seeds.add(p.arg)
+    return seeds
+
+
+def _solve_taint(tree: Tree, entries):
+    """Interprocedural taint fixpoint.  Entries seed all params; a
+    callee's parameter is tainted only where some reachable call site
+    passes a tainted expression (so static helpers like
+    `build_incidence(batch, n_buckets, exact)` keep `exact` clean).
+    Nested defs passed to lax combinators seed all params (they ARE the
+    traced body) plus the tainted closure names they reference.
+    Returns {id(fn): (module, fn, seed set)}."""
+    state: dict[int, tuple[Module, ast.AST, set[str]]] = {}
+    work: list[int] = []
+
+    def seed(m, fn, names):
+        key = id(fn)
+        cur = state.get(key)
+        if cur is None:
+            state[key] = (m, fn, set(names))
+            work.append(key)
+        elif not set(names) <= cur[2]:
+            cur[2].update(names)
+            work.append(key)
+
+    for m, fn in entries:
+        seed(m, fn, _entry_seeds(fn))
+    rounds = 0
+    while work and rounds < 20000:
+        rounds += 1
+        key = work.pop()
+        m, fn, seeds = state[key]
+        t = _Taint(m, seeds)
+        t.propagate(fn)
+        free_taint = set(t.names)
+        for node in _walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            fd = resolved_dotted(m, node.func)
+            body_taker = fd in _BODY_TAKERS or (fd or "").startswith(
+                "jax.lax.")
+            for tm, tfn in _call_targets(tree, m, node):
+                if body_taker and tm is m and _is_local_arg(node, tfn):
+                    # traced body: all params are tracers, plus tainted
+                    # closure names it references
+                    names = set(_param_names(tfn))
+                    names |= {n for n in free_taint
+                              if _references(tfn, n)}
+                    seed(tm, tfn, names)
+                    continue
+                params = _param_names(tfn)
+                is_method = bool(params) and params[0] in ("self", "cls") \
+                    and isinstance(node.func, ast.Attribute)
+                if is_method:
+                    params = params[1:]
+                names = set()
+                for i, a in enumerate(node.args):
+                    if isinstance(a, ast.Starred):
+                        break
+                    if i < len(params) and t.expr(a) \
+                            and params[i] not in _UNTAINT_PARAMS:
+                        names.add(params[i])
+                for kw in node.keywords:
+                    if kw.arg and kw.arg in params and t.expr(kw.value) \
+                            and kw.arg not in _UNTAINT_PARAMS:
+                        names.add(kw.arg)
+                seed(tm, tfn, names)
+    return state
+
+
+def _is_local_arg(call: ast.Call, fn: ast.AST) -> bool:
+    return any(isinstance(a, ast.Name) and a.id == fn.name
+               for a in call.args)
+
+
+def _references(fn: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(fn))
+
+
+def check(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    entries, statics = _find_entries(tree)
+    for m, fn, seeds in _solve_taint(tree, entries).values():
+        t = _Taint(m, seeds)
+        t.propagate(fn)
+        for node in _walk_own(fn):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                if t.expr(node.test) and not _exempt_test(node.test):
+                    findings.append(Finding(
+                        "trace-branch", m.rel, node.lineno,
+                        f"Python-level branch on a traced value inside "
+                        f"jit-reachable `{fn.name}` — use jnp.where/"
+                        f"lax.cond or hoist the decision to the host"))
+            elif isinstance(node, ast.Call):
+                fd = resolved_dotted(m, node.func)
+                arg_tainted = any(t.expr(a) for a in node.args) or any(
+                    t.expr(k.value) for k in node.keywords)
+                if fd is not None and fd.startswith("numpy.") \
+                        and arg_tainted:
+                    rule = ("trace-host-sync"
+                            if fd in ("numpy.asarray", "numpy.array")
+                            else "trace-np-call")
+                    findings.append(Finding(
+                        rule, m.rel, node.lineno,
+                        f"`{dotted(node.func)}` on a traced value inside "
+                        f"jit-reachable `{fn.name}` (host sync / "
+                        f"concretization under trace) — use jnp"))
+                elif fd in _HOST_CASTS and arg_tainted:
+                    findings.append(Finding(
+                        "trace-host-sync", m.rel, node.lineno,
+                        f"`{fd}()` on a traced value inside jit-reachable "
+                        f"`{fn.name}` forces a host sync (ConcretizationError "
+                        f"under jit)"))
+                elif fd == "jax.device_get" and arg_tainted:
+                    findings.append(Finding(
+                        "trace-host-sync", m.rel, node.lineno,
+                        f"jax.device_get inside jit-reachable `{fn.name}`"))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("item", "tolist") \
+                        and t.expr(node.func.value):
+                    findings.append(Finding(
+                        "trace-host-sync", m.rel, node.lineno,
+                        f"`.{node.func.attr}()` on a traced value inside "
+                        f"jit-reachable `{fn.name}` forces a host sync"))
+    findings += _check_static_args(tree, statics)
+    return findings
+
+
+def _static_spec_for(m: Module, node: ast.Call, name: str, specs: list):
+    """The (nums, names) spec whose defining module this call site can
+    actually reach: same module, a from-import of the name, or a
+    module-alias attribute call.  None for a mere bare-name collision
+    with an unrelated same-named function elsewhere in the tree."""
+    for nums, names, dm in specs:
+        if m is dm:
+            return nums, names
+        dmod = dm.rel[:-3].replace("/", ".")
+        if isinstance(node.func, ast.Name) \
+                and m.alias_of(name) == f"{dmod}.{name}":
+            return nums, names
+        if isinstance(node.func, ast.Attribute) \
+                and resolved_dotted(m, node.func) == f"{dmod}.{name}":
+            return nums, names
+    return None
+
+
+def _check_static_args(tree: Tree, statics: dict) -> list[Finding]:
+    """trace-unstable-static: call sites of jit functions with declared
+    static argnums/argnames passing freshly-constructed objects there."""
+    findings = []
+    unstable = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                ast.SetComp, ast.GeneratorExp, ast.Lambda)
+    for m in tree.modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = None
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            if name not in statics:
+                continue
+            spec = _static_spec_for(m, node, name, statics[name])
+            if spec is None:
+                continue
+            nums, names = spec
+            bad: list[tuple[int, str]] = []
+            for i in nums:
+                if i < len(node.args) and isinstance(node.args[i], unstable):
+                    bad.append((node.args[i].lineno, f"position {i}"))
+            for kw in node.keywords:
+                if kw.arg in names and isinstance(kw.value, unstable):
+                    bad.append((kw.value.lineno, f"argname {kw.arg!r}"))
+            # constructor calls in static positions (dict()/list()/set())
+            for i in nums:
+                if i < len(node.args) and isinstance(node.args[i], ast.Call):
+                    f = node.args[i].func
+                    if isinstance(f, ast.Name) and f.id in ("dict", "list",
+                                                            "set"):
+                        bad.append((node.args[i].lineno, f"position {i}"))
+            for line, where in bad:
+                findings.append(Finding(
+                    "trace-unstable-static", m.rel, line,
+                    f"hash-unstable object in static arg {where} of "
+                    f"jitted `{name}` — a fresh object per call re-traces "
+                    f"every epoch (pass a hashable constant)"))
+    return findings

@@ -1,0 +1,145 @@
+"""Declared wire-protocol model: one row per rtype.
+
+This is the machine-checked version of the protocol facts that so far
+lived in comments (native.py's registry, wire.py's codec docstrings,
+PR 4's "rtypes 15-17 outside the fault mask" rule).  `wireproto.check`
+cross-checks it against the actual ASTs; `tests/test_wire_registry.py`
+turns the codec half into an executable round-trip contract.
+
+Fields
+------
+codec_encode / codec_decode
+    Function names (in CODEC_MODULES) that produce / consume this
+    rtype's payload.  Empty tuples = payload-free or native-level.
+routes
+    Qualified handler functions that must contain an explicit branch on
+    the rtype name (string compare), i.e. who consumes it at the Python
+    level.  "native" = handled inside the C transport (PING/PONG).
+fault_mask
+    EXPLICIT in/out classification against native.FAULT_RTYPE_MASK.
+    Only the client<->server open-loop traffic is fault-eligible: it
+    has an end-to-end retry story (resend + idempotent admission).
+    Everything else is commit protocol / control plane — its fault mode
+    is process death, not silent loss.
+note
+    Why the rtype is classified the way it is (shown in findings).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+# modules (repo-relative) that may define wire codecs
+CODEC_MODULES = (
+    "deneva_tpu/runtime/wire.py",
+    "deneva_tpu/runtime/membership.py",
+    "deneva_tpu/runtime/logger.py",
+)
+
+# handler qualname -> (module, function name) to scan for route branches
+ROUTE_FUNCS = {
+    "ServerNode._route": ("deneva_tpu/runtime/server.py", "_route"),
+    "ClientNode._route": ("deneva_tpu/runtime/client.py", "_route"),
+    "ReplicaNode._handle": ("deneva_tpu/runtime/replica.py", "_handle"),
+    "wire.run_barrier": ("deneva_tpu/runtime/wire.py", "run_barrier"),
+}
+
+REGISTRY_MODULE = "deneva_tpu/runtime/native.py"
+
+
+@dataclass(frozen=True)
+class RtypeSpec:
+    name: str
+    fault_mask: bool
+    codec_encode: tuple = ()
+    codec_decode: tuple = ()
+    routes: tuple = ()
+    note: str = ""
+
+
+def _s(name, fault_mask, enc=(), dec=(), routes=(), note=""):
+    return RtypeSpec(name, fault_mask, tuple(enc), tuple(dec),
+                     tuple(routes), note)
+
+
+WIRE_MODEL: dict[str, RtypeSpec] = {s.name: s for s in (
+    _s("INIT_DONE", False, routes=("wire.run_barrier",),
+       note="payload-free setup barrier; precedes any traffic worth "
+            "faulting, and barrier loss would wedge every node"),
+    _s("CL_QRY_BATCH", True,
+       enc=("encode_qry_block", "qry_block_parts"),
+       dec=("decode_qry_block",),
+       routes=("ServerNode._route",),
+       note="open-loop client traffic: client resend + server idempotent "
+            "admission give it exactly-once under loss"),
+    _s("CL_RSP", True,
+       enc=("encode_cl_rsp", "cl_rsp_parts"),
+       dec=("decode_cl_rsp",),
+       routes=("ClientNode._route",),
+       note="open-loop ack: a lost ack is repaired by resend + re-ack"),
+    _s("RDONE", False,
+       note="reserved: EPOCH_BLOB doubles as the RDONE barrier (exactly "
+            "one blob per (server, epoch)); never sent on its own"),
+    _s("EPOCH_BLOB", False,
+       enc=("encode_epoch_blob", "epoch_blob_parts"),
+       dec=("decode_epoch_blob", "decode_epoch_blob_into",
+            "peek_blob_epoch"),
+       routes=("ServerNode._route",),
+       note="the commit protocol itself: dropping a blob models a dead "
+            "link, which IS the kill/failover scenario"),
+    _s("LOG_MSG", False,
+       enc=("pack_record", "pack_record_views"),
+       dec=("unpack_records", "iter_record_spans"),
+       routes=("ReplicaNode._handle",),
+       note="durability stream: replica logs must stay byte prefixes of "
+            "the primary's — loss would silently void the ack gate"),
+    _s("LOG_RSP", False,
+       enc=("encode_shutdown",), dec=("decode_shutdown",),
+       routes=("ServerNode._route",),
+       note="replica durability ack (epoch watermark); group commit "
+            "gates on it"),
+    _s("PING", False, routes=("native",),
+       note="transport-level RTT probe, answered inside the C layer"),
+    _s("PONG", False, routes=("native",),
+       note="transport-level RTT echo, consumed inside the C layer"),
+    _s("SHUTDOWN", False,
+       enc=("encode_shutdown",), dec=("decode_shutdown",),
+       routes=("ServerNode._route", "ClientNode._route",
+               "ReplicaNode._handle"),
+       note="stop-epoch announcement: control plane, loss would hang "
+            "the run"),
+    _s("MEASURE", False,
+       enc=("encode_shutdown",), dec=("decode_shutdown",),
+       routes=("ServerNode._route",),
+       note="measurement-window boundary announcement (epoch-aligned "
+            "snapshot agreement)"),
+    _s("VOTE", False,
+       enc=("encode_vote",), dec=("decode_vote",),
+       routes=("ServerNode._route",),
+       note="batched 2PC prepare round: the commit protocol"),
+    _s("VOTE2", False,
+       enc=("encode_vote",), dec=("decode_vote",),
+       routes=("ServerNode._route",),
+       note="MAAT position-verify round: the commit protocol"),
+    _s("REJOIN", False,
+       enc=("encode_shutdown",), dec=("decode_shutdown",),
+       routes=("ServerNode._route", "ReplicaNode._handle"),
+       note="crash-recovery handshake (resume epoch); failover control "
+            "plane"),
+    _s("MIGRATE_BEGIN", False,
+       enc=("encode_map_msg",), dec=("decode_map_msg",),
+       routes=("ServerNode._route",),
+       note="rebalance announcement (PR 4): control plane, outside the "
+            "fault mask by design — its fault mode is process death"),
+    _s("MIGRATE_ROWS", False,
+       enc=("encode_migrate_rows",),
+       dec=("decode_migrate_rows", "peek_rows_version"),
+       routes=("ServerNode._route",),
+       note="row migration stream: control plane, like the epoch "
+            "exchange (the PR 4 'rtypes 15-17 outside the mask' rule)"),
+    _s("MAP_UPDATE", False,
+       enc=("encode_map_msg",), dec=("decode_map_msg",),
+       routes=("ServerNode._route", "ClientNode._route"),
+       note="client map install / redirect NACK: loss self-heals via "
+            "the resend sweep's retargeting, but it is control plane"),
+)}

@@ -1,0 +1,183 @@
+"""wire family: rtype registry <-> codecs <-> route branches <-> fault
+mask, all cross-checked against the declared model (`wiremodel.py`).
+
+Rules
+-----
+wire-registry-drift  RTYPE registry (native.py) and WIRE_MODEL disagree
+                     (an rtype exists on one side only).
+wire-missing-codec   a declared encode/decode function does not exist in
+                     the codec modules.
+wire-missing-route   a handler that the model says consumes an rtype has
+                     no `== "NAME"` branch for it.
+wire-fault-mask      FAULT_RTYPE_MASK (native.py) disagrees with the
+                     model's explicit in/out classification.
+wire-unknown-rtype   a transport send/recv-compare uses an rtype string
+                     that is not in the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Finding, Module, Tree, walk_funcs
+from tools.graftlint.wiremodel import (CODEC_MODULES, REGISTRY_MODULE,
+                                       ROUTE_FUNCS, WIRE_MODEL)
+
+_SEND_NAMES = frozenset(("send", "sendv", "sendv_many"))
+
+
+def parse_registry(mod: Module) -> tuple[dict[str, int], set[str], int]:
+    """(RTYPE dict, names referenced by FAULT_RTYPE_MASK, mask line)."""
+    rtypes: dict[str, int] = {}
+    mask_names: set[str] = set()
+    mask_line = 1
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "RTYPE" in names and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and isinstance(v, ast.Constant):
+                    rtypes[k.value] = v.value
+        if "FAULT_RTYPE_MASK" in names:
+            mask_line = node.lineno
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Subscript) \
+                        and isinstance(n.value, ast.Name) \
+                        and n.value.id == "RTYPE" \
+                        and isinstance(n.slice, ast.Constant):
+                    mask_names.add(n.slice.value)
+    return rtypes, mask_names, mask_line
+
+
+def _is_rtype_expr(node: ast.AST) -> bool:
+    """The two branch idioms the handlers use: a name literally called
+    `rtype`, or a message-tuple subscript (`m[1] == "INIT_DONE"` in
+    run_barrier).  A compare against any other name (`reason == ...`)
+    does NOT count as routing the rtype."""
+    return (isinstance(node, ast.Name) and node.id == "rtype") \
+        or isinstance(node, ast.Subscript)
+
+
+def _rtype_branch_consts(mod: Module, fn_name: str) -> list[tuple[str, int]]:
+    """(string const, line) of == compares against an rtype expression
+    inside a function (see `_is_rtype_expr`)."""
+    out: list[tuple[str, int]] = []
+    for fn, _cls in walk_funcs(mod.tree):
+        if fn.name != fn_name:
+            continue
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Compare)
+                    and any(isinstance(op, ast.Eq) for op in node.ops)):
+                continue
+            sides = (node.left, *node.comparators)
+            if not any(_is_rtype_expr(s) for s in sides):
+                continue
+            for c in sides:
+                if isinstance(c, ast.Constant) and isinstance(c.value, str):
+                    out.append((c.value, node.lineno))
+    return out
+
+
+def check(tree: Tree, model=WIRE_MODEL, registry_module=REGISTRY_MODULE,
+          codec_modules=CODEC_MODULES, route_funcs=ROUTE_FUNCS
+          ) -> list[Finding]:
+    reg_mod = tree.module(registry_module)
+    if reg_mod is None:
+        return []        # fixture tree without the runtime: nothing to do
+    findings: list[Finding] = []
+    rtypes, mask_names, mask_line = parse_registry(reg_mod)
+
+    # 1. registry <-> model drift
+    for name in sorted(set(rtypes) - set(model)):
+        findings.append(Finding(
+            "wire-registry-drift", reg_mod.rel, mask_line,
+            f"rtype {name!r} is registered but has no WIRE_MODEL row — "
+            f"declare its codecs, routes and fault-mask classification"))
+    for name in sorted(set(model) - set(rtypes)):
+        findings.append(Finding(
+            "wire-registry-drift", reg_mod.rel, mask_line,
+            f"WIRE_MODEL declares {name!r} but the RTYPE registry does "
+            f"not register it"))
+
+    # 2. declared codecs exist
+    codec_defs: set[str] = set()
+    for rel in codec_modules:
+        m = tree.module(rel)
+        if m is not None:
+            codec_defs |= set(tree.mod_funcs.get(m.rel, {}))
+    for spec in model.values():
+        for fn in (*spec.codec_encode, *spec.codec_decode):
+            if fn not in codec_defs:
+                findings.append(Finding(
+                    "wire-missing-codec", registry_module, mask_line,
+                    f"rtype {spec.name!r}: declared codec `{fn}` not "
+                    f"found in {', '.join(codec_modules)}"))
+
+    # 3. route branches exist
+    for spec in model.values():
+        for route in spec.routes:
+            if route == "native":
+                continue
+            loc = route_funcs.get(route)
+            if loc is None:
+                findings.append(Finding(
+                    "wire-missing-route", registry_module, mask_line,
+                    f"rtype {spec.name!r}: route {route!r} is not a "
+                    f"known handler (wiremodel.ROUTE_FUNCS)"))
+                continue
+            rel, fn_name = loc
+            m = tree.module(rel)
+            if m is None:
+                continue             # partial tree (fixtures)
+            branch_names = {n for n, _ in _rtype_branch_consts(m, fn_name)}
+            if spec.name not in branch_names:
+                findings.append(Finding(
+                    "wire-missing-route", rel, 1,
+                    f"handler {route} has no branch for rtype "
+                    f"{spec.name!r} (model says it consumes it)"))
+
+    # 4. fault-mask classification
+    declared_in = {s.name for s in model.values() if s.fault_mask}
+    for name in sorted(mask_names - declared_in):
+        findings.append(Finding(
+            "wire-fault-mask", reg_mod.rel, mask_line,
+            f"rtype {name!r} is IN FAULT_RTYPE_MASK but the model "
+            f"classifies it outside (note: "
+            f"{model.get(name).note if name in model else 'unmodeled'})"))
+    for name in sorted(declared_in - mask_names):
+        findings.append(Finding(
+            "wire-fault-mask", reg_mod.rel, mask_line,
+            f"rtype {name!r} is fault-eligible per the model but missing "
+            f"from FAULT_RTYPE_MASK"))
+
+    # 5. every literal rtype used in send/compare is registered
+    known = set(rtypes)
+    # 5a. route branches must compare only registered names: a typo'd
+    # `rtype == "SHUTDWN"` branch is silently dead — the worst case
+    for route, (rel, fn_name) in route_funcs.items():
+        m = tree.module(rel)
+        if m is None:
+            continue
+        for name, line in _rtype_branch_consts(m, fn_name):
+            if name not in known:
+                findings.append(Finding(
+                    "wire-unknown-rtype", rel, line,
+                    f"handler {route} branches on unregistered rtype "
+                    f"{name!r} — the branch can never fire"))
+    for m in tree.modules:
+        if not m.rel.startswith("deneva_tpu/"):
+            continue
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SEND_NAMES \
+                    and len(node.args) >= 2 \
+                    and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str) \
+                    and node.args[1].value not in known:
+                findings.append(Finding(
+                    "wire-unknown-rtype", m.rel, node.lineno,
+                    f"send of unregistered rtype "
+                    f"{node.args[1].value!r}"))
+    return findings

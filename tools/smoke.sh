@@ -9,6 +9,10 @@
 #   tools/smoke.sh elastic                membership gate: elastic-grow /
 #                                         elastic-drain / elastic-kill-reassign
 #                                         (liveness + exactly-once invariants)
+#   tools/smoke.sh lint                   static-analysis gate: graftlint
+#                                         (trace/det/wire/own/imports families)
+#                                         + ruff (pyflakes slice, when
+#                                         installed) over deneva_tpu/ + tools/
 #
 # Timeout: SMOKE_TIMEOUT_SECS overrides for any scenario; the legacy
 # per-gate envs (CHAOS_TIMEOUT_SECS, ESCROW_TIMEOUT_SECS,
@@ -47,8 +51,21 @@ case "$SCEN" in
     T="${SMOKE_TIMEOUT_SECS:-${ELASTIC_TIMEOUT_SECS:-600}}"
     run "$T" python -m deneva_tpu.harness.chaos elastic --quick
     ;;
+  lint)
+    # static gate; budget 30 s total on the 2-core CI box (graftlint
+    # measures ~2.5 s over the 70-file tree, ruff sub-second)
+    T="${SMOKE_TIMEOUT_SECS:-${LINT_TIMEOUT_SECS:-30}}"
+    run "$T" python -m tools.graftlint deneva_tpu/ tools/
+    if command -v ruff >/dev/null 2>&1; then
+        # generic pyflakes + import-hygiene baseline (ruff.toml); boxes
+        # without ruff still get graftlint's imports family
+        run "$T" ruff check deneva_tpu tools tests
+    else
+        echo "[lint] ruff not installed; graftlint imports family stands in"
+    fi
+    ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|lint> [args...]" >&2
     exit 2
     ;;
 esac
