@@ -395,6 +395,52 @@ class Config:
     #                                margin discipline as the measurement
     #                                window announcement)
 
+    # ---- geo-replication tier (region-aware slot map, quorum group-
+    # commit, follower snapshot reads; runtime/replication.py).  All
+    # defaults OFF: with geo=False every path takes the pre-geo code
+    # exactly (same wire bytes, logs, replica stream, acks). ----
+    geo: bool = False              # arm the geo tier.  Requires elastic
+    #                                (full-residency tables are what let a
+    #                                follower materialize every row from
+    #                                the merged log stream) + logging +
+    #                                replica_cnt >= 1.  Replicas become
+    #                                FOLLOWERS: they replay the merged
+    #                                command stream group-by-group and
+    #                                serve REGION_READ snapshot reads at
+    #                                the last applied group boundary; the
+    #                                primary's group commit gates on a
+    #                                QUORUM of LOG_ACKs instead of all
+    #                                replicas.  In geo mode fault_kill
+    #                                "n:e" means REGION LOSS: server n
+    #                                dies at epoch e AND every replica
+    #                                homed in n's region dies at its own
+    #                                first record >= e.
+    geo_region_cnt: int = 1        # regions; servers map block-wise
+    #                                (s * R // node_cnt), clients likewise,
+    #                                and replica k of primary p lands in
+    #                                region (region(p) + 1 + k) % R — a
+    #                                primary's replicas always live in
+    #                                OTHER regions, so region loss never
+    #                                takes a primary and all its replicas
+    #                                together (runtime/replication.py
+    #                                region_of).
+    geo_quorum: int = 0            # replica acks a group boundary needs
+    #                                before its CL_RSPs release.  0 = all
+    #                                replica_cnt (the pre-geo gate); q <
+    #                                replica_cnt tolerates slow/dead
+    #                                replicas at the cost of a thinner
+    #                                durability margin.
+    geo_wan_us: str = ""           # WAN latency profile: "0-1:20000"
+    #                                (symmetric) and/or "0>1:5000"
+    #                                (directed) comma-separated region-
+    #                                pair one-way delays in us, applied
+    #                                per-link via dt_set_peer_delay_us at
+    #                                node start.
+    geo_read_perc: float = 0.0     # target fraction of client traffic
+    #                                issued as follower snapshot reads
+    #                                (REGION_READ to the nearest live
+    #                                follower); 0 disables the read path.
+
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
     checkpoint_path: str = ""      # "" = checkpointing off
@@ -431,6 +477,33 @@ class Config:
             return None
         node, epoch = self.fault_kill.split(":")
         return int(node), int(epoch)
+
+    def geo_wan_spec(self) -> dict[tuple[int, int], int]:
+        """Parse geo_wan_us into a directed {(region_a, region_b): us}
+        matrix.  "A-B:us" sets both directions, "A>B:us" one; later
+        entries override earlier ones."""
+        out: dict[tuple[int, int], int] = {}
+        if not self.geo_wan_us:
+            return out
+        for ent in self.geo_wan_us.split(","):
+            ent = ent.strip()
+            sep = ">" if ">" in ent else "-"
+            try:
+                pair, us = ent.split(":")
+                a, b = (int(x) for x in pair.split(sep))
+                us = int(us)
+            except ValueError:
+                raise ValueError(
+                    f"config: geo_wan_us entry {ent!r} must be "
+                    "'A-B:us' (symmetric) or 'A>B:us' (directed)")
+            _check(0 <= a < self.geo_region_cnt
+                   and 0 <= b < self.geo_region_cnt and us >= 0,
+                   f"geo_wan_us entry {ent!r}: regions must be in "
+                   f"[0, {self.geo_region_cnt}) and delay >= 0")
+            out[(a, b)] = us
+            if sep == "-":
+                out[(b, a)] = us
+        return out
 
     def elastic_plan_spec(self) -> tuple[str, int, int] | None:
         """Parse elastic_plan 'grow|drain:node:epoch' (None when unset)."""
@@ -618,6 +691,34 @@ class Config:
             _check(0 <= int(parts[1]) < self.node_cnt,
                    "elastic_plan node must name a server node")
             _check(int(parts[2]) >= 0, "elastic_plan epoch must be >= 0")
+        if self.geo:
+            _check(self.elastic,
+                   "geo needs --elastic=true: followers materialize every "
+                   "row from the merged log stream, which requires the "
+                   "full-residency elastic tables")
+            _check(self.logging and self.replica_cnt >= 1,
+                   "geo needs --logging and replica_cnt >= 1 (quorum "
+                   "group-commit and follower reads ride the replica "
+                   "LOG_MSG stream)")
+            _check(1 <= self.geo_region_cnt <= self.node_cnt,
+                   "geo_region_cnt must be in [1, node_cnt]")
+            _check(0 <= self.geo_quorum <= self.replica_cnt,
+                   "geo_quorum must be in [0, replica_cnt] (0 = all)")
+            _check(0.0 <= self.geo_read_perc < 1.0,
+                   "geo_read_perc must be in [0, 1)")
+            _check(not self.sim_full_row,
+                   "geo follower reads serve fingerprint values; "
+                   "sim_full_row payload serving is not wired yet")
+            _check(self.workload == WorkloadKind.YCSB,
+                   "geo is YCSB-scoped for now (the follower replay "
+                   "state machine and snapshot serving are built over "
+                   "the YCSB full-residency table)")
+            self.geo_wan_spec()   # raises on a malformed profile
+        else:
+            _check(self.geo_region_cnt == 1 and self.geo_quorum == 0
+                   and not self.geo_wan_us and self.geo_read_perc == 0.0,
+                   "geo_region_cnt/geo_quorum/geo_wan_us/geo_read_perc "
+                   "need --geo=true")
         if self.elastic and self.fault_kill:
             # failover-with-reassignment: survivors absorb the dead
             # node's slots by log replay — never restart it

@@ -37,9 +37,35 @@ to all three):
                    for its restart; liveness + exactly-once across the
                    takeover.
 
+Geo-replication scenarios (runtime/replication.py; `geo` expands to all
+three — the tools/smoke.sh ``geo`` gate):
+
+* **geo-region-loss**  3 regions x 1 server, a replica per primary
+                   homed one region over; fault_kill under geo kills
+                   region 2's WHOLE process set (server 2 + the replica
+                   homed there).  Survivors must promote (slot takeover
+                   by log replay), commits must continue, exactly-once
+                   must hold, and follower snapshot reads must keep
+                   serving consistent epoch-boundary snapshots across
+                   the loss (per-response version-stamp check + an
+                   independent replay of a surviving follower's log
+                   reproducing its state digest bit for bit).
+* **geo-asymmetric-wan**  2 regions with asymmetric per-link WAN delays
+                   (dt_set_peer_delay_us); the epoch exchange and the
+                   quorum ack stream must stay live and exactly-once,
+                   follower reads keep their consistency contract.
+* **geo-replica-lag**  a symmetric 40 ms WAN between the primary's and
+                   the follower's regions: quorum acks lag (visible as
+                   quorum_stall_ms > 0) and the follower trails, but
+                   the shutdown catch-up must converge the follower to
+                   the full logged stream (applied == last epoch) with
+                   its digest again bit-identical to independent
+                   replay.
+
 Every scenario runs from a fixed fault_seed, so failures reproduce.
 
-CLI:  python -m deneva_tpu.harness.chaos [scenario ...|all|elastic] [--quick]
+CLI:  python -m deneva_tpu.harness.chaos [scenario ...|all|elastic|geo]
+                                         [--quick]
 """
 
 from __future__ import annotations
@@ -90,12 +116,39 @@ SCENARIOS: dict[str, dict] = {
         node_cnt=3, epoch_batch=256, elastic=True, fault_kill="2:64",
         logging=True, done_secs=8.0, log_dir="/dev/shm/deneva_logs",
         fault_recovery_timeout_s=300.0),
+    # geo-replication tier (log dirs on /dev/shm: replicas fsync every
+    # record).  Windows stay FULL under --quick like the elastic family:
+    # the region-loss promote/replay stall measured 4-5 s on the 2-core
+    # CI box and a WAN-stretched epoch cadence needs its whole window —
+    # clamping either reports zero commits (the PR 4 flake class).
+    # two clients so region 1 has a HOME client targeting primary 1 —
+    # the primary whose only follower dies with region 2.  Its held
+    # acks must keep releasing across the loss (the durable_quorum
+    # live-set degradation; a frozen horizon wedges exactly this
+    # client's inflight credit and the scenario reports zero commits)
+    "geo-region-loss": dict(
+        node_cnt=3, client_node_cnt=2, epoch_batch=256, elastic=True,
+        geo=True, geo_region_cnt=3, geo_quorum=1, geo_read_perc=0.1,
+        replica_cnt=1, logging=True, fault_kill="2:64", done_secs=10.0,
+        log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
+    "geo-asymmetric-wan": dict(
+        node_cnt=2, epoch_batch=256, elastic=True, geo=True,
+        geo_region_cnt=2, geo_quorum=1, geo_read_perc=0.15,
+        geo_wan_us="0>1:8000,1>0:30000", replica_cnt=1, logging=True,
+        done_secs=4.0, log_dir="/dev/shm/deneva_logs"),
+    "geo-replica-lag": dict(
+        node_cnt=2, epoch_batch=256, elastic=True, geo=True,
+        geo_region_cnt=2, geo_quorum=1, geo_read_perc=0.15,
+        geo_wan_us="0-1:40000", replica_cnt=1, logging=True,
+        done_secs=5.0, log_dir="/dev/shm/deneva_logs"),
 }
 
 # `elastic` on the CLI expands to the three membership scenarios (the
-# tools/smoke.sh elastic gate)
+# tools/smoke.sh elastic gate); `geo` to the geo-replication trio
 ELASTIC_SCENARIOS = ("elastic-grow", "elastic-drain",
                      "elastic-kill-reassign")
+GEO_SCENARIOS = ("geo-region-loss", "geo-asymmetric-wan",
+                 "geo-replica-lag")
 
 
 class ChaosViolation(AssertionError):
@@ -118,7 +171,7 @@ def run_scenario(name: str, quick: bool = False,
         raise KeyError(f"unknown scenario {name!r} "
                        f"(have {sorted(SCENARIOS)})")
     spec = dict(SCENARIOS[name])
-    if quick and not name.startswith("elastic-"):
+    if quick and not name.startswith(("elastic-", "geo-")):
         # elastic scenarios keep their full window: the cutover stall
         # (row stream + boundary sync, 1.4-2.2 s measured on the CI box;
         # ~5 s replay-jit for kill-reassign) would otherwise swallow a
@@ -187,6 +240,8 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
         _check_recovery(cfg, out, run_id, report)
     if name.startswith("elastic-"):
         _check_elastic(name, cfg, out, report)
+    if name.startswith("geo-"):
+        _check_geo(name, cfg, out, run_id, report)
 
 
 def _check_elastic(name: str, cfg: Config, out: dict, report: dict) -> None:
@@ -244,6 +299,104 @@ def _check_elastic(name: str, cfg: Config, out: dict, report: dict) -> None:
         _require(all(v.get("rows_migrated_in", 0) > 0
                      for v in srv.values()),
                  f"{name}: a survivor rebuilt no rows by replay")
+
+
+def _check_geo(name: str, cfg: Config, out: dict, run_id: str,
+               report: dict) -> None:
+    """Geo-tier invariants: follower snapshot reads really served with
+    their consistency contract intact (per-response version-stamp and
+    boundary-monotonicity checks report zero violations), a surviving
+    follower's state is BIT-IDENTICAL to an independent replay of its
+    own log (snapshot-consistency oracle), quorum accounting is present
+    on every primary, and the per-scenario shape (promotion after a
+    region loss, convergent catch-up under replica lag) holds."""
+    from deneva_tpu.runtime import replication as georepl
+
+    n_srv, n_cl = cfg.node_cnt, cfg.client_node_cnt
+    base = n_srv + n_cl
+    srv = {s: parse_summary(out[s][1]) for s in range(n_srv)
+           if out[s][0] == "server"}
+    cls = [parse_summary(out[n_srv + c][1]) for c in range(n_cl)]
+    repl = {r: parse_summary(out[base + r][1])
+            for r in range(cfg.replica_cnt * n_srv)
+            if out[base + r][0] == "replica"}
+    # follower reads: issued, answered, and clean on both client-side
+    # consistency checks
+    reads = sum(c.get("follower_read_cnt", 0.0) for c in cls)
+    report["follower_reads"] = reads
+    _require(reads > 0, f"{name}: no follower snapshot read was served")
+    _require(sum(f.get("follower_read_cnt", 0.0)
+                 for f in repl.values()) > 0,
+             f"{name}: no follower reports serving reads")
+    for c in cls:
+        _require(c.get("follower_read_ver_viol", 0.0) == 0,
+                 f"{name}: a follower served a row version newer than "
+                 "its snapshot boundary")
+        _require(c.get("follower_read_mono_viol", 0.0) == 0,
+                 f"{name}: a follower's served boundary regressed")
+    # every reporting primary carries the quorum ledger
+    for s, v in srv.items():
+        _require("quorum_stall_ms" in v and "quorum_acked_epoch" in v,
+                 f"{name}: server {s} summary lacks quorum accounting")
+    # snapshot consistency: an independent full-ownership replay of a
+    # surviving follower's own log must reproduce its state digest bit
+    # for bit at the same applied epoch
+    log_dir = os.path.join(cfg.log_dir, run_id)
+    rid_rel = sorted(repl)[0]
+    side_path = os.path.join(log_dir,
+                             f"replica{base + rid_rel}.follower.json")
+    _require(os.path.exists(side_path),
+             f"{name}: follower sidecar missing at {side_path}")
+    with open(side_path) as f:
+        side = json.load(f)
+    report["follower_applied"] = side["applied_epoch"]
+    from deneva_tpu.runtime.logger import replay_into, state_digest
+    node_cfg = cfg.replace(node_id=side["primary"], part_cnt=n_srv,
+                           recover=False, fault_kill="")
+    _, wl, step, db, cc0, stats0 = georepl.follower_boot(
+        node_cfg, side["primary"])
+    db, _, _, last = replay_into(
+        os.path.join(log_dir, f"replica{base + rid_rel}.log.bin"),
+        node_cfg, wl, step, db, cc0, stats0,
+        stop_epoch=side["applied_epoch"] + 1)
+    _require(last == side["applied_epoch"],
+             f"{name}: follower log replay ended at {last}, follower "
+             f"applied {side['applied_epoch']}")
+    digest = state_digest(db)
+    report["follower_digest_match"] = digest == side["state_digest"]
+    _require(report["follower_digest_match"],
+             f"{name}: follower snapshot state diverged from independent "
+             f"replay ({digest[:16]} != {side['state_digest'][:16]})")
+    if name == "geo-region-loss":
+        kill_node, _ = cfg.fault_kill_spec()
+        _require(out[kill_node][0] == "killed",
+                 f"{name}: the killed primary was restarted instead of "
+                 "promoted around")
+        dead_repl = [r for r in range(cfg.replica_cnt * n_srv)
+                     if georepl.region_of(cfg, base + r)
+                     == georepl.region_of(cfg, kill_node)]
+        for r in dead_repl:
+            _require(out[base + r][0] == "killed",
+                     f"{name}: replica {base + r} homed in the lost "
+                     "region survived it")
+        _require(all(v.get("promote_cnt", 0.0) == 1 for v in srv.values()),
+                 f"{name}: expected exactly one promotion on every "
+                 f"survivor: { {s: v.get('promote_cnt') for s, v in srv.items()} }")
+        report["promotes"] = {s: v.get("promote_cnt") for s, v in srv.items()}
+    if name == "geo-replica-lag":
+        _require(any(v.get("quorum_stall_ms", 0.0) > 0
+                     for v in srv.values()),
+                 f"{name}: 40 ms WAN acks but no quorum stall was ever "
+                 "measured")
+        # catch-up convergence: the follower applied the whole stream
+        epochs = {s: v["epoch_cnt"] for s, v in srv.items()}
+        for r, v in repl.items():
+            p = r % n_srv
+            _require(v.get("applied_epoch", -1) == epochs[p] - 1,
+                     f"{name}: follower of {p} applied "
+                     f"{v.get('applied_epoch')} of {epochs[p] - 1}")
+        report["stale_max"] = max(v.get("stale_read_max_epochs", 0)
+                                  for v in repl.values())
 
 
 def _check_recovery(cfg: Config, out: dict, run_id: str,
@@ -327,7 +480,8 @@ def main(argv: list[str]) -> int:
     if not names or names == ["all"]:
         names = list(SCENARIOS)
     names = [x for n in names
-             for x in (ELASTIC_SCENARIOS if n == "elastic" else (n,))]
+             for x in (ELASTIC_SCENARIOS if n == "elastic"
+                       else GEO_SCENARIOS if n == "geo" else (n,))]
     rc = 0
     for name in names:
         try:

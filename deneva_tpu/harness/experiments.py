@@ -286,6 +286,41 @@ def network_sweep(quick: bool) -> list[Config]:
     return pts
 
 
+def geo_quorum(quick: bool) -> list[Config]:
+    """Geo-replication round-10 (runtime/replication.py): quorum
+    group-commit vs full-sync ack gating under a WAN.  2 primaries in 2
+    regions, 2 replicas per primary (placement puts one in the OTHER
+    region, one at home), symmetric 20 ms one-way WAN between regions:
+
+    * geo off        — the pre-geo gate (ALL replica acks, no WAN): the
+                       local-cluster baseline the tier must not tax.
+    * geo, quorum=0  — full-sync over the WAN: every boundary waits for
+                       the cross-region follower's ack (+2x20 ms).
+    * geo, quorum=1  — quorum commit: the home-region follower's ack
+                       releases the boundary; the WAN follower trails
+                       without gating commit latency.
+
+    The epoch exchange crosses the WAN in both geo points (primaries
+    live in different regions), so tput is cadence-bound identically —
+    the quorum win shows up in client_client_latency percentiles and
+    quorum_stall_ms, which is the point: quorum changes the ack-release
+    path, not the epoch pipeline."""
+    base = Config(
+        deploy="cluster", node_cnt=2, part_cnt=2, client_node_cnt=1,
+        cc_alg=CCAlg.CALVIN, synth_table_size=1 << 14,
+        req_per_query=4, max_accesses=4, epoch_batch=256,
+        conflict_buckets=1024, max_txn_in_flight=2048,
+        elastic=True, logging=True, replica_cnt=2,
+        log_dir="/dev/shm/deneva_logs",
+        warmup_secs=0.5, done_secs=1.5 if quick else 5.0)
+    pts = [base]
+    for q in (0, 1):
+        pts.append(base.replace(geo=True, geo_region_cnt=2, geo_quorum=q,
+                                geo_wan_us="0-1:20000",
+                                geo_read_perc=0.1))
+    return pts
+
+
 def modes(quick: bool) -> list[Config]:
     """Degraded-mode oracles (SURVEY §4.2): layer-isolation bounds."""
     base = paper_base(quick).replace(zipf_theta=0.6, cc_alg=CCAlg.TPU_BATCH)
@@ -309,6 +344,7 @@ experiment_map: dict[str, Callable[[bool], list[Config]]] = {
     "pps_scaling": pps_scaling,
     "cluster_scaling": cluster_scaling,
     "network_sweep": network_sweep,
+    "geo_quorum": geo_quorum,
     "modes": modes,
 }
 

@@ -138,6 +138,33 @@ def parse_membership(lines) -> list[dict[str, Any]]:
     return out
 
 
+_REPL = re.compile(r"\[replication\] (.*)")
+
+
+def parse_replication(lines) -> list[dict[str, Any]]:
+    """Per-node ``[replication]`` summary lines (runtime/replication.py)
+    -> [{node, role, region, ...}] — primaries carry quorum fields
+    (quorum, quorum_acked, quorum_stall_ms, promote_cnt), followers the
+    read-side ones (follower_read_cnt, stale_read_max_epochs,
+    applied_epoch).  Logs predating the geo tier yield [], and every
+    other parser ignores ``[replication]`` lines — the same
+    forward/backward-compat contract as ``parse_membership`` (tested in
+    tests/test_harness.py)."""
+    out = []
+    for line in lines:
+        m = _REPL.search(line)
+        if not m:
+            continue
+        d: dict[str, Any] = {}
+        for kv in m.group(1).split():
+            if "=" not in kv:
+                continue
+            k, v = kv.split("=", 1)
+            d[k] = _auto(v)
+        out.append(d)
+    return out
+
+
 def cfg_header(cfg: Config) -> str:
     """`# cfg key=value` echo lines the runner prepends to each output file
     so parsing never has to re-derive the config from the filename."""

@@ -30,6 +30,15 @@ import numpy as np
 _LINE = re.compile(r"\[timeline\] node=(\d+) epoch=(\d+) (.*)")
 _SPAN = re.compile(r"(\w+)=([0-9.]+)ms")
 
+# replication spans (geo tier): latency LEDGERS, not thread-time slices
+# of the epoch loop — quorum wait (held-ack release lag), failover
+# promote (reassignment takeover stall), follower-read serve and group
+# apply time on a replica.  The Chrome-trace export lays them on a
+# separate per-node "replication" thread track so they never distort
+# the phase track's running clock.
+REPLICATION_SPANS = frozenset(("quorum", "promote", "follower_read",
+                               "apply"))
+
 
 def parse_timeline(lines) -> list[dict]:
     """[{node, epoch, phases: {name: ms}}] from raw log lines."""
@@ -74,19 +83,38 @@ def chrome_trace(rows: list[dict]) -> dict:
     at t=0), which is what the lockstep epoch exchange makes meaningful.
     """
     events: list[dict] = []
-    clock: dict[int, float] = {}          # node -> running time (us)
+    clock: dict[int, float] = {}          # node -> phase track time (us)
+    rclock: dict[int, float] = {}         # node -> replication track time
     for r in rows:
         t = clock.get(r["node"], 0.0)
+        rt = rclock.get(r["node"], 0.0)
         for name, ms in r["phases"].items():
             dur = ms * 1000.0
+            if name in REPLICATION_SPANS:
+                # replication spans ride their own thread track (tid 1)
+                # with an independent running clock: they are latency
+                # ledgers, drawn beside the phases, never inside them
+                events.append({"name": name, "ph": "X", "pid": r["node"],
+                               "tid": 1, "ts": round(rt, 3),
+                               "dur": round(dur, 3), "cat": "replication",
+                               "args": {"epoch": r["epoch"]}})
+                rt += dur
+                # the track is named for every node that EMITTED a
+                # tid-1 event, even if all its spans are 0.0 ms
+                rclock.setdefault(r["node"], 0.0)
+                continue
             events.append({"name": name, "ph": "X", "pid": r["node"],
                            "tid": 0, "ts": round(t, 3),
                            "dur": round(dur, 3),
                            "args": {"epoch": r["epoch"]}})
             t += dur
         clock[r["node"]] = t
+        if r["node"] in rclock:
+            rclock[r["node"]] = rt
     meta = [{"name": "process_name", "ph": "M", "pid": n, "tid": 0,
              "args": {"name": f"node {n}"}} for n in sorted(clock)]
+    meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": 1,
+              "args": {"name": "replication"}} for n in sorted(rclock)]
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 

@@ -96,6 +96,34 @@ class ClientNode:
         else:
             self._active = np.ones(self.n_srv, bool)
         self._rr = 0   # rotating retarget cursor
+        # ---- geo tier (runtime/replication.py): nearest-primary write
+        # targeting, follower snapshot reads against the nearest live
+        # replica, WAN profile on every outbound link.  With geo off
+        # (default) no code path below changes. ----
+        self._geo = cfg.geo
+        if self._geo:
+            from deneva_tpu.runtime import replication as georepl
+            self._georepl = georepl
+            self._region = georepl.region_of(cfg, self.me)
+            self._srv_tiers = georepl.server_tiers(cfg, self._region)
+            self._follower_order = georepl.follower_order(cfg,
+                                                          self._region)
+            self._geo_rr = 0
+            self._read_batch = min(256, cfg.client_batch_size)
+            self._fr_ring_pos = 0
+            self._fr_seq = 0
+            self._fr_out: dict[int, tuple[int, int, int]] = {}
+            # outstanding reads: seq -> (sent us, follower id, rows)
+            self._fr_rows = 0          # snapshot rows answered
+            self._fr_sent_rows = 0     # rate-target ledger (lost rows
+            #                            re-credited so reads re-issue)
+            self._fr_tx_rows = 0       # rows actually transmitted
+            self._fr_lost = 0          # rows written off as lost
+            self._fr_boundary: dict[int, int] = {}   # rid -> last epoch
+            self._fr_mono_viol = 0     # served boundary regressed
+            self._fr_ver_viol = 0      # row version stamp > boundary
+            if cfg.geo_wan_us:
+                georepl.apply_wan_profile(self.tp, cfg, self.me)
         # elastic + fault mode: remember which server each tag's inflight
         # credit is CHARGED to.  After a retarget, the first ack may come
         # from a different server than the charge (the drained-but-alive
@@ -202,6 +230,25 @@ class ClientNode:
                     self.stats.arr(
                         f"{self.type_names[t]}_latency").extend(vals[m])
             self.stats.incr("txn_cnt", len(tags))
+        elif rtype == "REGION_READ_RSP":
+            tag, boundary, vals, vers = \
+                self._georepl.decode_region_read_rsp(payload)
+            ent = self._fr_out.pop(tag, None)
+            if ent is not None:
+                now = time.monotonic_ns() // 1000
+                self.stats.arr("follower_read_latency").extend(
+                    [(now - ent[0]) / 1e6])
+                self._fr_rows += len(vals)
+            # lockless version check (the read-set/version-check shape):
+            # no served row may carry a version stamp newer than the
+            # snapshot boundary it was served at, and one follower's
+            # served boundary must never regress
+            if len(vers) and int(vers.max()) > boundary:
+                self._fr_ver_viol += 1
+            if boundary < self._fr_boundary.get(src, -1):
+                self._fr_mono_viol += 1
+            else:
+                self._fr_boundary[src] = boundary
         elif rtype == "MAP_UPDATE":
             from deneva_tpu.runtime.membership import decode_map_msg
             smap, _cut, _reason, _subject = decode_map_msg(payload)
@@ -262,6 +309,65 @@ class ClientNode:
             self._resend_cnt += len(sub)
             self._resend_q.append((now, srv, sub))
 
+    # -- geo tier: nearest-primary writes + follower snapshot reads -----
+    def _geo_write_targets(self) -> list[int]:
+        """Servers of the nearest tier (by region, then WAN delay) that
+        still has an active member, rotated for in-tier fairness; [] if
+        every server is inactive."""
+        for tier in self._srv_tiers:
+            live = [s for s in tier if self._active[s]]
+            if live:
+                self._geo_rr += 1
+                r = self._geo_rr % len(live)
+                return live[r:] + live[:r]
+        return []
+
+    def _nearest_follower(self) -> int | None:
+        """First live replica in nearest-first order (None when the
+        whole follower fleet is gone)."""
+        for rid in self._follower_order:
+            if self.tp.peer_alive(rid):
+                return rid
+        return None
+
+    def _issue_follower_reads(self, sent_total: int, now_us: int) -> None:
+        """Keep snapshot-read traffic at ``geo_read_perc`` of total load
+        (reads / (reads + writes)), at most 4 outstanding batches;
+        outstanding batches older than 16x the resend timeout are
+        written off as lost (a killed follower must not wedge the read
+        loop — REGION_READ has no resend story by design, it is
+        re-issued from this ledger against the next-nearest follower).
+        16x = 4 s at the default resend timeout: past the worst
+        serve+apply head-of-line lag measured on the contended 2-core
+        box (~1.3 s), still well inside the region-loss scenario window
+        so re-targeting off a dead follower stays live.  Written-off
+        rows are re-credited to the rate target, so replacement batches
+        go out (to whichever follower is nearest NOW) and the achieved
+        read fraction recovers after a failover instead of permanently
+        undershooting by the lost traffic."""
+        for seq in [s for s, (t, _r, _n) in self._fr_out.items()
+                    if now_us - t > 16 * self._resend_us]:
+            rows = self._fr_out.pop(seq)[2]
+            self._fr_sent_rows -= rows
+            self._fr_lost += rows
+        p = self.cfg.geo_read_perc
+        target = p / (1.0 - p) * max(sent_total, 1)
+        while (self._fr_sent_rows < target and len(self._fr_out) < 4):
+            rid = self._nearest_follower()
+            if rid is None:
+                return
+            blk = self.ring[self._fr_ring_pos]
+            self._fr_ring_pos = (self._fr_ring_pos + 1) % len(self.ring)
+            keys = np.ascontiguousarray(
+                blk.keys.reshape(-1)[: self._read_batch], np.int32)
+            seq = self._fr_seq
+            self._fr_seq += 1
+            self.tp.sendv(rid, "REGION_READ",
+                          self._georepl.region_read_parts(seq, keys))
+            self._fr_out[seq] = (now_us, rid, len(keys))
+            self._fr_sent_rows += len(keys)
+            self._fr_tx_rows += len(keys)
+
     # ------------------------------------------------------------------
     def run(self) -> Stats:
         cfg = self.cfg
@@ -280,8 +386,18 @@ class ClientNode:
             # no Python-level min/int bookkeeping)
             budgets = np.minimum(self.chunk,
                                  self.cap - self.inflight).astype(np.int64)
-            for _ in range(self.n_srv):
-                srv = (srv + 1) % self.n_srv
+            if self._geo:
+                # nearest-primary writes: the closest region tier that
+                # still has an active server takes this tick's sends
+                # (rotated for fairness inside the tier); farther tiers
+                # only see traffic once every nearer one is drained or
+                # dead
+                cand = self._geo_write_targets()
+            else:
+                cand = [(srv + 1 + i) % self.n_srv
+                        for i in range(self.n_srv)]
+            for c in cand:
+                srv = c
                 if not self._active[srv]:       # slotless under the map
                     continue
                 n = int(budgets[srv])
@@ -319,6 +435,9 @@ class ClientNode:
                 self.inflight[srv] += n
                 sent_total += n
                 progressed = True
+            if self._geo and self.cfg.geo_read_perc > 0:
+                self._issue_follower_reads(sent_total,
+                                           time.monotonic_ns() // 1000)
             if self._fault_mode:
                 now_us = time.monotonic_ns() // 1000
                 if now_us >= self._sweep_next_us:
@@ -347,6 +466,13 @@ class ClientNode:
         if self._elastic:
             st.set("map_version", float(self._map_version))
             st.set("redirect_resend_cnt", float(self._redirect_resends))
+        if self._geo:
+            st.set("geo_region", float(self._region))
+            st.set("follower_read_cnt", float(self._fr_rows))
+            st.set("follower_read_sent", float(self._fr_tx_rows))
+            st.set("follower_read_lost", float(self._fr_lost))
+            st.set("follower_read_mono_viol", float(self._fr_mono_viol))
+            st.set("follower_read_ver_viol", float(self._fr_ver_viol))
         for k, v in self.tp.stats().items():
             if not self._fault_mode and k in ("msg_dropped", "msg_dup",
                                               "reconnects"):

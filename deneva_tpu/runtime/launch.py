@@ -41,8 +41,13 @@ def _server_main(cfg: Config, endpoints: str, platform: str | None, q) -> None:
         q.put((cfg.node_id, "error", traceback.format_exc()))
 
 
-def _replica_main(cfg: Config, endpoints: str, q) -> None:
+def _replica_main(cfg: Config, endpoints: str, platform: str | None,
+                  q) -> None:
     try:
+        if platform:
+            # geo followers replay the command stream through the
+            # per-epoch jit — pin their JAX platform like the servers'
+            os.environ.setdefault("JAX_PLATFORMS", platform)
         from deneva_tpu.runtime.replica import ReplicaNode
         node = ReplicaNode(cfg, endpoints)
         st = node.run()
@@ -135,7 +140,7 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
         procs.append(ctx.Process(
             target=_replica_main,
             args=(cfg.replace(node_id=n_srv + n_cl + r, part_cnt=n_srv),
-                  endpoints, q),
+                  endpoints, platform, q),
             daemon=True))
     for p in procs:
         p.start()
@@ -155,6 +160,20 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
             try:
                 nid, kind, line = q.get(timeout=1.0)
             except _queue.Empty:
+                if supervise and cfg.geo:
+                    # geo region loss also takes the region's replicas:
+                    # only the planned kill sentinel (exit 17) retires a
+                    # follower in place; anything else is a real crash
+                    for r in range(n_repl):
+                        rid = n_srv + n_cl + r
+                        p = procs[rid]
+                        if (rid not in out and not p.is_alive()
+                                and p.exitcode not in (0, None)):
+                            if p.exitcode != 17:
+                                raise RuntimeError(
+                                    f"replica {rid} crashed (exitcode "
+                                    f"{p.exitcode}) in geo mode")
+                            out[rid] = ("killed", "")
                 if supervise:
                     # a dead, unreported server with logging enabled is
                     # recoverable: restart it once in recovery mode (it

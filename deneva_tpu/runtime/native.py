@@ -36,6 +36,15 @@ RTYPE = {
     # is control plane, like the epoch exchange — its fault mode is
     # process death, not silent loss.
     "MIGRATE_BEGIN": 15, "MIGRATE_ROWS": 16, "MAP_UPDATE": 17,
+    # geo-replication tier (runtime/replication.py): quorum durability
+    # ack (replica -> primary, replaces LOG_RSP in geo mode and adds the
+    # follower's applied horizon), and the follower snapshot-read pair
+    # (client <-> replica).  Deliberately OUTSIDE FAULT_RTYPE_MASK like
+    # rtypes 15-17: the quorum ack is the commit protocol itself, and
+    # follower reads are best-effort control-plane traffic the client
+    # re-issues from its own outstanding ledger — neither has the
+    # resend+idempotent-admission story the fault mask encodes.
+    "LOG_ACK": 18, "REGION_READ": 19, "REGION_READ_RSP": 20,
 }
 RTYPE_NAME = {v: k for k, v in RTYPE.items()}
 
@@ -99,6 +108,9 @@ def _load() -> C.CDLL:
                                     C.POINTER(C.c_uint32)]
             lib.dt_flush.argtypes = [C.c_void_p]
             lib.dt_set_delay_us.argtypes = [C.c_void_p, C.c_uint64]
+            lib.dt_set_peer_delay_us.restype = C.c_int
+            lib.dt_set_peer_delay_us.argtypes = [C.c_void_p, C.c_uint32,
+                                                 C.c_uint64]
             lib.dt_set_fault.restype = C.c_int
             lib.dt_set_fault.argtypes = [C.c_void_p, C.c_uint32,
                                          C.c_uint32, C.c_uint64,
@@ -267,6 +279,13 @@ class NativeTransport:
 
     def set_delay_us(self, us: int) -> None:
         self._lib.dt_set_delay_us(self._h, us)
+
+    def set_peer_delay_us(self, peer: int, us: int) -> None:
+        """Per-link extra send delay (geo WAN profiles; adds on top of
+        the global delay — `runtime/replication.py` drives it from the
+        region distance matrix)."""
+        if self._lib.dt_set_peer_delay_us(self._h, peer, int(us)) != 0:
+            raise RuntimeError(f"set_peer_delay_us({peer}) failed")
 
     def set_fault(self, drop_prob: float = 0.0, dup_prob: float = 0.0,
                   jitter_us: float = 0.0, seed: int = 0,

@@ -72,6 +72,13 @@ OWNER: dict[str, str] = {
     "_committed_recent": DISPATCH, "_held_rsp": DISPATCH,
     "_held_commit": DISPATCH, "repl_acked": DISPATCH,
     "_rejoin_pending": DISPATCH, "_feed_free": DISPATCH,
+    # geo-replication tier (quorum ledger + promote accounting; acks
+    # arrive through _route on the dispatch thread, holds/releases at
+    # the retire positions)
+    "_geo": DISPATCH, "_geo_region": DISPATCH, "repl_applied": DISPATCH,
+    "_promote_cnt": DISPATCH, "_quorum_hold_t": DISPATCH,
+    "_quorum_stall_s": DISPATCH, "_quorum_release_cnt": DISPATCH,
+    "_geo_spans": DISPATCH,
     # elastic membership control plane (cutovers at group boundaries,
     # always applied on the dispatch thread)
     "smap": DISPATCH, "_mig_pending": DISPATCH, "_mig_rows": DISPATCH,
@@ -118,7 +125,8 @@ GUARDED = (
     "pending", "blob_buf", "vote_buf", "vote2_buf", "_in_system",
     "_committed_set", "_committed_recent", "_held_rsp", "_held_commit",
     "_feed_free", "_mig_rows", "_reassigned", "_rejoin_pending",
-    "_contrib_gone", "repl_acked",
+    "_contrib_gone", "repl_acked", "repl_applied", "_quorum_hold_t",
+    "_geo_spans",
 )
 
 

@@ -42,6 +42,7 @@ from collections import deque
 import numpy as np
 
 from deneva_tpu.config import CCAlg, Config
+from deneva_tpu.runtime import replication as georepl
 from deneva_tpu.runtime import wire
 from deneva_tpu.runtime.native import NativeTransport
 from deneva_tpu.stats import Stats
@@ -506,6 +507,18 @@ class ServerNode:
             # (re-ack takeover authority; same gate as held CL_RSPs)
             self._held_commit: deque[tuple[int, np.ndarray]] = deque()
 
+        # ---- geo-replication tier (quorum group-commit + region roles;
+        # runtime/replication.py — all off on a default config) ----------
+        self._geo = cfg.geo
+        self._geo_region = georepl.region_of(cfg, self.me) if self._geo \
+            else 0
+        self.repl_applied: dict[int, int] = {}
+        self._promote_cnt = 0
+        self._quorum_hold_t: dict[int, float] = {}
+        self._quorum_stall_s = 0.0
+        self._quorum_release_cnt = 0
+        self._geo_spans = {"quorum": 0.0, "promote": 0.0}
+
         # ---- chaos / failover gates (all off on a default config) ------
         # _failover: peers tolerate a dead server and wait for its
         # recovered incarnation instead of raising; acks gate on whole-
@@ -543,6 +556,10 @@ class ServerNode:
                                   recv_threads=cfg.rem_thread_cnt,
                                   rejoin=cfg.recover)
         self.tp.start()
+        if self._geo and cfg.geo_wan_us:
+            # WAN latency profile: per-link delays from the region
+            # distance matrix (the geo tier's network model)
+            georepl.apply_wan_profile(self.tp, cfg, self.me)
         if (cfg.fault_drop_prob or cfg.fault_dup_prob
                 or cfg.fault_delay_jitter_us):
             self.tp.set_fault(cfg.fault_drop_prob, cfg.fault_dup_prob,
@@ -605,6 +622,7 @@ class ServerNode:
         self.repl_ids = [self.n_srv + self.n_cl + self.me + k * self.n_srv
                          for k in range(cfg.replica_cnt)]
         self.repl_acked = {r: -1 for r in self.repl_ids}
+        self.repl_applied.update({r: -1 for r in self.repl_ids})
         self._held_rsp: deque[tuple[int, int, np.ndarray]] = deque()
         if cfg.logging:
             from deneva_tpu.runtime.logger import EpochLogger
@@ -780,6 +798,14 @@ class ServerNode:
             # this replica acked everything up to this epoch (FIFO link)
             e = wire.decode_shutdown(payload)
             self.repl_acked[src] = max(self.repl_acked.get(src, -1), e)
+            self._rejoin_pending.discard(src)
+        elif rtype == "LOG_ACK":
+            # geo quorum ack: durability watermark + the follower's
+            # applied horizon (replica-lag visibility for the summary)
+            e, applied = georepl.decode_log_ack(payload)
+            self.repl_acked[src] = max(self.repl_acked.get(src, -1), e)
+            self.repl_applied[src] = max(self.repl_applied.get(src, -1),
+                                         applied)
             self._rejoin_pending.discard(src)
         elif rtype == "REJOIN":
             # a crashed peer server recovered and resumes at this epoch
@@ -1161,8 +1187,17 @@ class ServerNode:
     def _durable_through(self) -> int:
         """Highest epoch that is on disk locally AND acked by every one of
         my replicas (the reference's `log_flushed && repl_finished` commit
-        gate, `system/txn.cpp:436`)."""
+        gate, `system/txn.cpp:436`).  Geo mode relaxes "every" to a
+        QUORUM of ``geo_quorum`` LOG_ACKs over the LIVE follower set
+        (replication.durable_quorum): a slow WAN follower stops gating
+        commit latency, and a DEAD one (region loss) leaves the quorum
+        instead of freezing the horizon — held acks must keep releasing
+        across the promotion."""
         e = self.logger.flushed_epoch
+        if self._geo and self.repl_ids:
+            return georepl.durable_quorum(
+                {r: self.repl_acked[r] for r in self.repl_ids},
+                self.tp.peer_alive, self.cfg.geo_quorum, e)
         for r in self.repl_ids:
             e = min(e, self.repl_acked[r])
         return e
@@ -1183,7 +1218,12 @@ class ServerNode:
         durable — used at shutdown so no committed txn loses its ack."""
         if self.logger is None:
             return
-        if wait_epoch is not None:
+        held_any = bool(self._held_rsp) or (self._full_planes
+                                            and bool(self._held_commit))
+        if wait_epoch is not None and held_any:
+            # the bounded wait exists only to release held items; with
+            # nothing held (e.g. a geo server whose region admits no
+            # clients) it would just burn the 10 s budget
             t0 = time.monotonic()
             while self._durable_ack_epoch() < wait_epoch \
                     and time.monotonic() - t0 < 10.0:
@@ -1191,6 +1231,21 @@ class ServerNode:
                 if self.n_repl:
                     self._drain(timeout_us=10_000)
         durable = self._durable_ack_epoch()
+        if self._geo and self._quorum_hold_t:
+            # quorum wait ledger: hold -> release lag of each retiring
+            # epoch.  Epochs wait overlapped (the pipeline holds whole
+            # groups), so the [replication]/[summary] quorum_stall_ms is
+            # the MEAN per-epoch lag at the quorum gate, not a sum; the
+            # timeline span carries the max released this pass (the
+            # visible stall width).
+            now = time.monotonic()
+            released = [e for e in self._quorum_hold_t if e <= durable]
+            if released:
+                lags = [now - self._quorum_hold_t.pop(e)
+                        for e in released]
+                self._quorum_stall_s += sum(lags)
+                self._quorum_release_cnt += len(lags)
+                self._geo_spans["quorum"] += max(lags) * 1e3
         if self._full_planes:
             while self._held_commit and self._held_commit[0][0] <= durable:
                 _, ids = self._held_commit.popleft()
@@ -1525,8 +1580,15 @@ class ServerNode:
         for ep, blobs in self.blob_buf.items():
             if ep >= epoch:
                 blobs.pop(dead, None)
+        stall_ms = (time.monotonic() - t0) * 1e3
+        if self._geo:
+            # geo failover: this takeover IS the promotion — a surviving
+            # replica-holder of the lost region's slots replayed itself
+            # up to the quorum-durable boundary and now answers for them
+            self._promote_cnt += 1
+            self._geo_spans["promote"] += stall_ms
         self._install_map(new_map, epoch, M.REASON_REASSIGN, dead,
-                          rows_in, 0, (time.monotonic() - t0) * 1e3)
+                          rows_in, 0, stall_ms)
 
     def _adopt_by_replay(self, acquired: np.ndarray, stop_epoch: int
                          ) -> int:
@@ -1646,6 +1708,9 @@ class ServerNode:
                     if self.logger is None:
                         self._retire_dedup(ids)
                     else:
+                        if self._geo:
+                            self._quorum_hold_t.setdefault(
+                                epoch, time.monotonic())
                         self._held_commit.append((epoch, ids))
             if pre is not None:
                 if pre[i] is not None:
@@ -1659,6 +1724,9 @@ class ServerNode:
                             self.tp.sendv(c, "CL_RSP",
                                           wire.cl_rsp_parts(masked))
                         else:
+                            if self._geo:
+                                self._quorum_hold_t.setdefault(
+                                    epoch, time.monotonic())
                             self._held_rsp.append((c, epoch, masked))
             elif my_commit.any():
                 # TxnStats analogue: whole-life restart/wait counts of
@@ -1685,6 +1753,9 @@ class ServerNode:
                                      wire.encode_cl_rsp(rsp[2]))
                     else:
                         # group commit: hold until epoch is durable
+                        if self._geo:
+                            self._quorum_hold_t.setdefault(
+                                epoch, time.monotonic())
                         self._held_rsp.append(rsp)
             ab = abort[i, lo:lo + n]
             df = defer[i, lo:lo + n]
@@ -2091,6 +2162,18 @@ class ServerNode:
                     now - t_start, c, {"epoch_cnt": float(group_end)}),
                     flush=True)
             if tl:
+                if self._geo:
+                    # replication spans (quorum wait, failover promote):
+                    # latency ledgers, not thread-time slices — the
+                    # chrome-trace export lays them on a separate
+                    # per-node "replication" track (harness/timeline.py)
+                    for name in ("quorum", "promote"):
+                        ms = self._geo_spans[name]
+                        if ms:
+                            self._geo_spans[name] = 0.0
+                            # _Timeline.spans holds SECONDS (emit scales
+                            # by 1e3); the geo ledgers are ms
+                            tl.spans.append((name, ms / 1e3))
                 tl.emit(self.me, group_end)
             if self.stop_epoch is not None and group_end >= self.stop_epoch:
                 while inflight:
@@ -2160,6 +2243,25 @@ class ServerNode:
             st.set("dup_admit_cnt", float(self._dup_admits))
             st.set("reack_cnt", float(self._reacks))
             st.set("recovered", 1.0 if cfg.recover else 0.0)
+        if self._geo:
+            # geo-replication counters + the [replication] summary line
+            # (parsed by harness.parse.parse_replication)
+            acked = [self.repl_acked[r] for r in self.repl_ids]
+            applied = [self.repl_applied[r] for r in self.repl_ids]
+            stall_ms = (self._quorum_stall_s
+                        / max(self._quorum_release_cnt, 1)) * 1e3
+            st.set("quorum_stall_ms", stall_ms)
+            st.set("promote_cnt", float(self._promote_cnt))
+            st.set("geo_region", float(self._geo_region))
+            st.set("quorum_acked_epoch",
+                   float(georepl.quorum_ack(acked, cfg.geo_quorum)))
+            print(georepl.replication_line(
+                self.me, "primary", self._geo_region,
+                quorum=cfg.geo_quorum or cfg.replica_cnt,
+                quorum_acked=georepl.quorum_ack(acked, cfg.geo_quorum),
+                repl_applied_min=min(applied, default=-1),
+                quorum_stall_ms=stall_ms,
+                promote_cnt=self._promote_cnt), flush=True)
         if self._elastic:
             # membership counters ([summary] satellite): how much the
             # control plane moved and what the cutovers cost

@@ -118,6 +118,13 @@ void dt_flush(dt_transport *t);
  * queue for at least delay_us before hitting the socket. */
 void dt_set_delay_us(dt_transport *t, uint64_t delay_us);
 
+/* Per-destination extra send delay (geo-replication WAN profiles: one
+ * value per link, added on top of the global dt_set_delay_us).  May be
+ * called before or after dt_start; 0 (the default) disables.  Returns
+ * 0, -1 on a bad peer id. */
+int dt_set_peer_delay_us(dt_transport *t, uint32_t peer,
+                         uint64_t delay_us);
+
 /* Seeded fault injection (chaos harness; the reference has none).
  * Applied at enqueue time to frames whose rtype bit is set in rtype_mask
  * (bit i = rtype i, rtypes >= 32 never match): drop with probability

@@ -141,6 +141,9 @@ struct dt_transport {
 
   std::atomic<bool> stop{false};
   std::atomic<uint64_t> delay_us{0};
+  // per-destination extra delay (geo WAN profiles): added on top of the
+  // global delay_us; sized at dt_create, all-zero by default
+  std::vector<std::atomic<uint64_t>> peer_delay_us;
   // fault injection (dt_set_fault): all-zero = disabled (default)
   std::atomic<uint32_t> fault_drop_ppm{0};
   std::atomic<uint32_t> fault_dup_ppm{0};
@@ -360,14 +363,14 @@ struct dt_transport {
         wait = 100;  // stay responsive while frames are parked
       bool got = sh.q.pop(&f, wait);
       uint64_t now = now_us();
-      if (got) {
-        accept(sh, std::move(f), now, delayed);
-        // drain the whole queue per wake: one blocking pop then
-        // non-blocking pops until empty (batching amortizes syscalls)
-        OutFrame g;
-        while (sh.q.pop(&g, 0)) accept(sh, std::move(g), now, delayed);
-      }
-      // release matured delayed frames
+      // release matured delayed frames BEFORE accepting fresh pops:
+      // a popped frame that is already mature (the sender woke late)
+      // must not leapfrog an earlier same-destination frame still
+      // parked here — per-link FIFO is an invariant the runtime leans
+      // on (replica log streams replay order-sensitively).  Within one
+      // pass maturity is monotonic per destination for un-jittered
+      // frames (ready_us = enqueue time + a per-dest-constant delay),
+      // so releasing parked frames first restores FIFO.
       for (size_t i = 0; i < delayed.size();) {
         if (delayed[i].ready_us <= now) {
           append(sh, std::move(delayed[i]), now);
@@ -375,6 +378,13 @@ struct dt_transport {
         } else {
           ++i;
         }
+      }
+      if (got) {
+        accept(sh, std::move(f), now, delayed);
+        // drain the whole queue per wake: one blocking pop then
+        // non-blocking pops until empty (batching amortizes syscalls)
+        OutFrame g;
+        while (sh.q.pop(&g, 0)) accept(sh, std::move(g), now, delayed);
       }
       // flush full/timed-out buffers; when idle (or told to) flush all
       uint64_t freq = sh.flush_req.load(std::memory_order_acquire);
@@ -397,10 +407,13 @@ struct dt_transport {
       }
       if (force) sh.flush_done.store(freq, std::memory_order_release);
     }
-    // drain on shutdown: queued frames AND parked delayed frames
+    // drain on shutdown: parked delayed frames FIRST (they were
+    // enqueued before anything still in the queue — appending the
+    // queue first would invert per-link FIFO at the stream tail),
+    // then the queued frames
+    for (auto &df : delayed) append(sh, std::move(df), now_us());
     OutFrame f;
     while (sh.q.pop(&f, 0)) append(sh, std::move(f), now_us());
-    for (auto &df : delayed) append(sh, std::move(df), now_us());
     for (uint32_t d = 0; d < n_nodes; ++d) flush_dest(sh, d);
   }
 
@@ -575,7 +588,9 @@ struct dt_transport {
     }
     OutFrame f;
     f.dest = dest;
-    uint64_t d = delay_us.load(std::memory_order_relaxed) + jitter;
+    uint64_t d = delay_us.load(std::memory_order_relaxed) +
+                 peer_delay_us[dest].load(std::memory_order_relaxed) +
+                 jitter;
     f.ready_us = d ? now_us() + d : 0;
     f.bytes.resize(sizeof(h) + len);
     std::memcpy(f.bytes.data(), &h, sizeof(h));
@@ -612,6 +627,7 @@ dt_transport *dt_create(uint32_t node_id, const char *endpoints,
   t->peer_fd = std::vector<std::atomic<int>>(n_nodes);
   for (auto &slot : t->peer_fd) slot.store(-1, std::memory_order_relaxed);
   t->peer_dead = std::vector<std::atomic<bool>>(n_nodes);
+  t->peer_delay_us = std::vector<std::atomic<uint64_t>>(n_nodes);
 
   std::string text(endpoints);
   size_t pos = 0;
@@ -760,6 +776,13 @@ void dt_flush(dt_transport *t) {
 
 void dt_set_delay_us(dt_transport *t, uint64_t delay_us) {
   if (t) t->delay_us.store(delay_us, std::memory_order_relaxed);
+}
+
+int dt_set_peer_delay_us(dt_transport *t, uint32_t peer,
+                         uint64_t delay_us) {
+  if (!t || peer >= t->n_nodes) return -1;
+  t->peer_delay_us[peer].store(delay_us, std::memory_order_relaxed);
+  return 0;
 }
 
 int dt_set_fault(dt_transport *t, uint32_t drop_ppm, uint32_t dup_ppm,

@@ -191,3 +191,79 @@ def test_parse_tolerates_membership_lines(tmp_path):
     old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
     assert parse_membership(old_log.read_text().splitlines()) == []
     assert parse_file(str(old_log))["tput"] == 5
+
+
+def test_parse_replication_forward_backward_compat(tmp_path):
+    """[replication] summary lines (geo tier satellite): primaries and
+    followers each carry their own key set, old logs yield [], and the
+    new lines perturb no other parser."""
+    from deneva_tpu.harness.parse import parse_file, parse_membership, \
+        parse_replication
+    from deneva_tpu.harness.timeline import parse_timeline
+
+    new_log = tmp_path / "geo.out"
+    new_log.write_text(
+        "# cfg node_cnt=2\n"
+        "[replication] node=0 role=primary region=0 quorum=1 "
+        "quorum_acked=118 repl_applied_min=112 quorum_stall_ms=41.5 "
+        "promote_cnt=1\n"
+        "[replication] node=4 role=follower region=1 primary=0 "
+        "applied_epoch=118 follower_read_cnt=2048 "
+        "stale_read_max_epochs=9 follower_read_ms=12.0 apply_ms=310.2\n"
+        "[timeline] node=0 epoch=120 loop=1.0ms quorum=41.5ms\n"
+        "[summary] total_runtime=2,tput=50,txn_cnt=100,"
+        "quorum_stall_ms=41.5,promote_cnt=1\n")
+    rows = parse_replication(new_log.read_text().splitlines())
+    assert len(rows) == 2
+    prim, fol = rows
+    assert prim["role"] == "primary" and prim["quorum_stall_ms"] == 41.5
+    assert prim["promote_cnt"] == 1
+    assert fol["role"] == "follower" and fol["follower_read_cnt"] == 2048
+    assert fol["stale_read_max_epochs"] == 9 and fol["applied_epoch"] == 118
+    # other parsers ignore the new lines entirely
+    row = parse_file(str(new_log))
+    assert row["tput"] == 50 and row["quorum_stall_ms"] == 41.5
+    assert parse_membership(new_log.read_text().splitlines()) == []
+    assert len(parse_timeline(new_log.read_text().splitlines())) == 1
+    # old log: no replication lines -> []
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_replication(old_log.read_text().splitlines()) == []
+
+
+def test_timeline_chrome_trace_replication_track(tmp_path):
+    """Replication spans (quorum wait, follower-read serve, failover
+    promote, group apply) export on a separate per-node "replication"
+    thread track: latency ledgers drawn beside the phase clock, never
+    inside it."""
+    from deneva_tpu.harness.timeline import chrome_trace, parse_timeline
+
+    lines = [
+        "[timeline] node=0 epoch=8 loop=1.0ms admit=2.0ms quorum=40.0ms\n",
+        "[timeline] node=0 epoch=16 loop=1.0ms promote=900.0ms\n",
+        "[timeline] node=4 epoch=8 apply=12.0ms follower_read=3.0ms\n",
+        # all-zero spans must still name the track (idle follower)
+        "[timeline] node=5 epoch=8 apply=0.0ms follower_read=0.0ms\n",
+    ]
+    trace = chrome_trace(parse_timeline(lines))
+    ev = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    phase = [e for e in ev if e["tid"] == 0]
+    repl = [e for e in ev if e["tid"] == 1]
+    # phase track clock is untouched by the replication spans
+    n0 = [e for e in phase if e["pid"] == 0]
+    assert [e["name"] for e in n0] == ["loop", "admit", "loop"]
+    assert n0[2]["ts"] == 3000.0          # 1ms + 2ms, no 40ms gap
+    # replication track has its own running clock and category
+    r0 = [e for e in repl if e["pid"] == 0]
+    assert [e["name"] for e in r0] == ["quorum", "promote"]
+    assert r0[0]["ts"] == 0 and r0[1]["ts"] == 40000.0
+    assert all(e["cat"] == "replication" for e in repl)
+    # follower-side spans ride the same mechanism
+    assert [e["name"] for e in repl if e["pid"] == 4] \
+        == ["apply", "follower_read"]
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta if m["tid"] == 1} \
+        == {"replication"}
+    # every node with tid-1 events gets a named track — including node
+    # 5, whose spans are all zero-duration
+    assert {m["pid"] for m in meta if m["tid"] == 1} == {0, 4, 5}

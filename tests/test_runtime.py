@@ -159,6 +159,50 @@ def test_dead_peer_detected_fast():
     assert err["secs"] < 30, f"detection took {err['secs']:.1f}s"
 
 
+def test_replica_barrier_timeout_and_clean_close(tmp_path):
+    """ReplicaNode.barrier timeout path (previously untested): a peer
+    that joins the mesh but never sends INIT_DONE must trip the bounded
+    TimeoutError naming the replica, and close() afterwards must release
+    the log file handle AND the transport in that order, idempotently —
+    teardown after a failed barrier may not leak the open log or hang."""
+    import os
+    import threading
+
+    from deneva_tpu.runtime.native import NativeTransport, ipc_endpoints
+    from deneva_tpu.runtime.replica import ReplicaNode
+
+    # layout [1 server | 0 clients | 1 replica]: replica is node 1
+    cfg = small_cfg(node_cnt=1, client_node_cnt=0, replica_cnt=1,
+                    node_id=1, logging=True,
+                    log_dir=str(tmp_path)).validate()
+    eps = ipc_endpoints(2, f"replbar_{os.getpid()}")
+    peer_box: dict = {}
+
+    def run_peer():
+        # joins the mesh so both dt_starts complete, then stays silent
+        tp = NativeTransport(0, eps, 2)
+        tp.start()
+        peer_box["tp"] = tp
+        peer_box["ev"].wait(30)
+        tp.close()
+
+    peer_box["ev"] = threading.Event()
+    t = threading.Thread(target=run_peer)
+    t.start()
+    node = ReplicaNode(cfg, eps)
+    try:
+        with pytest.raises(TimeoutError, match="replica 1"):
+            node.barrier(timeout_s=0.8)
+    finally:
+        node.close()
+        peer_box["ev"].set()
+        t.join(timeout=30)
+    # close ordering: the log handle is released (no dangling fsync
+    # target) and a second close is a no-op, not a crash
+    assert node._f.closed
+    node.close()
+
+
 @pytest.mark.slow
 def test_client_load_rate_throttles():
     """LOAD_RATE mode (reference `config.h:21-22`, client_thread.cpp:35-41):

@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 
 from deneva_tpu.runtime import membership as M
+from deneva_tpu.runtime import replication as R
 from deneva_tpu.runtime import logger, native, wire
 from tools.graftlint.wiremodel import WIRE_MODEL
 
@@ -37,7 +38,7 @@ def test_fault_mask_classification_is_explicit_and_matches():
 def test_declared_codecs_exist():
     for spec in WIRE_MODEL.values():
         for fn in (*spec.codec_encode, *spec.codec_decode):
-            assert any(hasattr(m, fn) for m in (wire, M, logger)), \
+            assert any(hasattr(m, fn) for m in (wire, M, logger, R)), \
                 f"{spec.name}: declared codec {fn} not found"
 
 
@@ -162,6 +163,35 @@ def _rt_migrate_rows():
         np.testing.assert_array_equal(cols[name], cols2[name])
 
 
+def _rt_log_ack():
+    acked, applied = R.decode_log_ack(R.encode_log_ack(1234, 1227))
+    assert (acked, applied) == (1234, 1227)
+
+
+def _rt_region_read():
+    keys = np.array([7, 4095, 0, 88], np.int32)
+    buf = R.encode_region_read(991, keys)
+    tag, keys2 = R.decode_region_read(buf)
+    assert tag == 991
+    np.testing.assert_array_equal(keys, keys2)
+    # zero-copy parts path must be byte-identical to the codec
+    parts = R.region_read_parts(991, keys)
+    assert b"".join(bytes(p) for p in parts) == buf
+
+
+def _rt_region_read_rsp():
+    r = np.random.default_rng(11)
+    values = r.integers(0, 1 << 32, 9, dtype=np.uint32)
+    vers = r.integers(0, 500, 9).astype(np.int32)
+    buf = R.encode_region_read_rsp(5, 640, values, vers)
+    tag, boundary, v2, ver2 = R.decode_region_read_rsp(buf)
+    assert (tag, boundary) == (5, 640)
+    np.testing.assert_array_equal(values, v2)
+    np.testing.assert_array_equal(vers, ver2)
+    parts = R.region_read_rsp_parts(5, 640, values, vers)
+    assert b"".join(bytes(p) for p in parts) == buf
+
+
 def _rt_payload_free():
     return None     # no payload on the wire: nothing to round-trip
 
@@ -184,6 +214,9 @@ ROUNDTRIP = {
     "MIGRATE_BEGIN": _rt_map_msg,
     "MIGRATE_ROWS": _rt_migrate_rows,
     "MAP_UPDATE": _rt_map_msg,
+    "LOG_ACK": _rt_log_ack,
+    "REGION_READ": _rt_region_read,
+    "REGION_READ_RSP": _rt_region_read_rsp,
 }
 
 

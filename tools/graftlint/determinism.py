@@ -37,6 +37,7 @@ REPLAY_MODULES = (
     "deneva_tpu/runtime/membership.py",
     "deneva_tpu/runtime/logger.py",
     "deneva_tpu/runtime/wire.py",
+    "deneva_tpu/runtime/replication.py",
 )
 
 _SEND_SINKS = frozenset(("send", "sendv", "sendv_many"))

@@ -34,6 +34,7 @@ CODEC_MODULES = (
     "deneva_tpu/runtime/wire.py",
     "deneva_tpu/runtime/membership.py",
     "deneva_tpu/runtime/logger.py",
+    "deneva_tpu/runtime/replication.py",
 )
 
 # handler qualname -> (module, function name) to scan for route branches
@@ -142,4 +143,23 @@ WIRE_MODEL: dict[str, RtypeSpec] = {s.name: s for s in (
        routes=("ServerNode._route", "ClientNode._route"),
        note="client map install / redirect NACK: loss self-heals via "
             "the resend sweep's retargeting, but it is control plane"),
+    _s("LOG_ACK", False,
+       enc=("encode_log_ack",), dec=("decode_log_ack",),
+       routes=("ServerNode._route",),
+       note="geo quorum durability ack (acked + applied horizon): the "
+            "commit protocol itself, outside the mask like rtypes "
+            "15-17"),
+    _s("REGION_READ", False,
+       enc=("encode_region_read", "region_read_parts"),
+       dec=("decode_region_read",),
+       routes=("ReplicaNode._handle",),
+       note="follower snapshot read request: control plane; the client "
+            "re-issues from its outstanding ledger, it has no "
+            "resend+idempotent-admission story"),
+    _s("REGION_READ_RSP", False,
+       enc=("encode_region_read_rsp", "region_read_rsp_parts"),
+       dec=("decode_region_read_rsp",),
+       routes=("ClientNode._route",),
+       note="follower snapshot read answer (boundary + values + row "
+            "version stamps): control plane, same lost-read ledger"),
 )}

@@ -9,6 +9,10 @@
 #   tools/smoke.sh elastic                membership gate: elastic-grow /
 #                                         elastic-drain / elastic-kill-reassign
 #                                         (liveness + exactly-once invariants)
+#   tools/smoke.sh geo                    geo-replication gate: region-loss /
+#                                         asymmetric-WAN / replica-lag
+#                                         (quorum commit, follower snapshot
+#                                         reads, promote-on-region-loss)
 #   tools/smoke.sh lint                   static-analysis gate: graftlint
 #                                         (trace/det/wire/own/imports families)
 #                                         + ruff (pyflakes slice, when
@@ -51,6 +55,10 @@ case "$SCEN" in
     T="${SMOKE_TIMEOUT_SECS:-${ELASTIC_TIMEOUT_SECS:-600}}"
     run "$T" python -m deneva_tpu.harness.chaos elastic --quick
     ;;
+  geo)
+    T="${SMOKE_TIMEOUT_SECS:-${GEO_TIMEOUT_SECS:-900}}"
+    run "$T" python -m deneva_tpu.harness.chaos geo --quick
+    ;;
   lint)
     # static gate; budget 30 s total on the 2-core CI box (graftlint
     # measures ~2.5 s over the 70-file tree, ruff sub-second)
@@ -65,7 +73,7 @@ case "$SCEN" in
     fi
     ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|lint> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|lint> [args...]" >&2
     exit 2
     ;;
 esac
