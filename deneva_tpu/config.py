@@ -441,6 +441,85 @@ class Config:
     #                                (REGION_READ to the nearest live
     #                                follower); 0 disables the read path.
 
+    # ---- overload robustness tier (open-loop load generation +
+    # per-tenant admission control + SLO backpressure; runtime/loadgen.py
+    # and runtime/admission.py).  All defaults OFF: with every knob at
+    # its default the client drives the pre-overload closed loop and the
+    # server admits unconditionally — bit-identical wire bytes. ----
+    arrival_process: str = ""      # open-loop arrival process replacing
+    #                                closed-loop driving: "" (off) |
+    #                                "poisson" (steady seeded Poisson) |
+    #                                "diurnal" (sinusoid-modulated rate) |
+    #                                "bursty" (on/off duty cycle) |
+    #                                "flash" (rate step x factor during a
+    #                                window — the flash-crowd scenario).
+    #                                The client sends whenever its seeded
+    #                                cumulative-arrival target runs ahead
+    #                                of sent_total, independent of
+    #                                responses (open loop) — backlog, not
+    #                                acks, drives the send schedule.
+    arrival_rate: float = 0.0      # mean arrival rate, txn/s across ALL
+    #                                clients (split per client like
+    #                                load_rate); required > 0 when a
+    #                                process is armed
+    arrival_period_s: float = 1.0  # diurnal sinusoid period / bursty
+    #                                on-off cycle length (seconds)
+    arrival_amp: float = 0.5       # diurnal amplitude fraction in [0, 1):
+    #                                rate(t) = rate * (1 + amp sin wt)
+    arrival_duty: float = 0.5      # bursty: fraction of each period spent
+    #                                ON at rate/duty (mean rate preserved)
+    arrival_flash_at_s: float = 0.0    # flash: burst start, seconds after
+    #                                    the client's run start
+    arrival_flash_secs: float = 0.0    # flash: burst duration (required
+    #                                    > 0 for the flash process)
+    arrival_flash_factor: float = 10.0  # flash: rate multiplier inside
+    #                                     the burst window
+    tenant_cnt: int = 1            # tenants sharing the cluster; each
+    #                                query carries its tenant id in tag
+    #                                bits 24..31 (<= 256 tenants), so the
+    #                                wire format is unchanged and
+    #                                tenant_cnt=1 leaves every tag byte
+    #                                exactly as before
+    tenant_weights: str = ""       # comma-separated arrival weights per
+    #                                tenant ("1,8" = tenant 1 offers 8x
+    #                                tenant 0's load — the aggressor
+    #                                shape); "" = uniform
+    admission: bool = False        # server-side admission control: token-
+    #                                bucket tenant quotas feed a bounded
+    #                                queue ahead of epoch-batch formation;
+    #                                over-quota / over-capacity queries
+    #                                are NACKed (ADMIT_NACK + retry-after
+    #                                hint) instead of held forever.  Off
+    #                                (default): every decoded CL_QRY_BATCH
+    #                                goes straight to pending, no NACK is
+    #                                ever sent, no controller exists.
+    admission_queue_max: int = 8192    # admission queue bound (txns
+    #                                    pending epoch formation); arrivals
+    #                                    past it NACK with a retry hint
+    tenant_quota: float = 0.0      # per-tenant token-bucket rate, txn/s
+    #                                per SERVER (each server meters its own
+    #                                arrivals); 0 = no quota (capacity
+    #                                shedding only)
+    tenant_burst_s: float = 0.5    # bucket depth in seconds of quota
+    #                                (burst tolerance = quota * burst_s)
+    admission_slo_ms: float = 0.0  # admission-queue-delay SLO (p99 per
+    #                                epoch group).  When breached, the
+    #                                controller sheds over-quota tenants
+    #                                FIRST: a tenant whose bucket drained
+    #                                below half depth (it arrives at >=
+    #                                quota) loses its whole batch while
+    #                                quota-respecting tenants keep
+    #                                admitting.  0 = no SLO backpressure.
+    admission_retry_us: float = 50_000.0  # base retry-after hint on a
+    #                                       capacity NACK (quota NACKs
+    #                                       hint the bucket refill time)
+    nack_backoff_base_us: float = 20_000.0  # client backoff ledger: first
+    #                                retry delay; doubles per consecutive
+    #                                NACK of the same tag, jittered
+    #                                +/-50%, floored at the server's
+    #                                retry-after hint
+    nack_backoff_max_us: float = 2_000_000.0  # backoff growth cap
+
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
     checkpoint_path: str = ""      # "" = checkpointing off
@@ -504,6 +583,23 @@ class Config:
             if sep == "-":
                 out[(b, a)] = us
         return out
+
+    def tenant_weights_spec(self) -> list[float]:
+        """Per-tenant arrival weights (normalized); uniform when unset."""
+        if not self.tenant_weights:
+            return [1.0 / self.tenant_cnt] * self.tenant_cnt
+        try:
+            ws = [float(x) for x in self.tenant_weights.split(",")]
+        except ValueError:
+            raise ValueError(
+                f"config: tenant_weights {self.tenant_weights!r} must be "
+                "comma-separated numbers")
+        _check(len(ws) == self.tenant_cnt,
+               f"tenant_weights has {len(ws)} entries for "
+               f"{self.tenant_cnt} tenants")
+        _check(all(w > 0 for w in ws), "tenant_weights must be positive")
+        s = sum(ws)
+        return [w / s for w in ws]
 
     def elastic_plan_spec(self) -> tuple[str, int, int] | None:
         """Parse elastic_plan 'grow|drain:node:epoch' (None when unset)."""
@@ -719,6 +815,59 @@ class Config:
                    and not self.geo_wan_us and self.geo_read_perc == 0.0,
                    "geo_region_cnt/geo_quorum/geo_wan_us/geo_read_perc "
                    "need --geo=true")
+        # ---- overload tier gating (same discipline as elastic/geo:
+        # defaults take the pre-overload paths exactly) ----
+        _check(self.arrival_process in
+               ("", "poisson", "diurnal", "bursty", "flash"),
+               f"bad arrival_process {self.arrival_process!r}")
+        if self.arrival_process:
+            _check(self.arrival_rate > 0,
+                   "an arrival process needs arrival_rate > 0")
+            _check(self.load_rate == 0,
+                   "arrival_process replaces load_rate (open loop vs "
+                   "fixed-budget closed loop); set only one")
+            _check(self.arrival_period_s > 0,
+                   "arrival_period_s must be > 0")
+            _check(0.0 <= self.arrival_amp < 1.0,
+                   "arrival_amp must be in [0, 1)")
+            _check(0.0 < self.arrival_duty <= 1.0,
+                   "arrival_duty must be in (0, 1]")
+            if self.arrival_process == "flash":
+                _check(self.arrival_flash_secs > 0
+                       and self.arrival_flash_at_s >= 0
+                       and self.arrival_flash_factor >= 1.0,
+                       "flash arrivals need arrival_flash_secs > 0, "
+                       "arrival_flash_at_s >= 0 and factor >= 1")
+        else:
+            _check(self.arrival_rate == 0.0,
+                   "arrival_rate needs an arrival_process")
+        _check(1 <= self.tenant_cnt <= 256,
+               "tenant_cnt must be in [1, 256] (tenant ids ride tag "
+               "bits 24..31)")
+        if self.tenant_cnt > 1 or self.tenant_weights:
+            self.tenant_weights_spec()   # raises on a malformed spec
+        if self.admission:
+            _check(self.admission_queue_max >= 64,
+                   "admission_queue_max must be >= 64 (one minimal "
+                   "client message)")
+            _check(self.tenant_quota >= 0 and self.tenant_burst_s > 0,
+                   "tenant_quota must be >= 0 and tenant_burst_s > 0")
+            _check(self.admission_slo_ms >= 0,
+                   "admission_slo_ms must be >= 0")
+            _check(self.admission_retry_us > 0
+                   and self.nack_backoff_base_us > 0
+                   and self.nack_backoff_max_us
+                   >= self.nack_backoff_base_us,
+                   "admission retry/backoff knobs must be positive and "
+                   "nack_backoff_max_us >= nack_backoff_base_us")
+            if self.admission_slo_ms > 0:
+                _check(self.tenant_quota > 0,
+                       "SLO backpressure sheds over-QUOTA tenants first: "
+                       "admission_slo_ms needs tenant_quota > 0")
+        else:
+            _check(self.tenant_quota == 0.0
+                   and self.admission_slo_ms == 0.0,
+                   "tenant_quota/admission_slo_ms need --admission=true")
         if self.elastic and self.fault_kill:
             # failover-with-reassignment: survivors absorb the dead
             # node's slots by log replay — never restart it

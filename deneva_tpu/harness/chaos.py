@@ -141,14 +141,64 @@ SCENARIOS: dict[str, dict] = {
         geo_region_cnt=2, geo_quorum=1, geo_read_perc=0.15,
         geo_wan_us="0-1:40000", replica_cnt=1, logging=True,
         done_secs=5.0, log_dir="/dev/shm/deneva_logs"),
+    # overload robustness tier (runtime/loadgen.py + runtime/
+    # admission.py): open-loop arrival processes against per-tenant
+    # admission control.  Windows stay FULL under --quick like the
+    # elastic/geo families (the PR 4 zero-commit flake class): the
+    # flash burst + post-burst recovery and the backoff re-entry
+    # cadence must all fit INSIDE the measured window on the 2-core CI
+    # box, and a clamped window would report zero post-burst acks.
+    #
+    # flash: x10 open-loop burst at t=2.5s for 1.5s with a small seeded
+    # drop rate layered on (exactly-once must hold under NACK + backoff
+    # re-entry + loss resend + idempotent admission all at once);
+    # admission bounds the queue, NACKs the overflow, and goodput must
+    # recover after the burst (post_flash_ack_cnt).
+    # max_txn_in_flight is raised in all three: the open-loop generator
+    # must be able to flood PAST the server's queue bound (with the
+    # default 1024-cap the client throttle binds first and admission
+    # never sheds — measured on the CI box: depth pinned at the client
+    # cap, zero NACKs)
+    # queue bound 1024 against ~5k/s per-server service (measured on
+    # the CI box): the x10 burst (50k/s offered for 1.5s) outruns the
+    # drain decisively, so the shed path fires thousands of NACKs even
+    # on a fast day — a 2048 bound at 4k/s base shed only ~20 (one slow
+    # epoch group from zero), too close to a variance flake
+    "overload-flash": dict(
+        epoch_batch=256, max_txn_in_flight=16384, admission=True,
+        admission_queue_max=1024, arrival_process="flash",
+        arrival_rate=5000.0, arrival_flash_at_s=2.5,
+        arrival_flash_secs=1.5, arrival_flash_factor=10.0,
+        fault_drop_prob=0.02, fault_resend_us=500_000.0, done_secs=8.0),
+    # aggressor: tenant 1 offers 6x tenant 0's load against equal
+    # per-tenant quotas + the queue-delay SLO; the aggressor must be
+    # throttled (NACK/shed) while the quota-respecting tenant keeps its
+    # service rate and latency
+    "overload-aggressor": dict(
+        epoch_batch=256, max_txn_in_flight=16384, admission=True,
+        admission_queue_max=4096, arrival_process="poisson",
+        arrival_rate=3500.0, tenant_cnt=2, tenant_weights="1,6",
+        tenant_quota=400.0, tenant_burst_s=0.25,
+        admission_slo_ms=200.0, done_secs=6.0),
+    # diurnal: sinusoid wave whose peak crests over steady capacity;
+    # admission keeps the queue bounded through the crest and the
+    # trough drains it — liveness + exactly-once across the wave
+    "overload-diurnal": dict(
+        epoch_batch=256, max_txn_in_flight=16384, admission=True,
+        admission_queue_max=1024, arrival_process="diurnal",
+        arrival_rate=5000.0, arrival_period_s=2.0, arrival_amp=0.8,
+        done_secs=6.0),
 }
 
 # `elastic` on the CLI expands to the three membership scenarios (the
-# tools/smoke.sh elastic gate); `geo` to the geo-replication trio
+# tools/smoke.sh elastic gate); `geo` to the geo-replication trio;
+# `overload` to the admission-control trio
 ELASTIC_SCENARIOS = ("elastic-grow", "elastic-drain",
                      "elastic-kill-reassign")
 GEO_SCENARIOS = ("geo-region-loss", "geo-asymmetric-wan",
                  "geo-replica-lag")
+OVERLOAD_SCENARIOS = ("overload-flash", "overload-aggressor",
+                      "overload-diurnal")
 
 
 class ChaosViolation(AssertionError):
@@ -171,7 +221,7 @@ def run_scenario(name: str, quick: bool = False,
         raise KeyError(f"unknown scenario {name!r} "
                        f"(have {sorted(SCENARIOS)})")
     spec = dict(SCENARIOS[name])
-    if quick and not name.startswith(("elastic-", "geo-")):
+    if quick and not name.startswith(("elastic-", "geo-", "overload-")):
         # elastic scenarios keep their full window: the cutover stall
         # (row stream + boundary sync, 1.4-2.2 s measured on the CI box;
         # ~5 s replay-jit for kill-reassign) would otherwise swallow a
@@ -242,6 +292,8 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
         _check_elastic(name, cfg, out, report)
     if name.startswith("geo-"):
         _check_geo(name, cfg, out, run_id, report)
+    if name.startswith("overload-"):
+        _check_overload(name, cfg, srv, cls, report)
 
 
 def _check_elastic(name: str, cfg: Config, out: dict, report: dict) -> None:
@@ -399,6 +451,73 @@ def _check_geo(name: str, cfg: Config, out: dict, run_id: str,
                                   for v in repl.values())
 
 
+def _check_overload(name: str, cfg: Config, srv: list[dict],
+                    cls: list[dict], report: dict) -> None:
+    """Overload-tier invariants: the admission queue stayed BOUNDED
+    (depth never exceeded the configured cap), shedding actually fired
+    where the scenario oversubscribes, goodput recovered after a flash
+    burst, and per-tenant fairness held under an aggressor — all on top
+    of the global exactly-once check (unique acks <= unique sends, which
+    the NACK + backoff re-entry path must preserve)."""
+    depth_max = max(s.get("adm_queue_depth_max", 0.0) for s in srv)
+    nacks = sum(s.get("adm_nack_cnt", 0.0) + s.get("adm_shed_cnt", 0.0)
+                for s in srv)
+    report["adm_queue_depth_max"] = depth_max
+    report["adm_nacked_total"] = nacks
+    for s in srv:
+        _require("adm_admit_cnt" in s and "adm_queue_depth_max" in s,
+                 f"{name}: a server summary lacks admission accounting")
+        _require(s.get("adm_queue_depth_max", 0.0)
+                 <= cfg.admission_queue_max,
+                 f"{name}: admission queue depth "
+                 f"{s.get('adm_queue_depth_max')} exceeded the bound "
+                 f"{cfg.admission_queue_max}")
+    client_nacks = sum(c.get("nack_cnt", 0.0) for c in cls)
+    report["client_nacks"] = client_nacks
+    report["nack_resends"] = sum(c.get("nack_resend_cnt", 0.0)
+                                 for c in cls)
+    if name == "overload-flash":
+        _require(nacks > 0 and client_nacks > 0,
+                 f"{name}: a x{cfg.arrival_flash_factor} flash crowd "
+                 "was never shed (is admission live?)")
+        post = sum(c.get("post_flash_ack_cnt", 0.0) for c in cls)
+        report["post_flash_acks"] = post
+        _require(post > 0,
+                 f"{name}: no ack after the burst window — goodput "
+                 "never recovered to steady state")
+    if name == "overload-aggressor":
+        # per-tenant fairness: the aggressor (tenant 1, offering 6x) is
+        # throttled; the quota-respecting tenant keeps its service rate
+        # and its latency tail stays BELOW the aggressor's (NACKed-then-
+        # re-entered txns measure from first send, so throttling shows
+        # up exactly there)
+        _require(nacks > 0, f"{name}: the aggressor was never throttled")
+        ratio = []
+        for t in (0, 1):
+            sent = sum(c.get(f"tenant{t}_sent_cnt", 0.0) for c in cls)
+            acked = sum(c.get(f"tenant{t}_acked_cnt", 0.0) for c in cls)
+            _require(sent > 0 and acked > 0,
+                     f"{name}: tenant {t} starved (sent={sent}, "
+                     f"acked={acked})")
+            ratio.append(acked / sent)
+        report["tenant_ack_ratio"] = [round(r, 3) for r in ratio]
+        _require(ratio[0] > ratio[1] + 0.1,
+                 f"{name}: quota tenant's ack ratio {ratio[0]:.2f} not "
+                 f"clearly above the aggressor's {ratio[1]:.2f}")
+        p99 = [max(c.get(f"tenant{t}_latency_p99", 0.0) for c in cls)
+               for t in (0, 1)]
+        report["tenant_p99_s"] = [round(p, 3) for p in p99]
+        _require(p99[0] < p99[1],
+                 f"{name}: quota tenant's p99 {p99[0]:.3f}s not below "
+                 f"the throttled aggressor's {p99[1]:.3f}s")
+    if name == "overload-diurnal":
+        # the wave's crest oversubscribes; the bounded queue + NACKs
+        # must keep every server live through it (commits already
+        # checked identical and > 0 above)
+        _require(all(s.get("adm_admit_cnt", 0.0) > 0 for s in srv),
+                 f"{name}: a server admitted nothing across the wave")
+
+
 def _check_recovery(cfg: Config, out: dict, run_id: str,
                     report: dict) -> None:
     """Safety of the failover path: the killed server recovered by log
@@ -481,7 +600,9 @@ def main(argv: list[str]) -> int:
         names = list(SCENARIOS)
     names = [x for n in names
              for x in (ELASTIC_SCENARIOS if n == "elastic"
-                       else GEO_SCENARIOS if n == "geo" else (n,))]
+                       else GEO_SCENARIOS if n == "geo"
+                       else OVERLOAD_SCENARIOS if n == "overload"
+                       else (n,))]
     rc = 0
     for name in names:
         try:

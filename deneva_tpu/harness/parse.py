@@ -165,6 +165,33 @@ def parse_replication(lines) -> list[dict[str, Any]]:
     return out
 
 
+_ADMIT = re.compile(r"\[admission\] (.*)")
+
+
+def parse_admission(lines) -> list[dict[str, Any]]:
+    """Per-tenant ``[admission]`` lines (runtime/admission.py) ->
+    [{node, tenant, admitted, nacked, shed, ...}].  ``tenant=-1`` rows
+    are node aggregates and additionally carry the queue-delay
+    quantiles (qdelay_p50/p95/p99_ms), depth_max and breach_groups.
+    Logs predating the overload tier yield [] — and every other parser
+    here ignores ``[admission]`` lines — the same forward/backward-
+    compat contract as ``parse_membership``/``parse_replication``
+    (tested in tests/test_harness.py)."""
+    out = []
+    for line in lines:
+        m = _ADMIT.search(line)
+        if not m:
+            continue
+        d: dict[str, Any] = {}
+        for kv in m.group(1).split():
+            if "=" not in kv:
+                continue
+            k, v = kv.split("=", 1)
+            d[k] = _auto(v)
+        out.append(d)
+    return out
+
+
 def cfg_header(cfg: Config) -> str:
     """`# cfg key=value` echo lines the runner prepends to each output file
     so parsing never has to re-derive the config from the filename."""

@@ -39,6 +39,13 @@ _SPAN = re.compile(r"(\w+)=([0-9.]+)ms")
 REPLICATION_SPANS = frozenset(("quorum", "promote", "follower_read",
                                "apply"))
 
+# admission spans (overload tier): the per-group max admission-queue
+# delay ("adm_wait") is a latency ledger like the replication spans —
+# the Chrome-trace export lays it on its own per-node "admission"
+# thread track (tid 2) so a backpressure episode shows up as a
+# widening band beside the phase track, never inside it.
+ADMISSION_SPANS = frozenset(("adm_wait",))
+
 
 def parse_timeline(lines) -> list[dict]:
     """[{node, epoch, phases: {name: ms}}] from raw log lines."""
@@ -85,9 +92,11 @@ def chrome_trace(rows: list[dict]) -> dict:
     events: list[dict] = []
     clock: dict[int, float] = {}          # node -> phase track time (us)
     rclock: dict[int, float] = {}         # node -> replication track time
+    aclock: dict[int, float] = {}         # node -> admission track time
     for r in rows:
         t = clock.get(r["node"], 0.0)
         rt = rclock.get(r["node"], 0.0)
+        at = aclock.get(r["node"], 0.0)
         for name, ms in r["phases"].items():
             dur = ms * 1000.0
             if name in REPLICATION_SPANS:
@@ -103,6 +112,16 @@ def chrome_trace(rows: list[dict]) -> dict:
                 # tid-1 event, even if all its spans are 0.0 ms
                 rclock.setdefault(r["node"], 0.0)
                 continue
+            if name in ADMISSION_SPANS:
+                # admission spans: same latency-ledger treatment on a
+                # third track (tid 2, "admission")
+                events.append({"name": name, "ph": "X", "pid": r["node"],
+                               "tid": 2, "ts": round(at, 3),
+                               "dur": round(dur, 3), "cat": "admission",
+                               "args": {"epoch": r["epoch"]}})
+                at += dur
+                aclock.setdefault(r["node"], 0.0)
+                continue
             events.append({"name": name, "ph": "X", "pid": r["node"],
                            "tid": 0, "ts": round(t, 3),
                            "dur": round(dur, 3),
@@ -111,10 +130,14 @@ def chrome_trace(rows: list[dict]) -> dict:
         clock[r["node"]] = t
         if r["node"] in rclock:
             rclock[r["node"]] = rt
+        if r["node"] in aclock:
+            aclock[r["node"]] = at
     meta = [{"name": "process_name", "ph": "M", "pid": n, "tid": 0,
              "args": {"name": f"node {n}"}} for n in sorted(clock)]
     meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": 1,
               "args": {"name": "replication"}} for n in sorted(rclock)]
+    meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": 2,
+              "args": {"name": "admission"}} for n in sorted(aclock)]
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 

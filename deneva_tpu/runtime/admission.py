@@ -1,0 +1,262 @@
+"""Per-tenant admission control + SLO backpressure (overload tier).
+
+The reference server admits unconditionally: `new_txn_queue` grows
+without bound and an overloaded node starves every client equally
+(SURVEY §3.A — there is no shedding point at all).  Here the epoch
+batch IS the natural shedding point (DGCC decides contention handling
+at batch-formation time the same way): a bounded admission queue sits
+AHEAD of epoch-batch formation, fed through per-tenant token buckets,
+and anything over quota or over capacity is answered with an
+``ADMIT_NACK`` carrying a retry-after hint instead of being held
+forever.  Three layers, applied in order to each arriving batch:
+
+1. **SLO shed** — when the previous epoch group's admission-queue delay
+   p99 breached ``admission_slo_ms``, every tenant whose bucket is
+   exhausted (it has been burning tokens at >= quota) loses its WHOLE
+   batch.  Over-quota tenants shed first, so a quota-respecting tenant
+   keeps its SLO while the aggressor is throttled.
+2. **quota** — rows past the tenant's available tokens NACK with a
+   retry-after hint sized to the bucket refill time of the deficit.
+3. **capacity** — admitted rows past ``admission_queue_max`` NACK with
+   the base retry hint (in arrival order, after the quota layer, so
+   over-quota rows never displace in-quota ones).
+
+Everything is vectorized numpy over the batch; with ``admission=false``
+(default) none of this is constructed and the server's `_route` takes
+the pre-overload path byte for byte.
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import deque
+
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.runtime.loadgen import tenant_of_tags
+from deneva_tpu.stats import StatsArr
+
+# ---- ADMIT_NACK codec --------------------------------------------------
+# tags (int64[n]) + per-tag retry-after hints (uint32[n], microseconds).
+# Per-tag hints, not one scalar: a mixed batch NACKs different tenants
+# for different reasons (bucket refill vs queue pressure) and the client
+# ledger floors each tag's backoff on its own hint.
+
+_NACK_HDR = struct.Struct("<II")       # n, pad
+
+
+def encode_admit_nack(tags: np.ndarray, retry_us: np.ndarray) -> bytes:
+    tags = np.ascontiguousarray(tags, np.int64)
+    retry = np.ascontiguousarray(retry_us, np.uint32)
+    return _NACK_HDR.pack(len(tags), 0) + tags.tobytes() + retry.tobytes()
+
+
+def decode_admit_nack(buf: bytes) -> tuple[np.ndarray, np.ndarray]:
+    n, _ = _NACK_HDR.unpack_from(buf)
+    tags = np.frombuffer(buf, np.int64, count=n, offset=_NACK_HDR.size)
+    retry = np.frombuffer(buf, np.uint32, count=n,
+                          offset=_NACK_HDR.size + 8 * n)
+    return tags, retry
+
+
+def admit_nack_parts(tags: np.ndarray, retry_us: np.ndarray) -> list:
+    """ADMIT_NACK as sendv parts; concatenated == encode_admit_nack."""
+    return [_NACK_HDR.pack(len(tags), 0),
+            np.ascontiguousarray(tags, np.int64),
+            np.ascontiguousarray(retry_us, np.uint32)]
+
+
+# NACK reasons (per-row verdicts inside admit(); reason 0 = admitted)
+R_ADMIT, R_SLO, R_QUOTA, R_CAP = 0, 1, 2, 3
+
+
+def _cumcount(x: np.ndarray, width: int) -> np.ndarray:
+    """0-based occurrence index of each row within its value class
+    (order-preserving; the vectorized groupby-cumcount)."""
+    if not len(x):
+        return np.zeros(0, np.int64)
+    counts = np.bincount(x, minlength=width)
+    starts = np.zeros(width, np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    order = np.argsort(x, kind="stable")
+    ranks = np.empty(len(x), np.int64)
+    ranks[order] = np.arange(len(x), dtype=np.int64)
+    return ranks - starts[x]
+
+
+class AdmissionController:
+    """Token buckets + bounded queue + SLO ledger for one server.
+
+    Mutated only from the dispatch thread (`_route` admits, the
+    contribution paths pop, the epoch loop ticks groups) — same
+    ownership discipline as `pending` itself.
+    """
+
+    def __init__(self, cfg: Config, now_us: int):
+        self.T = max(1, cfg.tenant_cnt)
+        self.quota = float(cfg.tenant_quota)           # tokens / second
+        self.burst = max(self.quota * cfg.tenant_burst_s, 1.0)
+        self.tokens = np.full(self.T, self.burst, np.float64)
+        self._last_us = now_us
+        self.queue_max = int(cfg.admission_queue_max)
+        self.slo_us = cfg.admission_slo_ms * 1e3
+        self.retry_us = float(cfg.admission_retry_us)
+        self.depth = 0
+        self.depth_max = 0
+        self.slo_breached = False
+        self.breach_groups = 0
+        # per-tenant counters ([admission] lines + [summary])
+        self.admitted = np.zeros(self.T, np.int64)
+        self.nacked = np.zeros(self.T, np.int64)      # quota + capacity
+        self.shed = np.zeros(self.T, np.int64)        # SLO shed
+        # queue-delay ledger: FIFO of (enqueue us, rows) mirrors the
+        # pending deque's txn order (pops are FIFO by construction)
+        self._enq: deque[list] = deque()
+        self._group_delay: list[tuple[float, int]] = []   # (us, weight)
+        self._group_max_us = 0.0
+        self.delay_ms = StatsArr()       # cumulative, weighted (ms)
+
+    # -- token buckets ---------------------------------------------------
+    def _refill(self, now_us: int) -> None:
+        if self.quota <= 0:
+            return
+        dt = max(now_us - self._last_us, 0) * 1e-6
+        self._last_us = now_us
+        np.minimum(self.tokens + self.quota * dt, self.burst,
+                   out=self.tokens)
+
+    # -- the admission decision ------------------------------------------
+    def admit(self, tags: np.ndarray, now_us: int
+              ) -> tuple[np.ndarray, np.ndarray]:
+        """Per-row verdicts for one arriving batch.
+
+        Returns ``(reason int8[n], retry_us int64[n])`` — reason 0 rows
+        are admitted (and their tokens charged, queue depth counted);
+        the caller enqueues exactly those rows and NACKs the rest with
+        the per-row retry hints."""
+        n = len(tags)
+        reason = np.zeros(n, np.int8)
+        retry = np.zeros(n, np.int64)
+        self._refill(now_us)
+        # clamp: a tenant id past the configured count (mismatched
+        # client config) meters against the last bucket instead of
+        # indexing out of bounds
+        ten = np.minimum(tenant_of_tags(tags), self.T - 1)
+        if self.quota > 0:
+            grant = np.floor(self.tokens).astype(np.int64)
+            if self.slo_breached:
+                # shed over-quota tenants FIRST: a bucket drained below
+                # half depth means the tenant has been arriving at
+                # >= quota (a respecting tenant's net refill keeps its
+                # bucket pegged near full) — under a breached SLO its
+                # whole batch sheds, refill trickle included, so
+                # in-quota tenants keep their latency
+                agg = self.tokens < 0.5 * self.burst
+                shed_rows = agg[ten]
+                reason[shed_rows] = R_SLO
+            pos = _cumcount(ten, self.T)
+            over = (pos >= grant[ten]) & (reason == R_ADMIT)
+            reason[over] = R_QUOTA
+            # retry hint: refill time of each row's token deficit
+            deficit = (pos - grant[ten] + 1).clip(min=1)
+            hint = (deficit * 1e6 / self.quota).astype(np.int64)
+            nq = reason != R_ADMIT
+            retry[nq] = np.maximum(hint[nq], int(self.retry_us))
+        # capacity: admitted rows past the queue bound NACK in arrival
+        # order (over-quota rows are already out, so they never displace
+        # in-quota ones)
+        adm = reason == R_ADMIT
+        room = self.queue_max - self.depth
+        if int(adm.sum()) > room:
+            k = np.cumsum(adm)
+            overflow = adm & (k > room)
+            reason[overflow] = R_CAP
+            retry[overflow] = int(self.retry_us)
+            adm = reason == R_ADMIT
+        n_adm = int(adm.sum())
+        if self.quota > 0 and n_adm:
+            self.tokens -= np.bincount(ten[adm], minlength=self.T)
+        self.depth += n_adm
+        self.depth_max = max(self.depth_max, self.depth)
+        if n_adm:
+            self._enq.append([now_us, n_adm])
+        np.add.at(self.admitted, ten[adm], 1)
+        np.add.at(self.shed, ten[reason == R_SLO], 1)
+        quota_cap = (reason == R_QUOTA) | (reason == R_CAP)
+        np.add.at(self.nacked, ten[quota_cap], 1)
+        return reason, retry
+
+    # -- queue-delay ledger ----------------------------------------------
+    def on_pop(self, n: int, now_us: int) -> None:
+        """``n`` txns left the pending queue for epoch formation: pop
+        the enqueue FIFO and record their queue delays (weighted)."""
+        self.depth = max(self.depth - n, 0)
+        while n > 0 and self._enq:
+            ent = self._enq[0]
+            take = min(n, ent[1])
+            d = float(now_us - ent[0])
+            self._group_delay.append((d, take))
+            if d > self._group_max_us:
+                self._group_max_us = d
+            ent[1] -= take
+            n -= take
+            if ent[1] == 0:
+                self._enq.popleft()
+
+    def on_group(self) -> float:
+        """Per-group SLO tick: fold this group's delay samples into the
+        cumulative ledger, re-evaluate the breach state, and return the
+        group's max queue delay in ms (the timeline span width)."""
+        max_ms = self._group_max_us / 1e3
+        if self._group_delay:
+            d = np.asarray([x for x, _ in self._group_delay])
+            w = np.asarray([c for _, c in self._group_delay],
+                           np.float64)
+            self.delay_ms.extend(d / 1e3, w)
+            if self.slo_us > 0:
+                order = np.argsort(d, kind="stable")
+                cum = np.cumsum(w[order])
+                idx = int(np.searchsorted(cum, 0.99 * cum[-1]))
+                p99 = float(d[order][min(idx, len(d) - 1)])
+                self.slo_breached = p99 > self.slo_us
+                if self.slo_breached:
+                    self.breach_groups += 1
+        elif self.depth == 0:
+            # an empty, idle queue cannot be breaching; with depth > 0
+            # and no pops the previous verdict stands (stalled queue)
+            self.slo_breached = False
+        self._group_delay.clear()
+        self._group_max_us = 0.0
+        return max_ms
+
+    # -- reporting --------------------------------------------------------
+    def summary_into(self, st) -> None:
+        st.set("adm_admit_cnt", float(self.admitted.sum()))
+        st.set("adm_nack_cnt", float(self.nacked.sum()))
+        st.set("adm_shed_cnt", float(self.shed.sum()))
+        st.set("adm_queue_depth_max", float(self.depth_max))
+        st.set("adm_slo_breach_groups", float(self.breach_groups))
+        if len(self.delay_ms):
+            st.arr("adm_queue_delay_ms").merge_from(self.delay_ms)
+
+    def admission_lines(self, node: int) -> list[str]:
+        """Per-tenant ``[admission]`` lines + one node aggregate (the
+        ``parse_admission`` contract, mirroring ``[membership]`` /
+        ``[replication]``)."""
+        q = self.delay_ms.percentiles((50, 95, 99))
+        out = [f"[admission] node={node} tenant=-1 "
+               f"admitted={int(self.admitted.sum())} "
+               f"nacked={int(self.nacked.sum())} "
+               f"shed={int(self.shed.sum())} "
+               f"qdelay_p50_ms={q['p50']:.3f} "
+               f"qdelay_p95_ms={q['p95']:.3f} "
+               f"qdelay_p99_ms={q['p99']:.3f} "
+               f"depth_max={self.depth_max} "
+               f"breach_groups={self.breach_groups}"]
+        for t in range(self.T):
+            out.append(f"[admission] node={node} tenant={t} "
+                       f"admitted={int(self.admitted[t])} "
+                       f"nacked={int(self.nacked[t])} "
+                       f"shed={int(self.shed[t])}")
+        return out

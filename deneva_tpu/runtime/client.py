@@ -67,8 +67,42 @@ class ClientNode:
             self.tp.set_fault(cfg.fault_drop_prob, cfg.fault_dup_prob,
                               cfg.fault_delay_jitter_us,
                               seed=cfg.fault_seed + 7919 * cfg.node_id)
-        self._unacked = (np.zeros(TAG_RING, bool) if self._fault_mode
-                         else None)
+        # ---- overload tier (runtime/loadgen.py + runtime/admission.py):
+        # open-loop arrival schedule, per-query tenant ids in tag bits
+        # 24..31, and the ADMIT_NACK backoff ledger.  All gated off on a
+        # default config: no arrival process, tenant_cnt=1 writes no tag
+        # bits, admission=false means no NACK ever arrives. ----
+        self._adm = cfg.admission
+        self._arrival = None
+        self._flash_end_us: float | None = None
+        if cfg.arrival_process:
+            from deneva_tpu.runtime.loadgen import ArrivalSchedule
+            self._arrival = ArrivalSchedule(cfg, cfg.node_id)
+        self._ledger = None
+        self._nacked = None
+        if self._adm:
+            from deneva_tpu.runtime.loadgen import BackoffLedger
+            self._ledger = BackoffLedger(
+                TAG_RING, cfg.nack_backoff_base_us,
+                cfg.nack_backoff_max_us,
+                cfg.seed + 104729 * cfg.node_id)
+            self._nacked = np.zeros(TAG_RING, bool)
+            # sweep at half the base backoff, floored at 10 ms: the
+            # sweep coalesces everything ready, so a coarse cadence
+            # costs at most one tick of extra delay and keeps re-entry
+            # traffic in few large batches
+            self._bo_sweep_us = max(int(cfg.nack_backoff_base_us) // 2,
+                                    10_000)
+            self._bo_next_us = 0
+        self._nack_cnt = 0
+        self._nack_resend_cnt = 0
+        self._post_flash_acks = 0
+        self._backlog_max = 0
+        # the unacked bitmap serves BOTH repair paths: fault-mode resend
+        # (loss) and admission backoff (NACK) key their freshness and
+        # exactly-once filters on it
+        self._unacked = (np.zeros(TAG_RING, bool)
+                         if (self._fault_mode or self._adm) else None)
         self._resend_q: deque[tuple[int, int, wire.QueryBlock]] = deque()
         self._resend_us = int(cfg.fault_resend_us)
         # resend sweeps amortize across ticks: walking the queue every
@@ -185,6 +219,20 @@ class ClientNode:
         self.type_names = list(getattr(self.wl, "txn_type_names",
                                        ("txn",)))
         self.tag_type = np.zeros(TAG_RING, np.uint8)
+        # per-query tenant ids (overload tier): seeded per-ring-block
+        # columns from the configured weights; each tag remembers its
+        # tenant so acks feed tenant{t}_latency percentiles and the
+        # fairness counters.  tenant_cnt=1 (default) builds none of it.
+        self.ring_tenants: list[np.ndarray] | None = None
+        if cfg.tenant_cnt > 1:
+            from deneva_tpu.runtime.loadgen import tenant_column
+            w = np.asarray(cfg.tenant_weights_spec())
+            trng = np.random.default_rng(
+                (cfg.seed + 15485863 * cfg.node_id) & 0x7FFFFFFF)
+            self.ring_tenants = [tenant_column(trng, w, self.chunk)
+                                 for _ in range(n_pregen)]
+            self.tag_tenant = np.zeros(TAG_RING, np.uint8)
+            self._tenant_sent = np.zeros(cfg.tenant_cnt, np.int64)
 
     # ------------------------------------------------------------------
     def _route(self, src: int, rtype: str, payload: bytes,
@@ -192,7 +240,7 @@ class ClientNode:
         if rtype == "CL_RSP":
             tags = wire.decode_cl_rsp(payload)
             now = time.monotonic_ns() // 1000
-            if self._fault_mode:
+            if self._unacked is not None:
                 # exactly-once accounting under dup/replay: accept each
                 # tag's FIRST ack only — a duplicated CL_RSP or a
                 # re-ack answering our own resend must not double-count
@@ -204,15 +252,32 @@ class ClientNode:
                     if not len(tags):
                         return
                 self._unacked[tags % TAG_RING] = False
+            # inflight credit: a tag whose NACK already released its
+            # credit (the NACK-then-late-CL_RSP race: a duplicate of the
+            # query was NACKed while the original went on to commit)
+            # must not release it twice — the ack retires the tag but
+            # only non-NACKed tags still hold a charge
+            rel = tags
+            if self._nacked is not None:
+                nk = self._nacked[tags % TAG_RING]
+                if nk.any():
+                    self._nacked[tags % TAG_RING] = False
+                    rel = tags[~nk]
+                self._ledger.reset(tags)
+            if (self._flash_end_us is not None
+                    and now >= self._flash_end_us):
+                # post-burst recovery ledger: acks landing after the
+                # flash window prove goodput came back
+                self._post_flash_acks += len(tags)
             if self._tag_srv is not None:
                 # release each tag's credit from the server it is
                 # charged to (may differ from the answering server
                 # after a retarget)
                 self.inflight -= np.bincount(
-                    self._tag_srv[tags % TAG_RING], minlength=self.n_srv
+                    self._tag_srv[rel % TAG_RING], minlength=self.n_srv
                 )[: self.n_srv]
             else:
-                self.inflight[src] -= len(tags)   # src is a server id
+                self.inflight[src] -= len(rel)   # src is a server id
             slot = tags % TAG_RING
             vals = (now - self.send_us[slot]) / 1e6     # seconds
             # append each sample ONCE, into its type family — the
@@ -229,7 +294,40 @@ class ClientNode:
                     m = tt == t
                     self.stats.arr(
                         f"{self.type_names[t]}_latency").extend(vals[m])
+            if self.ring_tenants is not None:
+                # per-tenant latency families (overload tier): the
+                # aggressor/fairness invariants compare these — samples
+                # go ONLY into tenant arrays here, the combined series
+                # is already fed by the type families above
+                tn = self.tag_tenant[slot]
+                for t in np.unique(tn):
+                    m = tn == t
+                    self.stats.arr(f"tenant{t}_latency").extend(vals[m])
             self.stats.incr("txn_cnt", len(tags))
+        elif rtype == "ADMIT_NACK":
+            from deneva_tpu.runtime.admission import decode_admit_nack
+            tags, retry = decode_admit_nack(payload)
+            slot = tags % TAG_RING
+            # freshness: only outstanding, not-already-NACKed tags carry
+            # a charge to release (a duplicated NACK, or one racing the
+            # ack of an admitted copy, must be a no-op)
+            fresh = self._unacked[slot] & ~self._nacked[slot]
+            if not fresh.all():
+                tags, retry, slot = tags[fresh], retry[fresh], slot[fresh]
+            if not len(tags):
+                return
+            self._nacked[slot] = True
+            self._nack_cnt += len(tags)
+            now_us = time.monotonic_ns() // 1000
+            if self._tag_srv is not None:
+                self.inflight -= np.bincount(
+                    self._tag_srv[slot], minlength=self.n_srv
+                )[: self.n_srv]
+            else:
+                self.inflight[src] -= len(tags)
+            # re-entry rides the backoff ledger (exponential + jitter,
+            # floored at the server's per-tag retry-after hints)
+            self._ledger.nack(src, tags, retry, now_us)
         elif rtype == "REGION_READ_RSP":
             tag, boundary, vals, vers = \
                 self._georepl.decode_region_read_rsp(payload)
@@ -261,8 +359,12 @@ class ClientNode:
         elif rtype == "SHUTDOWN":
             self.stop = True
 
-    def _drain(self, lat_arr, timeout_us: int = 0) -> None:
-        while True:
+    def _drain(self, lat_arr, timeout_us: int = 0,
+               max_msgs: int = 4096) -> None:
+        # bounded like the server's _drain: under an overload NACK storm
+        # the recv queue may never go dry, and the send/sweep half of
+        # the loop must keep running (the hot loop re-calls every tick)
+        for _ in range(max_msgs):
             m = self.tp.recv(timeout_us)
             if m is None:
                 return
@@ -286,6 +388,11 @@ class ClientNode:
         while self._resend_q and now - self._resend_q[0][0] >= self._resend_us:
             _, srv, blk = self._resend_q.popleft()
             alive = self._unacked[blk.tags % TAG_RING]
+            if self._nacked is not None:
+                # NACKed tags are the backoff ledger's to re-enter (it
+                # re-appends them here once resent); sweeping them too
+                # would re-offer a query the server just shed
+                alive = alive & ~self._nacked[blk.tags % TAG_RING]
             if not alive.any():
                 continue
             sub = blk if alive.all() else blk.take(np.where(alive)[0])
@@ -308,6 +415,65 @@ class ClientNode:
                                                sub.types, sub.scalars))
             self._resend_cnt += len(sub)
             self._resend_q.append((now, srv, sub))
+
+    def _backoff_sweep(self, now_us: int) -> None:
+        """Re-enter NACKed tags whose backoff expired: fresh rows from
+        the pre-generated ring under the SAME tags (the tag, not the row
+        values, is the txn's identity — a NACKed query was never
+        admitted anywhere), re-charging the inflight credit the NACK
+        released.  Everything ready this sweep COALESCES into chunk-
+        sized batches per server: ledger entries fragment as batches
+        re-NACK (each cycle splits on the spread of fresh retry hints),
+        and sending them one entry at a time degenerated into a tiny-
+        message storm that crawled the 2-core cluster's epoch loop.  In
+        fault mode the resent batches join the resend queue so a lost
+        re-entry is repaired like any other loss."""
+        ready = self._ledger.pop_ready(now_us)
+        if not ready:
+            return
+        by_srv: dict[int, list] = {}
+        for srv, tags in ready:
+            if self._elastic and not self._active[srv]:
+                # original target drained or died: re-enter via an owner
+                act = np.where(self._active)[0]
+                if not len(act):
+                    # nobody to target — push back, try next sweep
+                    self._ledger.nack(srv, tags,
+                                      np.full(len(tags), 50_000,
+                                              np.uint32), now_us)
+                    continue
+                srv = int(act[self._rr % len(act)])
+                self._rr += 1
+            by_srv.setdefault(srv, []).append(tags)
+        for srv, tag_lists in by_srv.items():
+            tags = np.concatenate(tag_lists)
+            slot = tags % TAG_RING
+            live = self._unacked[slot] & self._nacked[slot]
+            if not live.all():
+                tags, slot = tags[live], slot[live]
+            for lo in range(0, len(tags), self.chunk):
+                part = tags[lo:lo + self.chunk]
+                pslot = slot[lo:lo + self.chunk]
+                n = len(part)
+                blk = self.ring[self.ring_pos]
+                # the replacement rows carry the fresh block's txn types:
+                # re-stamp the tag->type map or the ack's latency sample
+                # lands in the ORIGINAL rows' type family
+                self.tag_type[pslot] = self.ring_types[self.ring_pos][:n]
+                self.ring_pos = (self.ring_pos + 1) % len(self.ring)
+                self._nacked[pslot] = False
+                self.inflight[srv] += n
+                if self._tag_srv is not None:
+                    self._tag_srv[pslot] = srv
+                self.tp.sendv(srv, "CL_QRY_BATCH",
+                              wire.qry_block_parts(part, blk.keys[:n],
+                                                   blk.types[:n],
+                                                   blk.scalars[:n]))
+                if self._fault_mode:
+                    self._resend_q.append((now_us, srv, wire.QueryBlock(
+                        blk.keys[:n], blk.types[:n], blk.scalars[:n],
+                        part)))
+                self._nack_resend_cnt += n
 
     # -- geo tier: nearest-primary writes + follower snapshot reads -----
     def _geo_write_targets(self) -> list[int]:
@@ -377,10 +543,23 @@ class ClientNode:
         # LOAD_RATE budget (reference client_thread.cpp:35-41,70-91)
         rate = cfg.load_rate / max(cfg.client_node_cnt, 1)
         t_start = time.monotonic()
+        if self._arrival is not None:
+            fe = self._arrival.flash_end()
+            if fe is not None:
+                self._flash_end_us = (t_start + fe) * 1e6
         sent_total = 0
         iota = np.arange(self.chunk, dtype=np.int64)   # reusable tag base
         while not self.stop:
             progressed = False
+            # open-loop arrivals: the seeded schedule, not acks, drives
+            # the send budget — a stalled server grows the backlog
+            # (visible as backlog_max) instead of throttling the load
+            backlog = None
+            if self._arrival is not None:
+                backlog = self._arrival.target(
+                    time.monotonic() - t_start) - sent_total
+                if backlog > self._backlog_max:
+                    self._backlog_max = backlog
             # vectorized admission: per-server send budgets for this
             # whole tick in one pass (the per-send path below touches
             # no Python-level min/int bookkeeping)
@@ -403,7 +582,11 @@ class ClientNode:
                 n = int(budgets[srv])
                 if n < 64:                      # not worth a message yet
                     continue
-                if rate:
+                if backlog is not None:
+                    if backlog < 64:            # schedule has no arrivals
+                        break                   # worth a message yet
+                    n = min(n, backlog)
+                elif rate:
                     budget = int(rate * (time.monotonic() - t_start)) \
                         - sent_total
                     if budget <= 0:
@@ -417,23 +600,42 @@ class ClientNode:
                 self.next_tag = int(tags[-1]) + 1
                 self.send_us[tags] = now
                 self.tag_type[tags] = blk_types[:n]
+                wtags = tags
+                if self.ring_tenants is not None:
+                    # tenant ids ride tag bits 24..31; the lane (low
+                    # bits) keeps indexing every per-tag ring below
+                    from deneva_tpu.runtime.loadgen import pack_tenant
+                    tcol = self.ring_tenants[
+                        (self.ring_pos - 1) % len(self.ring)][:n]
+                    wtags = pack_tenant(tags, tcol)
+                    self.tag_tenant[tags] = tcol
+                    self._tenant_sent += np.bincount(
+                        tcol, minlength=len(self._tenant_sent))
                 # scatter-send straight from the pre-generated ring
                 # columns (row slices stay C-contiguous): the per-send
                 # codec pass — the client's dominant per-message cost —
                 # is gone; the native layer frames header+tags+columns
                 self.tp.sendv(srv, "CL_QRY_BATCH",
-                              wire.qry_block_parts(tags, blk.keys[:n],
+                              wire.qry_block_parts(wtags, blk.keys[:n],
                                                    blk.types[:n],
                                                    blk.scalars[:n]))
-                if self._fault_mode:
+                if self._unacked is not None:
                     self._unacked[tags] = True
+                    if self._nacked is not None:
+                        # reissued lane hygiene: stale NACK state from a
+                        # previous ring lap must not leak into this tag
+                        self._nacked[tags] = False
+                        self._ledger.reset(tags)
                     if self._tag_srv is not None:
                         self._tag_srv[tags] = srv
-                    self._resend_q.append((now, srv, wire.QueryBlock(
-                        blk.keys[:n], blk.types[:n], blk.scalars[:n],
-                        tags)))
+                    if self._fault_mode:
+                        self._resend_q.append((now, srv, wire.QueryBlock(
+                            blk.keys[:n], blk.types[:n], blk.scalars[:n],
+                            wtags)))
                 self.inflight[srv] += n
                 sent_total += n
+                if backlog is not None:
+                    backlog -= n
                 progressed = True
             if self._geo and self.cfg.geo_read_perc > 0:
                 self._issue_follower_reads(sent_total,
@@ -443,6 +645,11 @@ class ClientNode:
                 if now_us >= self._sweep_next_us:
                     self._resend_sweep()
                     self._sweep_next_us = now_us + self._sweep_every_us
+            if self._ledger is not None:
+                now_us = time.monotonic_ns() // 1000
+                if now_us >= self._bo_next_us:
+                    self._backoff_sweep(now_us)
+                    self._bo_next_us = now_us + self._bo_sweep_us
             self._drain(lat, timeout_us=0 if progressed else 2_000)
         # drain trailing responses so server-side commits are counted
         t_end = time.monotonic() + 0.3
@@ -463,6 +670,23 @@ class ClientNode:
             st.set("resend_cnt", float(self._resend_cnt))
             st.set("dup_ack_cnt", float(self._dup_acks))
             st.set("unacked_cnt", float(int(self._unacked.sum())))
+        if self._adm:
+            st.set("nack_cnt", float(self._nack_cnt))
+            st.set("nack_resend_cnt", float(self._nack_resend_cnt))
+            st.set("backoff_pending_cnt", float(len(self._ledger)))
+        if self._arrival is not None:
+            st.set("arrival_target_cnt", float(
+                self._arrival.target(time.monotonic() - t_start)))
+            st.set("backlog_max", float(self._backlog_max))
+            if self._flash_end_us is not None:
+                st.set("post_flash_ack_cnt", float(self._post_flash_acks))
+        if self.ring_tenants is not None:
+            for t in range(len(self._tenant_sent)):
+                st.set(f"tenant{t}_sent_cnt",
+                       float(self._tenant_sent[t]))
+                a = st.arrays.get(f"tenant{t}_latency")
+                st.set(f"tenant{t}_acked_cnt",
+                       float(len(a)) if a is not None else 0.0)
         if self._elastic:
             st.set("map_version", float(self._map_version))
             st.set("redirect_resend_cnt", float(self._redirect_resends))

@@ -1,0 +1,216 @@
+"""Open-loop load generation + NACK backoff ledger (overload tier).
+
+The reference client only saturates a fixed inflight window
+(`client_txn.cpp:25-46`) or meters a flat LOAD_RATE budget — both
+CLOSED loops: a slow server slows the offered load, which hides every
+overload behavior worth measuring.  This module supplies the open-loop
+half: a seeded, deterministic **cumulative-arrival target** ``N(t)``
+the client chases regardless of responses, in four shapes —
+
+* ``poisson``  steady Poisson arrivals (seeded exponential gaps);
+* ``diurnal``  sinusoid-modulated rate ``r(t) = rate (1 + A sin wt)``
+  (the day/night curve, integrated in closed form);
+* ``bursty``   on/off duty cycle at ``rate/duty`` during the ON
+  fraction of each period (mean rate preserved);
+* ``flash``    a rate step ``x factor`` inside one window — the
+  flash-crowd scenario the admission tier must absorb.
+
+All four are pure functions of elapsed time + the seed, so a scenario
+re-runs identically.  ``tenant_column`` draws per-query tenant ids from
+the configured weights with the same determinism.
+
+``BackoffLedger`` is the client half of the ADMIT_NACK protocol: a
+NACKed tag re-enters after ``max(retry_after, base * 2^(attempt-1))``
+jittered +/-50% (seeded) and capped — retry-after is a FLOOR (the
+server knows when the bucket refills), the exponential is the pressure
+valve when NACKs repeat.
+"""
+
+from __future__ import annotations
+
+import heapq
+import math
+
+import numpy as np
+
+from deneva_tpu.config import Config
+
+# tenant id rides tag bits 24..31: client lane tags live below 2^22
+# (client.TAG_RING) and the server packs its own client id at bit 40,
+# so this byte is free on every path — tenant_cnt=1 writes nothing and
+# the tag bytes stay exactly the pre-overload ones
+TENANT_SHIFT = 24
+TENANT_MASK = 0xFF
+
+
+def tenant_of_tags(tags: np.ndarray) -> np.ndarray:
+    """Tenant ids out of wire tags (int64 array in, uint8-range out)."""
+    return ((tags >> TENANT_SHIFT) & TENANT_MASK).astype(np.int64)
+
+
+def pack_tenant(tags: np.ndarray, tenants: np.ndarray) -> np.ndarray:
+    """Lane tags + tenant column -> wire tags (lane | tenant << 24)."""
+    return tags | (tenants.astype(np.int64) << TENANT_SHIFT)
+
+
+def tenant_column(rng: np.random.Generator, weights: np.ndarray,
+                  n: int) -> np.ndarray:
+    """``n`` seeded tenant draws from the weight vector (uint8)."""
+    return rng.choice(len(weights), size=n, p=weights).astype(np.uint8)
+
+
+class ArrivalSchedule:
+    """Deterministic cumulative-arrival target ``target(t) -> int``.
+
+    The client sends whenever ``target(elapsed) > sent_total`` — the
+    open loop: a stalled server grows the backlog instead of throttling
+    the offered load.  The per-client rate is ``arrival_rate`` split
+    evenly across clients (the LOAD_RATE convention).
+    """
+
+    def __init__(self, cfg: Config, node_id: int):
+        self.kind = cfg.arrival_process
+        self.rate = cfg.arrival_rate / max(cfg.client_node_cnt, 1)
+        self.period = cfg.arrival_period_s
+        self.amp = cfg.arrival_amp
+        self.duty = cfg.arrival_duty
+        self.flash_at = cfg.arrival_flash_at_s
+        self.flash_secs = cfg.arrival_flash_secs
+        self.flash_factor = cfg.arrival_flash_factor
+        if self.kind == "poisson":
+            # seeded exponential gaps, pre-generated in chunks and
+            # extended lazily past the queried horizon; the consumed
+            # prefix is COUNTED and dropped (queries ride the open
+            # loop's elapsed clock, which is monotone), so memory and
+            # per-call work stay O(chunk) over any run length
+            self._rng = np.random.default_rng(
+                (cfg.seed + 7919 * node_id) & 0x7FFFFFFF)
+            self._times = np.zeros(0, np.float64)
+            self._t_last = 0.0
+            self._done = 0
+
+    # -- closed-form integrals of the rate function ---------------------
+    def _lam(self, t: float) -> float:
+        """Expected cumulative arrivals through elapsed time ``t``."""
+        r = self.rate
+        if self.kind == "diurnal":
+            w = 2.0 * math.pi / self.period
+            return r * t + r * self.amp / w * (1.0 - math.cos(w * t))
+        if self.kind == "bursty":
+            on = self.period * self.duty
+            full, rem = divmod(t, self.period)
+            return r * self.period * full + r / self.duty * min(rem, on)
+        if self.kind == "flash":
+            burst = min(max(t - self.flash_at, 0.0), self.flash_secs)
+            return r * t + (self.flash_factor - 1.0) * r * burst
+        return r * t          # steady (poisson uses sampled gaps)
+
+    def _extend_poisson(self, t: float) -> None:
+        while self._t_last <= t:
+            gaps = self._rng.exponential(1.0 / self.rate, 4096)
+            times = self._t_last + np.cumsum(gaps)
+            self._times = np.concatenate([self._times, times])
+            self._t_last = float(times[-1])
+
+    def target(self, t: float) -> int:
+        """Arrivals through elapsed second ``t``.  Calls must be
+        non-decreasing in ``t`` (the client's elapsed clock is): the
+        Poisson path prunes each query's consumed prefix, so an
+        earlier-t re-query answers at the pruned horizon."""
+        if t <= 0:
+            return 0
+        if self.kind == "poisson":
+            self._extend_poisson(t)
+            k = int(np.searchsorted(self._times, t, side="right"))
+            self._done += k
+            self._times = self._times[k:]
+            return self._done
+        return int(self._lam(t))
+
+    def flash_end(self) -> float | None:
+        """Elapsed time the flash burst ends (None off the flash kind);
+        the client's post-burst recovery counter anchors on it."""
+        if self.kind != "flash":
+            return None
+        return self.flash_at + self.flash_secs
+
+
+class BackoffLedger:
+    """Retry schedule for NACKed tags (client side of ADMIT_NACK).
+
+    Entries carry TAGS only: a NACKed query was never admitted, so its
+    replacement rows are drawn fresh from the client's pre-generated
+    ring at resend time (same workload distribution; the tag — not the
+    row values — is the txn's identity on every exactly-once path).
+
+    Delay per consecutive NACK of the same tag:
+        ``min(cap, max(retry_after, base * 2^(attempt-1) * U[0.5, 1.5)))``
+    — the server's retry-after hint is honored as a floor, growth is
+    exponential with seeded jitter (herd-splitting), and the cap bounds
+    the worst-case re-entry latency.  Attempts reset when the tag is
+    acked or its lane is reissued.
+    """
+
+    def __init__(self, n_slots: int, base_us: float, max_us: float,
+                 seed: int):
+        self.base_us = float(base_us)
+        self.max_us = float(max_us)
+        self.attempts = np.zeros(n_slots, np.uint8)
+        self._n_slots = n_slots
+        self._rng = np.random.default_rng(seed & 0x7FFFFFFF)
+        self._heap: list[tuple[int, int, int, np.ndarray]] = []
+        self._seq = 0     # heap tiebreak: numpy arrays do not compare
+
+    def __len__(self) -> int:
+        return sum(len(tags) for _, _, _, tags in self._heap)
+
+    def delay_us(self, tags: np.ndarray,
+                 retry_us: np.ndarray) -> np.ndarray:
+        """Per-tag re-entry delay for one NACK batch (attempts already
+        bumped by ``nack``); exposed separately for the unit tests."""
+        slot = tags % self._n_slots
+        att = np.maximum(self.attempts[slot].astype(np.int64), 1)
+        exp = self.base_us * (2.0 ** np.minimum(att - 1, 30))
+        jit = self._rng.uniform(0.5, 1.5, len(tags))
+        return np.minimum(self.max_us,
+                          np.maximum(retry_us.astype(np.float64),
+                                     exp * jit)).astype(np.int64)
+
+    def nack(self, srv: int, tags: np.ndarray, retry_us: np.ndarray,
+             now_us: int) -> None:
+        """Schedule a NACK batch for re-entry, grouped by COARSE (50 ms)
+        ready-time buckets at each bucket's max.  Coarse on purpose: the
+        per-row quota hints spread a batch over hundreds of distinct
+        ready times, and fine-grained buckets re-entered the tags as
+        hundreds of single-row CL_QRY_BATCH messages — a self-sustaining
+        message storm that receive-livelocked the 2-core cluster (the
+        server never drained its queue dry, so the epoch loop starved).
+        50 ms rounding keeps re-entries batched and costs at most one
+        extra bucket of delay on a path already tens of ms deep."""
+        if not len(tags):
+            return
+        slot = tags % self._n_slots
+        self.attempts[slot] = np.minimum(
+            self.attempts[slot].astype(np.int64) + 1, 255)
+        ready = now_us + self.delay_us(tags, retry_us)
+        q = ready // 50_000
+        for b in np.unique(q):
+            m = q == b
+            self._seq += 1
+            heapq.heappush(self._heap, (int(ready[m].max()), self._seq,
+                                        srv, tags[m]))
+
+    def reset(self, tags: np.ndarray) -> None:
+        """Clear attempt counters (tag acked, or its lane reissued)."""
+        self.attempts[tags % self._n_slots] = 0
+
+    def pop_ready(self, now_us: int) -> list[tuple[int, np.ndarray]]:
+        """All (server, tags) batches whose re-entry time has passed."""
+        out: list[tuple[int, np.ndarray]] = []
+        while self._heap and self._heap[0][0] <= now_us:
+            _, _, srv, tags = heapq.heappop(self._heap)
+            out.append((srv, tags))
+        return out
+
+    def next_ready_us(self) -> int | None:
+        return self._heap[0][0] if self._heap else None

@@ -45,6 +45,13 @@ RTYPE = {
     # re-issues from its own outstanding ledger — neither has the
     # resend+idempotent-admission story the fault mask encodes.
     "LOG_ACK": 18, "REGION_READ": 19, "REGION_READ_RSP": 20,
+    # overload tier (runtime/admission.py): per-tenant admission NACK
+    # (server -> client, tags + retry-after hints).  Deliberately
+    # OUTSIDE FAULT_RTYPE_MASK: a lost NACK self-heals through the
+    # client's resend sweep (the unacked query is re-offered and
+    # re-NACKed or admitted), so it needs no loss story of its own —
+    # and faulting it would only re-test the CL_QRY_BATCH path.
+    "ADMIT_NACK": 21,
 }
 RTYPE_NAME = {v: k for k, v in RTYPE.items()}
 

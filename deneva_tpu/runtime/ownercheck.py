@@ -65,7 +65,10 @@ OWNER: dict[str, str] = {
     "_resume_epoch": DISPATCH, "_inflight": DISPATCH,
     "_t_meas": DISPATCH, "_uniq_meas": DISPATCH, "_retry_meas": DISPATCH,
     "_wait_meas": DISPATCH,
-    # admission / retirement queues and dedup state
+    # admission / retirement queues and dedup state (adm = the overload
+    # tier's AdmissionController: admits in _route, pops in the
+    # contribution paths, ticks at group boundaries — all dispatch)
+    "adm": DISPATCH,
     "pending": DISPATCH, "retry": DISPATCH,
     "blob_buf": DISPATCH, "vote_buf": DISPATCH, "vote2_buf": DISPATCH,
     "_in_system": DISPATCH, "_committed_set": DISPATCH,

@@ -519,6 +519,16 @@ class ServerNode:
         self._quorum_release_cnt = 0
         self._geo_spans = {"quorum": 0.0, "promote": 0.0}
 
+        # ---- overload tier: per-tenant admission control ahead of
+        # epoch-batch formation (runtime/admission.py — off on a default
+        # config: no controller exists and _route admits every decoded
+        # CL_QRY_BATCH exactly as before) ----
+        self.adm = None
+        if cfg.admission:
+            from deneva_tpu.runtime.admission import AdmissionController
+            self.adm = AdmissionController(cfg,
+                                           time.monotonic_ns() // 1000)
+
         # ---- chaos / failover gates (all off on a default config) ------
         # _failover: peers tolerate a dead server and wait for its
         # recovered incarnation instead of raising; acks gate on whole-
@@ -773,6 +783,13 @@ class ServerNode:
                 blk = self._admit_dedup(src, blk)
                 if blk is None:
                     return
+            if self.adm is not None:
+                # admission control AFTER dedup: committed resends were
+                # already re-acked and in-flight dups dropped above, so
+                # only genuinely fresh queries meter against quotas
+                blk = self._admission_gate(src, blk)
+                if blk is None:
+                    return
             self.pending.append((src, blk))
         elif rtype == "EPOCH_BLOB":
             if self._overlap:
@@ -850,8 +867,14 @@ class ServerNode:
         elif rtype == "INIT_DONE":
             pass  # late barrier duplicate; the barrier itself already ran
 
-    def _drain(self, timeout_us: int = 0) -> None:
-        while True:
+    def _drain(self, timeout_us: int = 0, max_msgs: int = 4096) -> None:
+        # bounded per call: an open-loop flood (the overload tier's
+        # flash crowd) can sustain a non-empty recv queue indefinitely,
+        # and an unbounded drain would receive-livelock the epoch loop.
+        # 4096 is far above any per-epoch message count on the normal
+        # paths (every caller loops, so nothing is lost — later
+        # messages just wait for the next call).
+        for _ in range(max_msgs):
             m = self.tp.recv(timeout_us)
             if m is None:
                 return
@@ -897,6 +920,30 @@ class ServerNode:
             return None
         return blk.take(np.where(fresh)[0])
 
+    def _admission_gate(self, src: int,
+                        blk: wire.QueryBlock) -> wire.QueryBlock | None:
+        """Per-tenant admission (overload tier): token-bucket quotas +
+        bounded queue + SLO shed decide per row; shed rows are answered
+        with ADMIT_NACK (tags + retry-after hints) instead of being held
+        forever.  Returns the admitted block (None if everything shed)."""
+        from deneva_tpu.runtime.admission import admit_nack_parts
+
+        reason, retry = self.adm.admit(blk.tags,
+                                       time.monotonic_ns() // 1000)
+        ok = reason == 0
+        if ok.all():
+            return blk
+        nk = np.where(~ok)[0]
+        # clip before the uint32 narrowing: a tiny quota against a big
+        # deficit can push the refill hint past 2^32 us
+        self.tp.sendv(src, "ADMIT_NACK",
+                      admit_nack_parts(blk.tags[nk],
+                                       retry[nk].clip(max=0xFFFFFFFF)
+                                       .astype(np.uint32)))
+        if not ok.any():
+            return None
+        return blk.take(np.where(ok)[0])
+
     def _retire_dedup(self, done_tags: np.ndarray) -> None:
         """Move committed packed ids from in-system to the bounded
         recently-committed ring (admission dedup's re-ack source)."""
@@ -934,6 +981,7 @@ class ServerNode:
             tss = [np.where(ab, np.int64(-1), ts)
                    for ts, ab in zip(tss, abms)]
         n = sum(len(b) for b in blocks)
+        n_retry = n
         while self.pending and n < self.b_loc:
             src, blk = self.pending[0]
             room = self.b_loc - n
@@ -950,6 +998,10 @@ class ServerNode:
             tss.append(np.full(len(use), -1, np.int64))   # -1 = stamp me
             dfcs.append(np.zeros(len(use), np.int32))
             n += len(use)
+        if self.adm is not None and n > n_retry:
+            # admission-queue delay ledger: these fresh rows just left
+            # the bounded queue for epoch formation
+            self.adm.on_pop(n - n_retry, time.monotonic_ns() // 1000)
         if not blocks:
             blocks = [wire.QueryBlock.empty(self._width, self._n_scalars)]
             counts = [np.zeros(0, np.int32)]
@@ -1030,6 +1082,7 @@ class ServerNode:
             tags_r[o:o + m] = blk.tags
             ts_r[o:o + m] = ts
             n += m
+        n_retry = n
         while self.pending and n < self.b_loc:
             src, blk = self.pending[0]
             room = self.b_loc - n
@@ -1049,6 +1102,9 @@ class ServerNode:
             counts.append(np.zeros(m, np.int32))
             dfcs.append(np.zeros(m, np.int32))
             n += m
+        if self.adm is not None and n > n_retry:
+            # same admission-delay ledger position as _contribution
+            self.adm.on_pop(n - n_retry, time.monotonic_ns() // 1000)
         # zero the unfilled tail of my slice (reused buffer: these lanes
         # must read as the serial path's np.zeros padding)
         tail = slice(lo + n, lo + self.b_loc)
@@ -2161,6 +2217,13 @@ class ServerNode:
                 print(f"node {self.me} " + make_prog_line(
                     now - t_start, c, {"epoch_cnt": float(group_end)}),
                     flush=True)
+            if self.adm is not None:
+                # per-group SLO tick: quantile the group's queue-delay
+                # samples, re-arm/clear the shed-over-quota state, and
+                # surface the max delay as an "admission"-track span
+                adm_ms = self.adm.on_group()
+                if tl and adm_ms > 0:
+                    tl.spans.append(("adm_wait", adm_ms / 1e3))
             if tl:
                 if self._geo:
                     # replication spans (quorum wait, failover promote):
@@ -2262,6 +2325,12 @@ class ServerNode:
                 repl_applied_min=min(applied, default=-1),
                 quorum_stall_ms=stall_ms,
                 promote_cnt=self._promote_cnt), flush=True)
+        if self.adm is not None:
+            # admission counters ([summary]) + per-tenant [admission]
+            # lines (parsed by harness.parse.parse_admission)
+            self.adm.summary_into(st)
+            for line in self.adm.admission_lines(self.me):
+                print(line, flush=True)
         if self._elastic:
             # membership counters ([summary] satellite): how much the
             # control plane moved and what the cutovers cost

@@ -1,0 +1,324 @@
+"""Overload-tier unit tests: arrival schedules (seeded, deterministic,
+correct shapes), the per-tenant admission controller (quota, capacity,
+SLO shed-over-quota-first, queue-delay ledger), config gating, and the
+admission-off wire pin (pre-admission bytes verbatim, no controller, no
+NACK)."""
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import CCAlg, Config, WorkloadKind
+from deneva_tpu.runtime import admission as A
+from deneva_tpu.runtime import loadgen as L
+from deneva_tpu.runtime import wire
+
+
+def _cfg(**kw):
+    base = dict(workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+                synth_table_size=4096, req_per_query=2, max_accesses=2)
+    base.update(kw)
+    return Config(**base).validate()
+
+
+# ---- config gating ------------------------------------------------------
+
+def test_overload_defaults_are_fully_off():
+    cfg = Config()
+    assert cfg.arrival_process == "" and not cfg.admission
+    assert cfg.tenant_cnt == 1
+
+
+def test_arrival_config_gating():
+    with pytest.raises(ValueError, match="arrival_rate"):
+        _cfg(arrival_process="poisson")
+    with pytest.raises(ValueError, match="needs an arrival_process"):
+        _cfg(arrival_rate=100.0)
+    with pytest.raises(ValueError, match="replaces load_rate"):
+        _cfg(arrival_process="poisson", arrival_rate=100.0,
+             load_rate=100)
+    with pytest.raises(ValueError, match="flash"):
+        _cfg(arrival_process="flash", arrival_rate=100.0)
+    with pytest.raises(ValueError, match="arrival_amp"):
+        _cfg(arrival_process="diurnal", arrival_rate=100.0,
+             arrival_amp=1.5)
+    # valid shapes construct
+    _cfg(arrival_process="flash", arrival_rate=100.0,
+         arrival_flash_at_s=1.0, arrival_flash_secs=0.5)
+
+
+def test_tenant_and_admission_gating():
+    with pytest.raises(ValueError, match="tenant_cnt"):
+        _cfg(tenant_cnt=0)
+    with pytest.raises(ValueError, match="tenant_cnt"):
+        _cfg(tenant_cnt=257)
+    with pytest.raises(ValueError, match="tenant_weights"):
+        _cfg(tenant_cnt=2, tenant_weights="1,2,3")
+    with pytest.raises(ValueError, match="need --admission"):
+        _cfg(tenant_quota=100.0)
+    with pytest.raises(ValueError, match="tenant_quota"):
+        _cfg(admission=True, admission_slo_ms=20.0)
+    w = _cfg(tenant_cnt=4, tenant_weights="1,1,1,5").tenant_weights_spec()
+    assert len(w) == 4 and abs(sum(w) - 1.0) < 1e-9 and w[3] == 5 * w[0]
+
+
+# ---- tenant tag packing -------------------------------------------------
+
+def test_tenant_packs_into_free_tag_bits():
+    lanes = np.arange(0, 1 << 22, 97, dtype=np.int64)[:1000]
+    ten = (lanes % 7).astype(np.uint8)
+    wtags = L.pack_tenant(lanes, ten)
+    assert (L.tenant_of_tags(wtags) == ten).all()
+    assert (wtags % (1 << 22) == lanes).all()     # lane survives
+    assert (wtags >> 40 == 0).all()               # client-id byte free
+    # tenant 0 writes nothing: the default tag bytes are unchanged
+    assert (L.pack_tenant(lanes, np.zeros(1000, np.uint8)) == lanes).all()
+
+
+def test_tenant_column_is_seeded_and_weighted():
+    w = np.array([0.2, 0.8])
+    a = L.tenant_column(np.random.default_rng(5), w, 8192)
+    b = L.tenant_column(np.random.default_rng(5), w, 8192)
+    assert (a == b).all()
+    frac = (a == 1).mean()
+    assert 0.75 < frac < 0.85
+
+
+# ---- arrival schedules --------------------------------------------------
+
+def _sched(kind, rate=1000.0, **kw):
+    cfg = _cfg(arrival_process=kind, arrival_rate=rate, **kw)
+    return L.ArrivalSchedule(cfg, node_id=1)
+
+
+def test_poisson_is_seeded_and_near_rate():
+    s1 = _sched("poisson")
+    s2 = _sched("poisson")
+    for t in (0.5, 1.0, 2.0, 10.0):
+        assert s1.target(t) == s2.target(t), "same seed, same schedule"
+    n = s1.target(10.0)
+    assert 0.9 * 10_000 < n < 1.1 * 10_000
+    assert s1.target(0.0) == 0
+    # monotone
+    ts = np.linspace(0, 10, 101)
+    vals = [s1.target(float(t)) for t in ts]
+    assert all(b >= a for a, b in zip(vals, vals[1:]))
+
+
+def test_diurnal_integral_and_mean_rate():
+    s = _sched("diurnal", arrival_period_s=2.0, arrival_amp=0.8)
+    # over whole periods the sinusoid integrates away: mean rate exact
+    assert s.target(4.0) == 4000
+    # quarter-period peak runs ahead of the flat schedule
+    assert s.target(0.5) > 500
+
+
+def test_bursty_duty_cycle():
+    s = _sched("bursty", arrival_period_s=1.0, arrival_duty=0.25)
+    # ON quarter carries the whole period's arrivals at 4x rate
+    assert s.target(0.25) == 1000
+    assert s.target(0.9) == 1000          # OFF: flat
+    assert s.target(1.25) == 2000
+    # mean rate preserved over whole periods
+    assert s.target(8.0) == 8000
+
+
+def test_flash_step_and_end():
+    s = _sched("flash", arrival_flash_at_s=1.0, arrival_flash_secs=0.5,
+               arrival_flash_factor=10.0)
+    assert s.target(1.0) == 1000
+    assert s.target(1.5) == 1000 + 5000       # 0.5 s at 10x
+    assert s.target(3.0) == 3000 + 4500       # post-burst slope back
+    assert s.flash_end() == 1.5
+    assert _sched("poisson").flash_end() is None
+
+
+def test_arrival_rate_splits_across_clients():
+    cfg = _cfg(arrival_process="poisson", arrival_rate=1000.0,
+               client_node_cnt=4)
+    s = L.ArrivalSchedule(cfg, node_id=4)
+    n = s.target(8.0)
+    assert 0.8 * 2000 < n < 1.2 * 2000
+
+
+# ---- admission controller ----------------------------------------------
+
+US = 1_000_000
+
+
+def _ctl(**kw):
+    base = dict(admission=True, tenant_cnt=2, admission_queue_max=256,
+                tenant_quota=100.0, tenant_burst_s=0.1,
+                admission_retry_us=10_000.0)
+    base.update(kw)
+    return A.AdmissionController(_cfg(**base), now_us=0)
+
+
+def _tags(tenants):
+    lanes = np.arange(len(tenants), dtype=np.int64)
+    return L.pack_tenant(lanes, np.asarray(tenants, np.uint8))
+
+
+def test_quota_nacks_past_the_bucket_and_refills():
+    ctl = _ctl()          # burst = 100 * 0.1 = 10 tokens
+    tags = _tags([0] * 30)
+    reason, retry = ctl.admit(tags, now_us=0)
+    assert (reason[:10] == A.R_ADMIT).all()
+    assert (reason[10:] == A.R_QUOTA).all()
+    assert ctl.admitted[0] == 10 and ctl.nacked[0] == 20
+    # quota retry hints grow with the deficit and floor at the base
+    assert (retry[10:] >= 10_000).all()
+    assert retry[29] > retry[10]
+    # tokens refill at quota rate: 50 ms -> 5 more grants
+    reason2, _ = ctl.admit(_tags([0] * 8), now_us=50_000)
+    assert int((reason2 == A.R_ADMIT).sum()) == 5
+    # tenant 1's bucket is untouched by tenant 0's burn
+    reason3, _ = ctl.admit(_tags([1] * 8), now_us=50_000)
+    assert (reason3 == A.R_ADMIT).all()
+
+
+def test_capacity_bound_nacks_overflow_in_arrival_order():
+    ctl = _ctl(tenant_quota=0.0, admission_queue_max=64)
+    reason, retry = ctl.admit(_tags([0] * 100), now_us=0)
+    assert int((reason == A.R_ADMIT).sum()) == 64
+    assert (reason[:64] == A.R_ADMIT).all(), "arrival order preserved"
+    assert (reason[64:] == A.R_CAP).all()
+    assert (retry[64:] == 10_000).all()
+    assert ctl.depth == 64 and ctl.depth_max == 64
+    # the queue drains -> room again
+    ctl.on_pop(40, now_us=1000)
+    reason2, _ = ctl.admit(_tags([0] * 50), now_us=1000)
+    assert int((reason2 == A.R_ADMIT).sum()) == 40
+
+
+def test_slo_breach_sheds_over_quota_tenants_first():
+    ctl = _ctl(admission_slo_ms=5.0)
+    # tenant 1 (the aggressor) burns its bucket dry; tenant 0 stays in
+    ctl.admit(_tags([1] * 10), now_us=0)
+    assert ctl.tokens[1] < 1.0 and ctl.tokens[0] >= 10.0
+    # queue delay blows past the 5 ms SLO -> breach at the group tick
+    ctl.on_pop(10, now_us=20_000)         # 20 ms in queue
+    ctl.on_group()
+    assert ctl.slo_breached and ctl.breach_groups == 1
+    # mixed batch under breach: the aggressor's WHOLE batch sheds (even
+    # rows its refilled trickle could have granted), tenant 0 admits
+    mixed = _tags([0, 1, 0, 1, 1, 0, 1, 1])
+    reason, retry = ctl.admit(mixed, now_us=20_000)
+    ten = L.tenant_of_tags(mixed)
+    assert (reason[ten == 0] == A.R_ADMIT).all()
+    assert (reason[ten == 1] == A.R_SLO).all()
+    assert ctl.shed[1] == 5 and ctl.shed[0] == 0
+    assert (retry[ten == 1] > 0).all()
+    # recovery: fast drains under the SLO clear the breach
+    ctl.on_pop(int((reason == A.R_ADMIT).sum()), now_us=21_000)
+    ctl.on_group()
+    assert not ctl.slo_breached
+    reason2, _ = ctl.admit(_tags([1] * 4), now_us=10 * US)
+    assert (reason2 == A.R_ADMIT).all(), "post-breach refill re-admits"
+
+
+def test_queue_delay_ledger_quantiles_and_summary():
+    from deneva_tpu.stats import Stats
+
+    ctl = _ctl(tenant_quota=0.0)
+    ctl.admit(_tags([0] * 100), now_us=0)
+    ctl.on_pop(50, now_us=10_000)      # 10 ms
+    ctl.on_pop(50, now_us=40_000)      # 40 ms
+    ctl.on_group()
+    assert abs(ctl.delay_ms.percentile(50) - 10.0) < 0.1
+    assert abs(ctl.delay_ms.percentile(99) - 40.0) < 0.1
+    st = Stats()
+    ctl.summary_into(st)
+    f = st.summary_fields()
+    assert f["adm_admit_cnt"] == 100 and f["adm_queue_depth_max"] == 100
+    assert "adm_queue_delay_ms_p99" in f
+    # [admission] lines round-trip through parse_admission
+    from deneva_tpu.harness.parse import parse_admission
+    rows = parse_admission(ctl.admission_lines(node=3))
+    assert rows[0]["node"] == 3 and rows[0]["tenant"] == -1
+    assert rows[0]["admitted"] == 100
+    assert {r["tenant"] for r in rows[1:]} == {0, 1}
+
+
+def test_foreign_tenant_id_clamps_to_last_bucket():
+    ctl = _ctl(tenant_cnt=2)
+    tags = L.pack_tenant(np.arange(4, dtype=np.int64),
+                         np.array([7, 7, 0, 7], np.uint8))
+    reason, _ = ctl.admit(tags, now_us=0)     # no IndexError
+    assert ctl.admitted.sum() == int((reason == A.R_ADMIT).sum())
+
+
+# ---- admission-off wire pin --------------------------------------------
+
+def test_admission_off_takes_pre_overload_path_verbatim():
+    """The house contract, executable: with admission off a server
+    builds NO controller, NACKs nothing, and the block it queues for
+    epoch formation re-encodes to the arriving payload byte for byte
+    (pre-admission bytes verbatim)."""
+    from tests.test_chaos import _solo_server
+
+    node = _solo_server("adm_off_pin")
+    try:
+        assert node.adm is None
+        blk = wire.QueryBlock(
+            keys=np.arange(8, dtype=np.int32).reshape(4, 2),
+            types=np.ones((4, 2), np.int8),
+            scalars=np.zeros((4, 0), np.int32),
+            tags=np.arange(4, dtype=np.int64))
+        payload = wire.encode_qry_block(blk)
+        node._route(0, "CL_QRY_BATCH", payload)
+        assert len(node.pending) == 1
+        src, queued = node.pending[0]
+        assert wire.encode_qry_block(queued) == payload
+        assert node.tp.recv(100_000) is None      # no NACK, no anything
+        # and the summary carries no admission keys
+        assert not any(k.startswith("adm_")
+                       for k in node.stats.counters)
+    finally:
+        node.close()
+
+
+def test_admission_on_nacks_over_quota_end_to_end():
+    """Loopback ServerNode with admission armed: an over-quota batch
+    splits — in-quota rows queue, the rest come back as one ADMIT_NACK
+    with per-tag retry hints."""
+    from tests.test_chaos import _solo_server
+
+    node = _solo_server("adm_on_nack", admission=True, tenant_cnt=2,
+                        tenant_quota=50.0, tenant_burst_s=0.2,
+                        client_node_cnt=0)
+    try:
+        assert node.adm is not None          # burst = 10 tokens
+        n = 30
+        lanes = np.arange(n, dtype=np.int64)
+        wtags = L.pack_tenant(lanes, np.zeros(n, np.uint8))
+        blk = wire.QueryBlock(
+            keys=np.zeros((n, 2), np.int32),
+            types=np.ones((n, 2), np.int8),
+            scalars=np.zeros((n, 0), np.int32), tags=wtags)
+        node._route(0, "CL_QRY_BATCH", wire.encode_qry_block(blk))
+        assert len(node.pending) == 1 and len(node.pending[0][1]) == 10
+        m = node.tp.recv(500_000)
+        assert m is not None and m[1] == "ADMIT_NACK"
+        tags, retry = A.decode_admit_nack(m[2])
+        assert (tags == wtags[10:]).all()
+        assert (retry > 0).all()
+        assert node.adm.depth == 10
+    finally:
+        node.close()
+
+
+# ---- cluster scenario (tier-1: one full-window overload boot) ----------
+
+def test_overload_flash_scenario():
+    """The flash-crowd chaos scenario end to end: x10 open-loop burst
+    against per-tenant admission on a real 2s1c cluster — queue depth
+    stays bounded, the overflow is NACKed and re-enters via backoff,
+    goodput recovers after the burst, and exactly-once holds under
+    NACK + resend + seeded drops (run_scenario raises ChaosViolation
+    on any breach)."""
+    from deneva_tpu.harness.chaos import run_scenario
+
+    report = run_scenario("overload-flash", quick=True, quiet=True)
+    assert report["adm_nacked_total"] > 0
+    assert report["post_flash_acks"] > 0
+    assert report["commits"][0] == report["commits"][1] > 0

@@ -1,0 +1,234 @@
+"""Backoff-ledger unit tests (overload tier, client side of
+ADMIT_NACK): retry-after hints honored as floors, jittered exponential
+growth capped, and — through a transport-free ClientNode rig — the
+interaction with the resend sweep: the inflight bitmap/throttle never
+drifts and the NACK-then-late-CL_RSP race never double-counts."""
+
+import numpy as np
+
+from deneva_tpu.runtime import wire
+from deneva_tpu.runtime.admission import encode_admit_nack
+from deneva_tpu.runtime.client import TAG_RING, ClientNode
+from deneva_tpu.runtime.loadgen import BackoffLedger
+from deneva_tpu.stats import Stats
+
+MS = 1_000     # us per ms
+
+
+def _ledger(base_us=10 * MS, max_us=500 * MS, seed=7):
+    return BackoffLedger(TAG_RING, base_us, max_us, seed)
+
+
+def test_retry_after_is_a_floor():
+    led = _ledger(base_us=10 * MS)
+    tags = np.arange(4, dtype=np.int64)
+    led.nack(0, tags, np.full(4, 300 * MS, np.uint32), now_us=0)
+    # first attempt's exponential term is ~10ms +/- 50%: far under the
+    # 300ms hint, so every ready time sits at/after the hint
+    assert led.next_ready_us() >= 300 * MS
+    assert led.pop_ready(299 * MS) == []
+    out = led.pop_ready(2_000 * MS)
+    assert sum(len(t) for _, t in out) == 4
+
+
+def test_jittered_exponential_growth_and_cap():
+    led = _ledger(base_us=10 * MS, max_us=200 * MS)
+    tags = np.arange(64, dtype=np.int64)
+    retry = np.zeros(64, np.uint32)
+    prev_mean = 0.0
+    for attempt in range(1, 7):
+        led.attempts[tags] = attempt
+        d = led.delay_us(tags, retry)
+        exp = 10 * MS * 2 ** (attempt - 1)
+        lo, hi = 0.5 * exp, 1.5 * exp
+        assert (d >= min(lo, 200 * MS) - 1).all()
+        assert (d <= 200 * MS).all()
+        if exp * 1.5 < 200 * MS:
+            assert (d <= hi + 1).all()
+            m = float(d.mean())
+            assert m > prev_mean, "growth must be exponential in attempts"
+            prev_mean = m
+    # deep attempts saturate at the cap exactly
+    led.attempts[tags] = 30
+    assert (led.delay_us(tags, retry) == 200 * MS).all()
+
+
+def test_jitter_is_seeded_and_spreads():
+    a = _ledger(seed=3)
+    b = _ledger(seed=3)
+    c = _ledger(seed=4)
+    tags = np.arange(256, dtype=np.int64)
+    r = np.zeros(256, np.uint32)
+    a.attempts[tags] = 1
+    b.attempts[tags] = 1
+    c.attempts[tags] = 1
+    da, db, dc = (led.delay_us(tags, r) for led in (a, b, c))
+    assert (da == db).all(), "same seed must reproduce the schedule"
+    assert (da != dc).any(), "different seed must re-jitter"
+    assert len(np.unique(da)) > 10, "jitter must split the herd"
+
+
+def test_pop_ready_and_reset():
+    led = _ledger(base_us=10 * MS)
+    led.nack(2, np.arange(8, dtype=np.int64), np.zeros(8, np.uint32),
+             now_us=0)
+    assert len(led) == 8
+    out = led.pop_ready(1_000 * MS)
+    assert len(led) == 0
+    assert all(srv == 2 for srv, _ in out)
+    got = np.sort(np.concatenate([t for _, t in out]))
+    assert (got == np.arange(8)).all()
+    # ack resets the attempt counter: next nack backs off like the first
+    led.reset(np.arange(8, dtype=np.int64))
+    assert (led.attempts[:8] == 0).all()
+
+
+# ---- transport-free ClientNode rig --------------------------------------
+# __new__ + hand-set attributes: _route / the sweeps touch only numpy
+# state, the stats object and tp.sendv — everything a FakeTp can record.
+
+class FakeTp:
+    def __init__(self):
+        self.sent = []
+
+    def sendv(self, dest, rtype, parts):
+        self.sent.append((dest, rtype, b"".join(bytes(p) for p in parts)))
+
+
+def _mini_client(n_srv=2, fault_mode=False, chunk=64):
+    c = ClientNode.__new__(ClientNode)
+    c.cfg = None
+    c.n_srv = n_srv
+    c._fault_mode = fault_mode
+    c._adm = True
+    c._elastic = False
+    c._geo = False
+    c._active = np.ones(n_srv, bool)
+    c._rr = 0
+    c._unacked = np.zeros(TAG_RING, bool)
+    c._nacked = np.zeros(TAG_RING, bool)
+    c._ledger = BackoffLedger(TAG_RING, 10 * MS, 500 * MS, seed=11)
+    c._tag_srv = None
+    c._resend_q = __import__("collections").deque()
+    c._resend_us = 100 * MS
+    c._resend_cnt = 0
+    c._dup_acks = 0
+    c._nack_cnt = 0
+    c._nack_resend_cnt = 0
+    c._flash_end_us = None
+    c.inflight = np.zeros(n_srv, np.int64)
+    c.send_us = np.zeros(TAG_RING, np.int64)
+    c.tag_type = np.zeros(TAG_RING, np.uint8)
+    c.type_names = ["txn"]
+    c.ring_tenants = None
+    c.chunk = chunk
+    c.ring = [wire.QueryBlock(
+        keys=np.zeros((chunk, 2), np.int32),
+        types=np.ones((chunk, 2), np.int8),
+        scalars=np.zeros((chunk, 1), np.int32),
+        tags=np.zeros(chunk, np.int64))]
+    c.ring_types = [np.zeros(chunk, np.uint8)]
+    c.ring_pos = 0
+    c.stats = Stats()
+    c.tp = FakeTp()
+    return c
+
+
+def _send(c, srv, tags):
+    """Emulate the hot loop's bookkeeping for a sent batch."""
+    c._unacked[tags % TAG_RING] = True
+    c._nacked[tags % TAG_RING] = False
+    c._ledger.reset(tags)
+    c.inflight[srv] += len(tags)
+    if c._fault_mode:
+        n = len(tags)
+        c._resend_q.append((0, srv, wire.QueryBlock(
+            np.zeros((n, 2), np.int32), np.ones((n, 2), np.int8),
+            np.zeros((n, 1), np.int32), tags)))
+
+
+def test_nack_releases_credit_once_and_dup_nack_is_noop():
+    c = _mini_client()
+    lat = c.stats.arr("client_client_latency")
+    tags = np.arange(10, dtype=np.int64)
+    _send(c, 0, tags)
+    assert c.inflight[0] == 10
+    nack = encode_admit_nack(tags[:4], np.full(4, 50 * MS, np.uint32))
+    c._route(0, "ADMIT_NACK", nack, lat)
+    assert c.inflight[0] == 6 and c._nack_cnt == 4
+    assert c._nacked[:4].all() and not c._nacked[4:10].any()
+    # the SAME NACK again (duplicated message): zero further release
+    c._route(0, "ADMIT_NACK", nack, lat)
+    assert c.inflight[0] == 6 and c._nack_cnt == 4
+    assert len(c._ledger) == 4
+
+
+def test_nack_then_late_cl_rsp_counts_once_and_never_drifts():
+    """The race: a duplicate of the query was NACKed while the original
+    was admitted and committed.  The late CL_RSP must count the txn
+    exactly once and must NOT release the inflight credit the NACK
+    already released; the ledger entry dies at the next sweep."""
+    c = _mini_client()
+    lat = c.stats.arr("client_client_latency")
+    tags = np.arange(8, dtype=np.int64)
+    _send(c, 0, tags)
+    c._route(0, "ADMIT_NACK",
+             encode_admit_nack(tags[:3], np.full(3, 20 * MS, np.uint32)),
+             lat)
+    assert c.inflight[0] == 5
+    # late CL_RSP for ALL 8 tags (the 3 NACKed ones raced an admission)
+    c._route(0, "CL_RSP", wire.encode_cl_rsp(tags), lat)
+    assert c.stats.counters["txn_cnt"] == 8          # counted once each
+    assert c.inflight[0] == 0, "NACKed credit must not release twice"
+    assert not c._nacked[:8].any() and not c._unacked[:8].any()
+    # the ledger entry is stale now: the sweep filters it on unacked
+    import time as _t
+    c._backoff_sweep(now_us=_t.monotonic_ns() // 1000 + 10_000 * MS)
+    assert c.tp.sent == [] and c._nack_resend_cnt == 0
+    # and a duplicate CL_RSP is fully absorbed
+    c._route(0, "CL_RSP", wire.encode_cl_rsp(tags), lat)
+    assert c.stats.counters["txn_cnt"] == 8 and c.inflight[0] == 0
+
+
+def test_backoff_resend_recharges_credit_and_rejoins_resend_queue():
+    c = _mini_client(fault_mode=True)
+    lat = c.stats.arr("client_client_latency")
+    tags = np.arange(6, dtype=np.int64)
+    _send(c, 1, tags)
+    c._route(1, "ADMIT_NACK",
+             encode_admit_nack(tags, np.full(6, 15 * MS, np.uint32)), lat)
+    assert c.inflight[1] == 0 and len(c._resend_q) == 1
+    # the fault resend sweep must SKIP nacked tags (the ledger owns them)
+    import time as _t
+    now = _t.monotonic_ns() // 1000
+    c._resend_q[0] = (now - 10_000 * MS, 1, c._resend_q[0][2])
+    c._resend_sweep()
+    assert c.tp.sent == [] and c._resend_cnt == 0
+    # past the backoff the ledger re-enters: credit recharged, fresh
+    # rows under the same tags, and (fault mode) a new resend_q entry
+    c._backoff_sweep(now_us=now + 10_000 * MS)
+    assert c._nack_resend_cnt == 6 and c.inflight[1] == 6
+    assert not c._nacked[:6].any() and c._unacked[:6].all()
+    assert len(c.tp.sent) == 1
+    dest, rtype, payload = c.tp.sent[0]
+    assert (dest, rtype) == (1, "CL_QRY_BATCH")
+    blk = wire.decode_qry_block(payload)
+    assert (blk.tags == tags).all()
+    assert len(c._resend_q) == 1       # stale entry gone, fresh one in
+    assert (c._resend_q[0][2].tags == tags).all()
+    # the ack then drains everything cleanly
+    c._route(1, "CL_RSP", wire.encode_cl_rsp(tags), lat)
+    assert c.inflight[1] == 0 and c.stats.counters["txn_cnt"] == 6
+
+
+def test_stale_nack_after_ack_is_ignored():
+    c = _mini_client()
+    lat = c.stats.arr("client_client_latency")
+    tags = np.arange(5, dtype=np.int64)
+    _send(c, 0, tags)
+    c._route(0, "CL_RSP", wire.encode_cl_rsp(tags), lat)
+    assert c.inflight[0] == 0
+    # a NACK landing after the ack (reordered duplicate): full no-op
+    c._route(0, "ADMIT_NACK",
+             encode_admit_nack(tags, np.full(5, 20 * MS, np.uint32)), lat)
+    assert c.inflight[0] == 0 and c._nack_cnt == 0 and len(c._ledger) == 0

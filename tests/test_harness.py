@@ -267,3 +267,74 @@ def test_timeline_chrome_trace_replication_track(tmp_path):
     # every node with tid-1 events gets a named track — including node
     # 5, whose spans are all zero-duration
     assert {m["pid"] for m in meta if m["tid"] == 1} == {0, 4, 5}
+
+
+def test_parse_admission_forward_backward_compat(tmp_path):
+    """[admission] lines (overload tier satellite): per-tenant rows plus
+    a tenant=-1 node aggregate with queue-delay quantiles; old logs
+    yield [], and the new lines perturb no other parser."""
+    from deneva_tpu.harness.parse import (parse_admission, parse_file,
+                                          parse_membership,
+                                          parse_replication)
+    from deneva_tpu.harness.timeline import parse_timeline
+
+    new_log = tmp_path / "overload.out"
+    new_log.write_text(
+        "# cfg node_cnt=2\n"
+        "[admission] node=0 tenant=-1 admitted=9000 nacked=1200 shed=300 "
+        "qdelay_p50_ms=4.100 qdelay_p95_ms=18.000 qdelay_p99_ms=31.500 "
+        "depth_max=4096 breach_groups=3\n"
+        "[admission] node=0 tenant=0 admitted=6000 nacked=10 shed=0\n"
+        "[admission] node=0 tenant=1 admitted=3000 nacked=1190 shed=300\n"
+        "[timeline] node=0 epoch=64 loop=1.0ms adm_wait=31.5ms\n"
+        "[summary] total_runtime=2,tput=70,txn_cnt=140,"
+        "adm_admit_cnt=9000,adm_nack_cnt=1200,adm_shed_cnt=300,"
+        "adm_queue_depth_max=4096\n")
+    rows = parse_admission(new_log.read_text().splitlines())
+    assert len(rows) == 3
+    agg, t0, t1 = rows
+    assert agg["tenant"] == -1 and agg["qdelay_p99_ms"] == 31.5
+    assert agg["depth_max"] == 4096 and agg["breach_groups"] == 3
+    assert t0["tenant"] == 0 and t0["shed"] == 0
+    assert t1["tenant"] == 1 and t1["nacked"] == 1190
+    # other parsers ignore the new lines entirely
+    row = parse_file(str(new_log))
+    assert row["tput"] == 70 and row["adm_nack_cnt"] == 1200
+    text = new_log.read_text().splitlines()
+    assert parse_membership(text) == []
+    assert parse_replication(text) == []
+    assert len(parse_timeline(text)) == 1
+    # old log: no admission lines -> [] and unchanged parsing
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_admission(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5
+
+
+def test_timeline_chrome_trace_admission_track(tmp_path):
+    """Admission spans (per-group max queue delay) export on their own
+    per-node "admission" thread track (tid 2), beside — never inside —
+    the phase and replication clocks."""
+    from deneva_tpu.harness.timeline import chrome_trace, parse_timeline
+
+    lines = [
+        "[timeline] node=0 epoch=8 loop=1.0ms admit=2.0ms adm_wait=25.0ms\n",
+        "[timeline] node=0 epoch=16 loop=1.0ms adm_wait=40.0ms "
+        "quorum=5.0ms\n",
+    ]
+    trace = chrome_trace(parse_timeline(lines))
+    ev = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    phase = [e for e in ev if e["tid"] == 0]
+    adm = [e for e in ev if e["tid"] == 2]
+    # phase clock untouched by the admission (and replication) spans
+    assert [e["name"] for e in phase] == ["loop", "admit", "loop"]
+    assert phase[2]["ts"] == 3000.0
+    # admission track has its own running clock and category
+    assert [e["name"] for e in adm] == ["adm_wait", "adm_wait"]
+    assert adm[0]["ts"] == 0 and adm[1]["ts"] == 25000.0
+    assert all(e["cat"] == "admission" for e in adm)
+    # replication spans still land on tid 1
+    assert [e["name"] for e in ev if e["tid"] == 1] == ["quorum"]
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta if m["tid"] == 2} \
+        == {"admission"}

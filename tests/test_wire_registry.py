@@ -13,6 +13,7 @@ rtype without extending it fails test_every_rtype_covered.
 import numpy as np
 import pytest
 
+from deneva_tpu.runtime import admission as A
 from deneva_tpu.runtime import membership as M
 from deneva_tpu.runtime import replication as R
 from deneva_tpu.runtime import logger, native, wire
@@ -38,7 +39,7 @@ def test_fault_mask_classification_is_explicit_and_matches():
 def test_declared_codecs_exist():
     for spec in WIRE_MODEL.values():
         for fn in (*spec.codec_encode, *spec.codec_decode):
-            assert any(hasattr(m, fn) for m in (wire, M, logger, R)), \
+            assert any(hasattr(m, fn) for m in (wire, M, logger, R, A)), \
                 f"{spec.name}: declared codec {fn} not found"
 
 
@@ -192,6 +193,23 @@ def _rt_region_read_rsp():
     assert b"".join(bytes(p) for p in parts) == buf
 
 
+def _rt_admit_nack():
+    r = np.random.default_rng(23)
+    tags = r.integers(0, 1 << 32, 7).astype(np.int64)
+    retry = r.integers(1, 1 << 22, 7).astype(np.uint32)
+    tags2, retry2 = A.decode_admit_nack(A.encode_admit_nack(tags, retry))
+    np.testing.assert_array_equal(tags, tags2)
+    np.testing.assert_array_equal(retry, retry2)
+    # zero-copy parts path must be byte-identical to the codec
+    parts = A.admit_nack_parts(tags, retry)
+    assert b"".join(bytes(p) for p in parts) \
+        == A.encode_admit_nack(tags, retry)
+    # empty batch round-trips too (a fully-deduped arrival)
+    t0, r0 = A.decode_admit_nack(A.encode_admit_nack(
+        np.zeros(0, np.int64), np.zeros(0, np.uint32)))
+    assert len(t0) == 0 and len(r0) == 0
+
+
 def _rt_payload_free():
     return None     # no payload on the wire: nothing to round-trip
 
@@ -217,6 +235,7 @@ ROUNDTRIP = {
     "LOG_ACK": _rt_log_ack,
     "REGION_READ": _rt_region_read,
     "REGION_READ_RSP": _rt_region_read_rsp,
+    "ADMIT_NACK": _rt_admit_nack,
 }
 
 

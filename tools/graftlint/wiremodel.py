@@ -35,6 +35,7 @@ CODEC_MODULES = (
     "deneva_tpu/runtime/membership.py",
     "deneva_tpu/runtime/logger.py",
     "deneva_tpu/runtime/replication.py",
+    "deneva_tpu/runtime/admission.py",
 )
 
 # handler qualname -> (module, function name) to scan for route branches
@@ -162,4 +163,11 @@ WIRE_MODEL: dict[str, RtypeSpec] = {s.name: s for s in (
        routes=("ClientNode._route",),
        note="follower snapshot read answer (boundary + values + row "
             "version stamps): control plane, same lost-read ledger"),
+    _s("ADMIT_NACK", False,
+       enc=("encode_admit_nack", "admit_nack_parts"),
+       dec=("decode_admit_nack",),
+       routes=("ClientNode._route",),
+       note="admission NACK (tags + retry-after hints): outside the "
+            "mask like rtypes 15-20 — a lost NACK self-heals through "
+            "the client resend sweep re-offering the unacked query"),
 )}

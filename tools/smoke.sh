@@ -13,6 +13,11 @@
 #                                         asymmetric-WAN / replica-lag
 #                                         (quorum commit, follower snapshot
 #                                         reads, promote-on-region-loss)
+#   tools/smoke.sh overload               overload-robustness gate:
+#                                         flash-crowd / aggressor-tenant /
+#                                         diurnal (bounded admission queue,
+#                                         shed + recovery, tenant fairness,
+#                                         exactly-once under NACK+resend)
 #   tools/smoke.sh lint                   static-analysis gate: graftlint
 #                                         (trace/det/wire/own/imports families)
 #                                         + ruff (pyflakes slice, when
@@ -59,6 +64,10 @@ case "$SCEN" in
     T="${SMOKE_TIMEOUT_SECS:-${GEO_TIMEOUT_SECS:-900}}"
     run "$T" python -m deneva_tpu.harness.chaos geo --quick
     ;;
+  overload)
+    T="${SMOKE_TIMEOUT_SECS:-${OVERLOAD_TIMEOUT_SECS:-900}}"
+    run "$T" python -m deneva_tpu.harness.chaos overload --quick
+    ;;
   lint)
     # static gate; budget 30 s total on the 2-core CI box (graftlint
     # measures ~2.5 s over the 70-file tree, ruff sub-second)
@@ -73,7 +82,7 @@ case "$SCEN" in
     fi
     ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|lint> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|lint> [args...]" >&2
     exit 2
     ;;
 esac
