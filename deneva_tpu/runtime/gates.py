@@ -1,0 +1,148 @@
+"""Default-off subsystem gate registry (pure data, stdlib-only).
+
+Every subsystem PR since the chaos harness has shipped under the same
+contract: **default-off, bit-identical when off**.  The enforcement half
+of that contract is control-flow shaped — every use of a gated
+subsystem must sit under its config-flag check — and lives in the
+graftlint gate-consistency family (tools/graftlint/gateconsistency.py),
+which imports THESE declarations so the linter and the runtime can
+never drift apart (the same pattern as runtime/ownercheck.py for thread
+ownership and tools/graftlint/wiremodel.py for the wire protocol).
+
+A ``GateSpec`` declares, per subsystem:
+
+flags
+    The ``Config`` fields that arm it.  The checker cross-parses
+    deneva_tpu/config.py and fails if a flag is not a real field or its
+    default is not off (``gate-registry-drift``) — a renamed flag can't
+    silently orphan the gate checking.
+guards
+    Attribute/name leaves whose truthiness establishes the gate: config
+    flags themselves (``cfg.geo``), the cached booleans nodes stamp in
+    ``__init__`` (``self._geo``, ``self._fault_mode``), and the
+    subsystem objects whose ``is not None`` checks gate their use
+    (``self.adm``).  A local name assigned from a guard expression
+    (``supervise = cfg.faults_enabled and cfg.logging``) inherits
+    guard-ness within its function.
+home
+    Module paths that ARE the subsystem: calls into them from outside
+    are uses; code inside them is exempt (it only runs once armed).
+use_attrs
+    Instance attributes holding subsystem objects (``None``/absent when
+    off): any deeper access (``self.adm.admit(...)``) is a use.  They
+    double as guards — ``if self.adm is not None`` is the canonical
+    gate.
+use_calls
+    Function/method names that are uses wherever they appear (the fault
+    tier has no home module; arming the native transport's fault layer
+    or scheduling a kill IS the use).
+context
+    Function names (optionally ``Class.name``-qualified) whose whole
+    body runs under the gate by construction — spawned threads or
+    protocol callbacks whose call sites static analysis cannot see.
+    Everything that CAN be derived from call sites is; this tuple is
+    for the remainder and should stay short.
+
+Gated **rtypes** are not declared here: tools/graftlint/wiremodel.py
+rows carry a ``gate`` field (LOG_ACK -> geo, MIGRATE_* -> elastic,
+ADMIT_NACK -> admission) and the checker both treats an
+``rtype == "LOG_ACK"`` route branch as establishing the gate (such a
+message only exists when the subsystem armed it) and cross-checks every
+gated rtype as OUTSIDE ``FAULT_RTYPE_MASK`` (a gated control-plane
+message must never be silently droppable).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+CONFIG_MODULE = "deneva_tpu/config.py"
+
+# modules never gate-checked: the harness constructs armed configs by
+# definition (a chaos scenario IS the fault-injection context)
+EXEMPT_PREFIXES = ("deneva_tpu/harness/",)
+
+
+@dataclass(frozen=True)
+class GateSpec:
+    name: str
+    flags: tuple = ()
+    guards: tuple = ()
+    home: tuple = ()
+    use_attrs: tuple = ()
+    use_calls: tuple = ()
+    context: tuple = ()
+    # subsystems this one REQUIRES armed (config.validate enforces it):
+    # establishing this gate establishes those too — geo requires
+    # elastic, so geo-gated code may use the membership layer freely
+    requires: tuple = ()
+
+    def all_guards(self) -> tuple:
+        # flags and use_attrs double as guards (`if cfg.fault_drop_prob
+        # or ...:`, `if self.adm is not None:`)
+        return tuple(dict.fromkeys(
+            (*self.guards, *self.use_attrs, *self.flags)))
+
+
+GATES: dict[str, GateSpec] = {s.name: s for s in (
+    GateSpec(
+        "geo",
+        flags=("geo",),
+        # geo_read_perc > 0 requires geo=true (config.validate), so a
+        # read-path check on it is a geo gate too
+        guards=("geo", "_geo", "geo_read_perc"),
+        home=("deneva_tpu/runtime/replication.py",),
+        use_attrs=("_georepl", "follower"),
+        requires=("elastic",),
+    ),
+    GateSpec(
+        "elastic",
+        flags=("elastic",),
+        # _mig_pending/_plan_sent exist only once elastic armed them;
+        # `mp is not None` is the cutover path's gate of record
+        guards=("elastic", "_elastic", "_mig_pending", "_plan_sent"),
+        home=("deneva_tpu/runtime/membership.py",),
+        # _M is the lazily-imported membership module stamped on the
+        # server under `if self._elastic:` — any self._M.x IS a use
+        use_attrs=("smap", "_M"),
+    ),
+    GateSpec(
+        "admission",
+        # the overload tier: server-side admission control + the
+        # client's open-loop load generation / backoff ledger /
+        # per-tenant tag packing (tenant_cnt > 1 arms the tag bits)
+        flags=("admission", "arrival_process"),
+        guards=("admission", "_adm", "arrival_process", "adm",
+                "_nacked", "tenant_cnt"),
+        home=("deneva_tpu/runtime/admission.py",
+              "deneva_tpu/runtime/loadgen.py"),
+        use_attrs=("adm", "_arrival", "_ledger", "ring_tenants"),
+    ),
+    GateSpec(
+        "fault",
+        flags=("fault_drop_prob", "fault_dup_prob",
+               "fault_delay_jitter_us", "fault_kill", "recover"),
+        # fault_kill_spec() is a pure parser (None when unarmed): its
+        # RESULT is the guard (`kill = cfg.fault_kill_spec()` then
+        # `if kill is not None:`), calling it is not a use
+        guards=("faults_enabled", "_fault_mode", "_failover",
+                "_dedup_on", "fault_kill", "recover", "_kill_at",
+                "fault_kill_spec"),
+        home=(),
+        use_attrs=("_retryq",),
+        use_calls=("set_fault",),
+    ),
+)}
+
+# ---- escrow --------------------------------------------------------------
+# Escrow's gate is a FUNCTION, not a branch: cc/base.gate_order_free is
+# "the ONE escrow gate" (returns the workload's order_free mask iff the
+# backend + config allow it, else None = pre-escrow semantics bit for
+# bit).  The checkable contract is that the RAW mask — workload plan
+# entries and freshly-built AccessBatch fields — reaches conflict
+# derivation only THROUGH a gate function, so no code path can consume
+# undeclared commutativity.
+ESCROW_GATE_FUNCS = ("gate_order_free", "build_conflict_incidence")
+# modules allowed to touch the raw mask: the workloads declare it, the
+# cc backends consume the pre-gated AccessBatch field
+ESCROW_HOME_PREFIXES = ("deneva_tpu/cc/", "deneva_tpu/workloads/")

@@ -34,9 +34,14 @@ def _server_main(cfg: Config, endpoints: str, platform: str | None, q) -> None:
             os.environ.setdefault("JAX_PLATFORMS", platform)
         from deneva_tpu.runtime.server import ServerNode
         node = ServerNode(cfg, endpoints, platform)
-        st = node.run()
-        q.put((cfg.node_id, "server", st.summary_line()))
-        node.close()
+        try:
+            st = node.run()
+            q.put((cfg.node_id, "server", st.summary_line()))
+        finally:
+            # a run() that raises must still release the transport: the
+            # error report below races peer teardown otherwise (and a
+            # wedged socket outlives the process on some rigs)
+            node.close()
     except Exception:
         q.put((cfg.node_id, "error", traceback.format_exc()))
 
@@ -50,9 +55,11 @@ def _replica_main(cfg: Config, endpoints: str, platform: str | None,
             os.environ.setdefault("JAX_PLATFORMS", platform)
         from deneva_tpu.runtime.replica import ReplicaNode
         node = ReplicaNode(cfg, endpoints)
-        st = node.run()
-        q.put((cfg.node_id, "replica", st.summary_line()))
-        node.close()
+        try:
+            st = node.run()
+            q.put((cfg.node_id, "replica", st.summary_line()))
+        finally:
+            node.close()
     except Exception:
         q.put((cfg.node_id, "error", traceback.format_exc()))
 
@@ -63,9 +70,11 @@ def _client_main(cfg: Config, endpoints: str, platform: str | None, q) -> None:
             os.environ.setdefault("JAX_PLATFORMS", platform)
         from deneva_tpu.runtime.client import ClientNode
         node = ClientNode(cfg, endpoints, platform)
-        st = node.run()
-        q.put((cfg.node_id, "client", st.summary_line()))
-        node.close()
+        try:
+            st = node.run()
+            q.put((cfg.node_id, "client", st.summary_line()))
+        finally:
+            node.close()
     except Exception:
         q.put((cfg.node_id, "error", traceback.format_exc()))
 

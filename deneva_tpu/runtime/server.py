@@ -1553,10 +1553,13 @@ class ServerNode:
         M = self._M
         keys = M.keys_of_slots(slots, self.wl.n_rows, self.smap.n_slots)
         kj = jnp.asarray(keys)
+        # sorted: the MIGRATE_ROWS byte stream must not depend on the
+        # db/columns dict INSERTION history (a rebuilt-by-replay node's
+        # tables must snapshot byte-identically to a boot-built one's)
         gathered = {f"{name}/{cn}": jnp.take(v, kj, axis=0)
-                    for name, tab in self.db.items()
+                    for name, tab in sorted(self.db.items())
                     if not name.startswith("__")
-                    for cn, v in tab.columns.items()}
+                    for cn, v in sorted(tab.columns.items())}
         # ONE batched d2h fetch: per-column device_get would serialize a
         # full tunnel round trip per column (the d2h path is the
         # documented single-digit-MB/s bottleneck) straight into the

@@ -1,0 +1,52 @@
+"""Seeded graftlint violations: jit-stability family (never imported).
+
+One violation per EXPECT-marker line; the ok_* shapes prove static
+positions, immutable tables and shape-metadata calls stay silent.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_CACHE = {}                  # mutated below: jit capture goes stale
+_TABLE = {"a": 1}            # never mutated: bakeable constant, exempt
+
+
+def note(k, v):
+    _CACHE[k] = v
+
+
+@jax.jit
+def dyn_shape(x):
+    idx = jnp.nonzero(x)             # EXPECT[jit-dynamic-shape]
+    n = x.sum()
+    pad = jnp.zeros(n)               # EXPECT[jit-dynamic-shape]
+    ok = jnp.zeros(jnp.shape(x))     # shape metadata is static: silent
+    return idx, pad, ok
+
+
+@jax.jit
+def reads_mut_global(x):
+    return x + _CACHE["k"]           # EXPECT[jit-mutable-global]
+
+
+@jax.jit
+def reads_const_global(x):
+    return x + _TABLE["a"]
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def stat_default(x, spec=[]):        # EXPECT[jit-unhashable-static]
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def weak_fx(x, mode):
+    return x
+
+
+def call_weak_fx(db):
+    good = weak_fx(db, 1)            # static position: hashes, silent
+    bad = weak_fx(0.5, 1)            # EXPECT[jit-weak-dtype]
+    return good, bad

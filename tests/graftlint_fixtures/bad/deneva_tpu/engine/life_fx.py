@@ -1,0 +1,97 @@
+"""Seeded graftlint violations: lifecycle family (never imported).
+
+One violation per EXPECT-marker line; the ok_* shapes prove the
+try/finally discipline (and `with`, and daemon threads) stay silent.
+Path mimics deneva_tpu/engine/ like the other bad fixtures.
+"""
+
+import threading
+
+
+def touch(x):
+    return len(x)
+
+
+def unjoined_thread(work):
+    t = threading.Thread(target=work)    # EXPECT[life-unjoined-thread]
+    t.start()
+    touch(work)
+    t.join()                             # not on the exception path
+
+
+def joined_ok(work):
+    t = threading.Thread(target=work)
+    t.start()
+    try:
+        touch(work)
+    finally:
+        t.join()
+
+
+def daemon_ok(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    touch(work)
+
+
+def undrained_future(pool, blob):
+    f = pool.submit(len, blob)           # EXPECT[life-undrained-future]
+    touch(blob)
+    return f.result()                    # not on the exception path
+
+
+def undrained_list(pool, items):
+    futs = []
+    for it in items:
+        futs.append(pool.submit(len, it))  # EXPECT[life-undrained-future]
+    touch(items)
+    for f in futs:
+        f.result()
+
+
+def drained_ok(pool, blob):
+    futs = []
+    try:
+        futs.append(pool.submit(len, blob))
+        touch(blob)
+    finally:
+        for f in futs:
+            f.result()
+
+
+def unclosed_file(path):
+    f = open(path)                       # EXPECT[life-unclosed-resource]
+    data = f.read()
+    f.close()                            # not on the exception path
+    return data
+
+
+def closed_ok(path):
+    f = open(path)
+    try:
+        return f.read()
+    finally:
+        f.close()
+
+
+def with_ok(path):
+    with open(path) as f:
+        return f.read()
+
+
+class Keeper:
+    """Attr-stored closable with no close anywhere in the class."""
+
+    def __init__(self, path):
+        self._f = open(path)             # EXPECT[life-unclosed-resource]
+
+    def read(self):
+        return self._f.read()
+
+
+class Closer:
+    def __init__(self, path):
+        self._f = open(path)
+
+    def close(self):
+        self._f.close()

@@ -59,3 +59,33 @@ def emit_wrapped_fx(tp):
     # list()/enumerate() copy the set's order, they don't fix it
     for i, p in enumerate(list(gone)):   # EXPECT[det-unordered-iter]
         tp.send(p, "EPOCH_BLOB", bytes([i]))
+
+
+def emit_taint_fx(tp, d):
+    # v2 flow-sensitive shape (the round-9 soft spot): a PLAIN
+    # `for k in d:` whose order taint reaches the sink through an
+    # accumulator — no dict-view call anywhere near the loop
+    d.setdefault(0, b"")
+    out = []
+    for k in d:                          # EXPECT[det-unordered-iter]
+        out.append(k)
+    tp.send(0, "EPOCH_BLOB", bytes(out))
+
+
+def emit_sorted_ok(tp, d):
+    # same shape, cleansed: rebinding through sorted() kills the taint
+    d.setdefault(0, b"")
+    out = []
+    for k in d:
+        out.append(k)
+    out = sorted(out)
+    tp.send(0, "EPOCH_BLOB", bytes(out))
+
+
+def emit_fold_ok(tp, d):
+    # commutative fold: order-insensitive by construction
+    d.setdefault(0, b"")
+    acc = 0
+    for k in d:
+        acc |= k
+    tp.send(0, "EPOCH_BLOB", bytes([acc]))

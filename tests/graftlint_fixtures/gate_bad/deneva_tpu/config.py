@@ -1,0 +1,6 @@
+# EXPECT[gate-registry-drift] EXPECT[gate-rtype-mask] — line-1 anchors:
+# the registry-level findings (unknown flag; gated rtype inside the
+# fault mask) have no better source line than the config module head.
+class Config:
+    fx_flag: bool = False
+    bad_flag: int = 3                    # EXPECT[gate-registry-drift]

@@ -1,0 +1,10 @@
+"""Fixture subsystem HOME module: code here only runs once armed, so
+nothing inside it needs (or gets) gate checking."""
+
+
+def fx_do():
+    return 1
+
+
+def fx_other():
+    return fx_do() + 1

@@ -1,0 +1,80 @@
+"""Seeded graftlint violations: gate-consistency family (never
+imported).  Checked against a fixture registry (see test_graftlint._GFX)
+— one subsystem "fx" with flag fx_flag, home fxsub.py, object attr fxo.
+
+The ok_* shapes pin every gating idiom the checker must accept: plain
+if, early return, IfExp, and/or short-circuit, guard alias through a
+local, `is not None` on the subsystem object, gated-rtype route branch,
+and a helper whose every call site is guarded.
+"""
+
+from deneva_tpu.runtime import fxsub
+
+
+class Node:
+    def __init__(self, cfg):
+        self._fx = cfg.fx_flag
+        self.fxo = fxsub.fx_do if cfg.fx_flag else None
+
+    def ok_if(self):
+        if self._fx:
+            fxsub.fx_do()
+
+    def ok_early(self):
+        if not self._fx:
+            return
+        fxsub.fx_do()
+
+    def ok_ifexp(self):
+        return fxsub.fx_do() if self._fx else None
+
+    def ok_and(self):
+        return self._fx and fxsub.fx_do()
+
+    def ok_alias(self, cfg):
+        armed = cfg.fx_flag and cfg.node_cnt
+        if armed:
+            fxsub.fx_do()
+
+    def ok_attr(self):
+        if self.fxo is not None:
+            self.fxo.poke()
+
+    def ok_route(self, rtype, payload):
+        if rtype == "FXMSG":
+            fxsub.fx_do()            # arrival implies the sender armed it
+
+    def _helper(self):
+        fxsub.fx_other()             # every call site is guarded: silent
+
+    def run(self):
+        if self._fx:
+            self._helper()
+
+    def bad_call(self):
+        fxsub.fx_do()                # EXPECT[gate-unguarded-use]
+
+    def bad_attr(self):
+        self.fxo.poke()              # EXPECT[gate-unguarded-use]
+
+    def bad_after_or(self, cfg):
+        # `a or b` true edge proves only ONE disjunct; no gate
+        if self._fx or cfg.node_cnt:
+            fxsub.fx_do()            # EXPECT[gate-unguarded-use]
+
+
+def esc_ok(cfg, be, planned):
+    return fx_gate(cfg, be, planned.get("order_free"))
+
+
+def esc_bad(planned):
+    return planned.get("order_free")     # EXPECT[gate-escrow-raw]
+
+
+def esc_bad_attr(batch):
+    return batch.order_free              # EXPECT[gate-escrow-raw]
+
+
+def fx_gate(cfg, be, mask):
+    """Fixture escrow gate function (registered via the test)."""
+    return mask if cfg.fx_flag else None

@@ -1,0 +1,19 @@
+"""Seeded graftlint violation: gate-guard-shed (never imported).
+
+A miniature ServerNode that REBINDS a guarded collection outside
+__init__ — the owner_check wrapper lives on the object, so the rebind
+sheds it (the PR 6 _rejoin_pending lesson).  Checked with
+guarded=("pending",) from the test.
+"""
+
+
+class ServerNode:
+    def __init__(self):
+        self.pending = []                # __init__ builds: pre-install
+
+    def _rejoin(self):
+        self.pending = []                # EXPECT[gate-guard-shed]
+
+    def ok_mutate(self):
+        self.pending.clear()
+        self.pending.append(1)

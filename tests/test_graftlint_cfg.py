@@ -1,0 +1,194 @@
+"""Unit tests for the graftlint v2 CFG/dataflow core (PR 9).
+
+The checker families lean on three facts — exception edges, dominance,
+reaching definitions — so each is pinned directly here, independent of
+any rule: a finally intercepts every exit route (normal, exceptional,
+early return), dominance answers the gate family's "must this check
+have run", and reaching defs kill on rebind (the sorted() cleanse).
+"""
+
+import ast
+import textwrap
+
+from tools.graftlint.cfg import (CFG, EXC, FALSE, RET, TRUE, _may_raise,
+                                 own_nodes, reachable_nodes, stmt_defs)
+
+
+def _cfg(src: str) -> CFG:
+    return CFG(ast.parse(textwrap.dedent(src)).body[0])
+
+
+def _block_of_call(c: CFG, name: str):
+    """The block holding the statement that calls `name` (compound
+    statements own only their headers, so a call in an if-BODY resolves
+    to the body block, not the branch block)."""
+    for b in c.blocks:
+        for s in b.stmts:
+            for n in own_nodes(s):
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                        and n.func.id == name:
+                    return b
+    raise AssertionError(f"no block calls {name}")
+
+
+# ---- branch edges + dominance ------------------------------------------
+
+DIAMOND = """
+def f(x):
+    if x:
+        a()
+    else:
+        b()
+    join()
+"""
+
+
+def test_if_edges_are_labeled():
+    c = _cfg(DIAMOND)
+    branch = next(b for b in c.blocks if b.test is not None)
+    kinds = sorted(k for _s, k in branch.succs)
+    assert kinds == [FALSE, TRUE]
+
+
+def test_dominance_diamond():
+    c = _cfg(DIAMOND)
+    branch = next(b for b in c.blocks if b.test is not None)
+    ba, bb = _block_of_call(c, "a"), _block_of_call(c, "b")
+    bj = _block_of_call(c, "join")
+    assert c.dominates(branch, bj)
+    assert not c.dominates(ba, bj) and not c.dominates(bb, bj)
+    assert c.idoms()[bj.id] is branch       # idom of the join = branch
+    assert c.dominates(c.entry, c.exit)
+
+
+# ---- exception edges ----------------------------------------------------
+
+def test_call_gets_exception_edge_to_handler():
+    c = _cfg("""
+    def f():
+        try:
+            work()
+        except ValueError:
+            handle()
+        after()
+    """)
+    bw = _block_of_call(c, "work")
+    bh = _block_of_call(c, "handle")
+    assert any(k == EXC and s is bh for s, k in bw.succs)
+
+
+def test_call_outside_try_raises_to_exit():
+    c = _cfg("""
+    def f():
+        work()
+        after()
+    """)
+    bw = _block_of_call(c, "work")
+    assert any(k == EXC and s is c.exit for s, k in bw.succs)
+
+
+def test_finally_intercepts_return_and_exception():
+    c = _cfg("""
+    def f(x):
+        t = acquire()
+        try:
+            if x:
+                return 0
+            work(t)
+        finally:
+            t.close()
+        return 1
+    """)
+    fin = next(b for b in c.blocks if b.in_finally)
+    # the early return routes THROUGH the finally, not past it
+    ret_blocks = [b for b in c.blocks
+                  if any(isinstance(s, ast.Return) and s.value is not None
+                         and isinstance(s.value, ast.Constant)
+                         and s.value.value == 0 for s in b.stmts)]
+    assert ret_blocks and all(
+        any(k == RET and s.in_finally for s, k in b.succs)
+        for b in ret_blocks)
+    # work(t) raising also lands in the finally
+    bw = _block_of_call(c, "work")
+    assert any(k == EXC and s.in_finally for s, k in bw.succs)
+    # and the finally, having seen a return, can continue to the exit
+    fin_tail = [b for b in c.blocks if b.in_finally]
+    assert any(k == RET and s is c.exit
+               for b in fin_tail for s, k in b.succs)
+    assert fin is not None
+
+
+def test_nested_def_body_does_not_raise():
+    """Defining a closure is not executing it: the def statement must
+    not split the block with an exception edge (the wirebench false
+    positive class)."""
+    stmt = ast.parse(textwrap.dedent("""
+    def settle():
+        for _ in range(200):
+            poll()
+    """)).body[0]
+    assert not _may_raise(stmt)
+    c = _cfg("""
+    def f():
+        t = acquire()
+        def settle():
+            poll()
+        t.close()
+    """)
+    bt = _block_of_call(c, "acquire")
+    # acquire's block continues into close without an intervening
+    # exc-split caused by the nested def
+    nxt = [s for s, k in bt.succs if k != EXC]
+    assert len(nxt) == 1
+    assert any(isinstance(n, ast.Call) and getattr(n.func, "attr", "")
+               == "close" for s in nxt[0].stmts for n in ast.walk(s))
+
+
+# ---- reaching definitions ----------------------------------------------
+
+def test_reaching_defs_branch_join_unions():
+    c = _cfg("""
+    def f(x):
+        if x:
+            v = 1
+        else:
+            v = 2
+        sink(v)
+    """)
+    bj = _block_of_call(c, "sink")
+    reach = c.reaching_defs()[bj.id]
+    assert len(reach["v"]) == 2             # both defs reach the join
+
+
+def test_reaching_defs_rebind_kills():
+    c = _cfg("""
+    def f(d):
+        v = list(d)
+        v = sorted(v)
+        sink(v)
+    """)
+    bj = _block_of_call(c, "sink")
+    reach = c.reaching_defs()[bj.id]
+    assert len(reach["v"]) == 1             # the rebind killed def #1
+
+
+def test_stmt_defs_shapes():
+    mod = ast.parse("a, (b, c) = x\nfor k, v in items: pass\n"
+                    "with open(p) as f: pass")
+    assert stmt_defs(mod.body[0]) == ["a", "b", "c"]
+    assert sorted(stmt_defs(mod.body[1])) == ["k", "v"]
+    assert stmt_defs(mod.body[2]) == ["f"]
+
+
+# ---- reachability -------------------------------------------------------
+
+def test_reachable_nodes_skip_dead_code():
+    c = _cfg("""
+    def f():
+        live()
+        return 1
+        dead()
+    """)
+    calls = {n.func.id for _s, n in reachable_nodes(c)
+             if isinstance(n, ast.Call) and isinstance(n.func, ast.Name)}
+    assert "live" in calls and "dead" not in calls

@@ -5,20 +5,52 @@ Exit status: 0 = clean, 1 = findings, 2 = usage/parse failure.
 Options
 -------
 --select=fam[,fam...]   run only these families
-                        (trace, det, wire, own, imports; default all)
+                        (trace, det, wire, own, imports, gate, life,
+                        jit; default all)
 --root=DIR              tree root for repo-relative paths (default: the
                         repo root containing this tools/ package)
 --json                  machine-readable output (one object per line)
 --list-rules            print the rule catalogue and exit
+--changed[=REF]         incremental mode: lint only the .py files git
+                        reports changed vs REF (default HEAD) plus
+                        untracked ones, intersected with the given
+                        paths.  Best-effort pre-commit signal — the
+                        cross-file families (wire/own/gate) see only
+                        the subset, so the FULL-tree run stays the CI
+                        gate.  Clean exit when nothing changed.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 
 from tools.graftlint.core import FAMILIES, Tree, run_checkers
+
+
+def _changed_paths(root: str, ref: str, scope: list[str]) -> list[str]:
+    """Repo-relative changed + untracked .py files under ``scope`` that
+    still exist on disk (a deleted file must not fail the tree closed)."""
+    out: set[str] = set()
+    for args in (["git", "diff", "--name-only", "-z", ref, "--", "*.py"],
+                 ["git", "ls-files", "-o", "--exclude-standard", "-z",
+                  "--", "*.py"]):
+        r = subprocess.run(args, cwd=root, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"graftlint --changed: {' '.join(args[:3])} failed: "
+                f"{r.stderr.strip()}")
+        out |= {p for p in r.stdout.split("\0") if p}
+    scoped = []
+    for p in sorted(out):
+        if not any(p == s.rstrip("/") or p.startswith(s.rstrip("/") + "/")
+                   for s in scope):
+            continue
+        if os.path.exists(os.path.join(root, p)):
+            scoped.append(p)
+    return scoped
 
 _RULES = {
     "trace": ("trace-branch", "trace-np-call", "trace-host-sync",
@@ -28,6 +60,12 @@ _RULES = {
              "wire-missing-route", "wire-fault-mask", "wire-unknown-rtype"),
     "own": ("own-cross-thread-write", "own-undeclared-attr"),
     "imports": ("imp-unused", "imp-redefined"),
+    "gate": ("gate-unguarded-use", "gate-guard-shed", "gate-escrow-raw",
+             "gate-registry-drift", "gate-rtype-mask"),
+    "life": ("life-unjoined-thread", "life-undrained-future",
+             "life-unclosed-resource"),
+    "jit": ("jit-dynamic-shape", "jit-unhashable-static",
+            "jit-mutable-global", "jit-weak-dtype"),
 }
 
 
@@ -37,7 +75,14 @@ def main(argv: list[str]) -> int:
     families = set(FAMILIES)
     paths: list[str] = []
     as_json = False
+    changed_ref: str | None = None
     for a in argv:
+        if a == "--changed":
+            changed_ref = "HEAD"
+            continue
+        if a.startswith("--changed="):
+            changed_ref = a.split("=", 1)[1]
+            continue
         if a == "--list-rules":
             for fam in FAMILIES:
                 for r in _RULES[fam]:
@@ -61,8 +106,18 @@ def main(argv: list[str]) -> int:
             paths.append(a)
     if not paths:
         paths = ["deneva_tpu", "tools"]
-    # repo root on sys.path so the ownership checker can import the
-    # declarations module (pure data, no jax)
+    if changed_ref is not None:
+        try:
+            paths = _changed_paths(root, changed_ref, paths)
+        except RuntimeError as e:
+            print(e, file=sys.stderr)
+            return 2
+        if not paths:
+            print(f"graftlint: no python files changed vs {changed_ref}",
+                  file=sys.stderr)
+            return 0
+    # repo root on sys.path so the ownership/gate checkers can import
+    # the declarations modules (pure data, no jax)
     if root not in sys.path:
         sys.path.insert(0, root)
     try:

@@ -1,0 +1,624 @@
+"""graftlint v2 core: intraprocedural CFG + dataflow.
+
+One function body becomes a graph of basic blocks.  Design choices are
+driven by what the checker families need:
+
+* **Exception edges.**  Any statement that may raise (a call, an
+  explicit ``raise``/``assert``, a subscript) ends its block and gets an
+  ``EXC`` edge to the innermost enclosing handler chain — through
+  ``finally`` blocks — or to the function exit.  This is what lets the
+  lifecycle family ask "is this resource released on *every* path out,
+  including the ones an exception takes?" (the try/finally-on-worker-
+  loop discipline, checked instead of remembered).
+
+* **Labeled branch edges.**  ``If``/``While``/``Assert`` blocks carry
+  their test expression and distinguish TRUE/FALSE successors, so the
+  gate-consistency family can compute *dominating conditions*: the set
+  of guard flags that must have tested true (or false, for the
+  early-return idiom) on every path reaching a block.
+
+* **Dominance** (Cooper-Harvey-Kennedy over a reverse postorder):
+  ``dominates()`` validates guard ALIASES for the gate family — a
+  local assigned from a guard expression counts at a branch only if
+  its definition block dominates it (guards want MUST semantics), and
+  the family's edge-labeled must-dataflow over these edges is the
+  dominating-conditions analysis itself.
+
+* **Reaching definitions** (forward may-analysis, gen/kill per block):
+  the jit family's mutable-global rule exempts a read only where a
+  local shadowing definition actually reaches it, and ``forward()`` is
+  the generic engine the determinism order-taint runs on.
+
+Blocks deliberately split *after* every may-raise statement, so block
+membership is fine-grained enough that "the release happens before the
+statement that raised" never needs intra-block positions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+# edge kinds
+NEXT = "next"      # straight-line fall-through
+TRUE = "true"      # branch test evaluated truthy
+FALSE = "false"    # branch test evaluated falsy
+EXC = "exc"        # exception propagation
+RET = "ret"        # return / end-of-body edge into the exit block
+LOOP = "loop"      # back edge to a loop header
+
+
+class Block:
+    __slots__ = ("id", "stmts", "test", "succs", "preds", "in_finally")
+
+    def __init__(self, bid: int):
+        self.id = bid
+        self.stmts: list[ast.AST] = []
+        # branch condition this block ends on (If/While test, Assert
+        # condition); None for straight-line blocks
+        self.test: ast.AST | None = None
+        self.succs: list[tuple["Block", str]] = []
+        self.preds: list[tuple["Block", str]] = []
+        # block lies inside a finalbody: release checkers treat its
+        # exception edges as already-hardened (the discipline the
+        # lifecycle family enforces is "release IN a finally", not
+        # "finally bodies may not raise")
+        self.in_finally = False
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        kinds = ",".join(f"{b.id}:{k}" for b, k in self.succs)
+        return f"<B{self.id} n={len(self.stmts)} -> {kinds}>"
+
+
+def _may_raise(stmt: ast.AST) -> bool:
+    """Conservative per-statement raise test.  Calls and subscripts are
+    the raisers that matter for the checker families; plain name/const
+    assignments are the only statements treated as no-throw.  Nested
+    def/lambda BODIES do not execute at the definition statement, so
+    they are skipped (their decorators and default values do run)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        roots: list[ast.AST] = [*stmt.decorator_list,
+                                *stmt.args.defaults,
+                                *(d for d in stmt.args.kw_defaults if d)]
+    else:
+        roots = [stmt]
+    stack = roots
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Call, ast.Subscript, ast.Raise,
+                             ast.Assert, ast.Await, ast.Yield,
+                             ast.YieldFrom)):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+class _Frame:
+    """Enclosing-construct context during the build: where exceptions,
+    breaks, continues and returns go from here.  A ``finally`` rebinds
+    all four to its own entry block (control cannot leave the try
+    without executing it)."""
+
+    __slots__ = ("exc", "brk", "cont", "ret")
+
+    def __init__(self, exc, brk=None, cont=None, ret=None):
+        self.exc = exc      # list[Block]: exception targets (handlers,
+        #                     finally entry, or [exit])
+        self.brk = brk      # break target (after-loop block)
+        self.cont = cont    # continue target (loop header)
+        self.ret = ret      # return target (None = the exit block)
+
+
+def _leaves_early(*stmt_lists) -> set[type]:
+    """Which of {Return, Break, Continue} occur in these statement lists
+    at THIS function's level (nested defs excluded; Break/Continue
+    inside nested loops belong to those loops, but the coarse answer
+    only adds edges, never drops them)."""
+    out: set[type] = set()
+    stack = [s for lst in stmt_lists for s in
+             (lst if isinstance(lst, list) else lst.body)]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(node, (ast.Return, ast.Break, ast.Continue)):
+            out.add(type(node))
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class CFG:
+    """Control-flow graph of one function body."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.blocks: list[Block] = []
+        self.entry = self._new()
+        self.exit = self._new()
+        # statement -> containing block (id(stmt) keyed; statements are
+        # unique nodes within one tree)
+        self.block_of: dict[int, Block] = {}
+        self._build(fn)
+        for b in self.blocks:
+            for s, kind in b.succs:
+                s.preds.append((b, kind))
+        self._rpo: list[Block] | None = None
+        self._idom: dict[int, Block | None] | None = None
+
+    # ---- construction --------------------------------------------------
+
+    def _new(self) -> Block:
+        b = Block(len(self.blocks))
+        self.blocks.append(b)
+        return b
+
+    def _edge(self, a: Block, b: Block, kind: str) -> None:
+        a.succs.append((b, kind))
+
+    def _build(self, fn: ast.AST) -> None:
+        frame = _Frame(exc=[self.exit])
+        last = self._stmts(fn.body, self.entry, frame)
+        if last is not None:
+            self._edge(last, self.exit, RET)
+
+    def _stmts(self, body: list[ast.stmt], cur: Block | None,
+               frame: _Frame) -> Block | None:
+        """Lay out a statement list starting in ``cur``; returns the
+        open fall-through block (None when all paths left the list)."""
+        for stmt in body:
+            if cur is None:          # unreachable code after return/raise
+                cur = self._new()
+            cur = self._stmt(stmt, cur, frame)
+        return cur
+
+    def _stmt(self, stmt: ast.stmt, cur: Block, frame: _Frame
+              ) -> Block | None:
+        self.block_of[id(stmt)] = cur
+        if isinstance(stmt, ast.If):
+            cur.stmts.append(stmt)
+            cur.test = stmt.test
+            body_entry = self._new()
+            self._edge(cur, body_entry, TRUE)
+            body_out = self._stmts(stmt.body, body_entry, frame)
+            after = self._new()
+            if stmt.orelse:
+                else_entry = self._new()
+                self._edge(cur, else_entry, FALSE)
+                else_out = self._stmts(stmt.orelse, else_entry, frame)
+                if else_out is not None:
+                    self._edge(else_out, after, NEXT)
+            else:
+                self._edge(cur, after, FALSE)
+            if body_out is not None:
+                self._edge(body_out, after, NEXT)
+            return after
+        if isinstance(stmt, (ast.While,)):
+            header = self._new()
+            self._edge(cur, header, NEXT)
+            header.stmts.append(stmt)
+            self.block_of[id(stmt)] = header
+            header.test = stmt.test
+            after = self._new()
+            body_entry = self._new()
+            self._edge(header, body_entry, TRUE)
+            self._edge(header, after, FALSE)
+            if frame.exc and _may_raise(stmt.test):
+                # the loop TEST itself can raise (q.get(), a[i], ...)
+                self._edge(header, frame.exc[0], EXC)
+            inner = _Frame(exc=frame.exc, brk=after, cont=header,
+                           ret=frame.ret)
+            body_out = self._stmts(stmt.body, body_entry, inner)
+            if body_out is not None:
+                self._edge(body_out, header, LOOP)
+            if stmt.orelse:
+                # while-else joins at `after` (loop exhausted) — modeled
+                # as straight-line into the same join block
+                self._stmts(stmt.orelse, after, frame)
+            return after
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            header = self._new()
+            self._edge(cur, header, NEXT)
+            header.stmts.append(stmt)       # iterator advance lives here
+            self.block_of[id(stmt)] = header
+            after = self._new()
+            body_entry = self._new()
+            self._edge(header, body_entry, TRUE)   # item produced
+            self._edge(header, after, FALSE)       # exhausted
+            if frame.exc:
+                self._edge(header, frame.exc[0], EXC)  # iter may raise
+            inner = _Frame(exc=frame.exc, brk=after, cont=header,
+                           ret=frame.ret)
+            body_out = self._stmts(stmt.body, body_entry, inner)
+            if body_out is not None:
+                self._edge(body_out, header, LOOP)
+            if stmt.orelse:
+                self._stmts(stmt.orelse, after, frame)
+            return after
+        if isinstance(stmt, (ast.Try,)):
+            return self._try(stmt, cur, frame)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            cur.stmts.append(stmt)
+            # context entry may raise
+            cur = self._raise_split(cur, frame)
+            body_entry = self._new()
+            self._edge(cur, body_entry, NEXT)
+            body_out = self._stmts(stmt.body, body_entry, frame)
+            if body_out is None:
+                return None
+            after = self._new()
+            self._edge(body_out, after, NEXT)
+            return after
+        if isinstance(stmt, ast.Return):
+            cur.stmts.append(stmt)
+            if stmt.value is not None and _may_raise(stmt) and frame.exc:
+                self._edge(cur, frame.exc[0], EXC)
+            self._edge(cur, frame.ret or self.exit, RET)
+            return None
+        if isinstance(stmt, ast.Raise):
+            cur.stmts.append(stmt)
+            for t in frame.exc[:1] or [self.exit]:
+                self._edge(cur, t, EXC)
+            return None
+        if isinstance(stmt, ast.Break):
+            cur.stmts.append(stmt)
+            if frame.brk is not None:
+                self._edge(cur, frame.brk, NEXT)
+            return None
+        if isinstance(stmt, ast.Continue):
+            cur.stmts.append(stmt)
+            if frame.cont is not None:
+                self._edge(cur, frame.cont, LOOP)
+            return None
+        if isinstance(stmt, ast.Assert):
+            # `assert g` is an If(not g: raise): the fall-through edge
+            # carries the TRUE label so assertion guards gate like ifs
+            cur.stmts.append(stmt)
+            cur.test = stmt.test
+            if frame.exc:
+                self._edge(cur, frame.exc[0], EXC)
+            after = self._new()
+            self._edge(cur, after, TRUE)
+            return after
+        # plain statement (Assign/Expr/AugAssign/Delete/Import/Global/
+        # nested FunctionDef/ClassDef/...)
+        cur.stmts.append(stmt)
+        if _may_raise(stmt):
+            cur = self._raise_split(cur, frame)
+        return cur
+
+    def _raise_split(self, cur: Block, frame: _Frame) -> Block:
+        """End the block after a may-raise statement: EXC edge to the
+        innermost handler (or exit), NEXT edge to a fresh block."""
+        if frame.exc:
+            self._edge(cur, frame.exc[0], EXC)
+        nxt = self._new()
+        self._edge(cur, nxt, NEXT)
+        return nxt
+
+    def _try(self, stmt: ast.Try, cur: Block, frame: _Frame
+             ) -> Block | None:
+        after = self._new()
+        if stmt.finalbody:
+            # ONE finally block shared by the normal, exceptional and
+            # early-exit (return/break/continue) routes: its exits are
+            # {after, outer exc target, and — when the body actually
+            # leaves early — the outer return/break/continue targets}.
+            # Path-insensitive (the normal route also "sees" the
+            # propagate edges) but sound for must-pass-through
+            # questions: control cannot leave the try without the
+            # finally executing.
+            fin_entry = self._new()
+            fin_lo = len(self.blocks) - 1
+            fin_out = self._stmts(stmt.finalbody, fin_entry, frame)
+            for b in self.blocks[fin_lo:]:
+                b.in_finally = True
+            if fin_out is not None:
+                self._edge(fin_out, after, NEXT)
+                self._edge(fin_out, frame.exc[0], EXC)
+                leaves = _leaves_early(stmt.body, stmt.handlers)
+                if ast.Return in leaves:
+                    self._edge(fin_out, frame.ret or self.exit, RET)
+                if ast.Break in leaves and frame.brk is not None:
+                    self._edge(fin_out, frame.brk, NEXT)
+                if ast.Continue in leaves and frame.cont is not None:
+                    self._edge(fin_out, frame.cont, LOOP)
+            normal_tgt, exc_chain = fin_entry, [fin_entry]
+            # early exits from the body route through the finally
+            inner_ret = inner_brk = inner_cont = fin_entry
+        else:
+            normal_tgt, exc_chain = after, frame.exc
+            inner_ret, inner_brk, inner_cont = (frame.ret, frame.brk,
+                                                frame.cont)
+        handler_entries = []
+        for h in stmt.handlers:
+            handler_entries.append(self._new())
+        body_exc = handler_entries + ([exc_chain[0]] if not stmt.handlers
+                                      and stmt.finalbody else [])
+        # exceptions in the body go to the FIRST handler entry (handler
+        # dispatch is modeled as a chain below), else straight to the
+        # finally / outer target
+        body_frame = _Frame(exc=(body_exc or exc_chain),
+                            brk=inner_brk, cont=inner_cont, ret=inner_ret)
+        body_entry = self._new()
+        self._edge(cur, body_entry, NEXT)
+        body_out = self._stmts(stmt.body, body_entry, body_frame)
+        if stmt.orelse:
+            if body_out is not None:
+                # try/ELSE runs after the body completed without raising
+                # — its OWN exceptions are NOT caught by this try's
+                # handlers (they go to the finally / outer target)
+                else_frame = _Frame(exc=exc_chain, brk=inner_brk,
+                                    cont=inner_cont, ret=inner_ret)
+                body_out = self._stmts(stmt.orelse, body_out, else_frame)
+        if body_out is not None:
+            self._edge(body_out, normal_tgt, NEXT)
+        # handler chain: entry i may fall to entry i+1 (no match), the
+        # last falls to the enclosing target (re-raise)
+        handler_frame = _Frame(exc=exc_chain, brk=inner_brk,
+                               cont=inner_cont, ret=inner_ret)
+        for i, (h, entry) in enumerate(zip(stmt.handlers,
+                                           handler_entries)):
+            nxt = (handler_entries[i + 1] if i + 1 < len(handler_entries)
+                   else (exc_chain[0] if exc_chain else self.exit))
+            self._edge(entry, nxt, EXC)       # exception type mismatch
+            h_out = self._stmts(h.body, entry, handler_frame)
+            if h_out is not None:
+                self._edge(h_out, normal_tgt, NEXT)
+            self.block_of.setdefault(id(h), entry)
+        return after
+
+    # ---- dominance (Cooper-Harvey-Kennedy) -----------------------------
+
+    def rpo(self) -> list[Block]:
+        """Reverse postorder from the entry (unreachable blocks last)."""
+        if self._rpo is not None:
+            return self._rpo
+        seen: set[int] = set()
+        post: list[Block] = []
+
+        def dfs(b: Block):
+            stack = [(b, iter(b.succs))]
+            seen.add(b.id)
+            while stack:
+                blk, it = stack[-1]
+                adv = False
+                for s, _k in it:
+                    if s.id not in seen:
+                        seen.add(s.id)
+                        stack.append((s, iter(s.succs)))
+                        adv = True
+                        break
+                if not adv:
+                    post.append(blk)
+                    stack.pop()
+
+        dfs(self.entry)
+        order = list(reversed(post))
+        order += [b for b in self.blocks if b.id not in seen]
+        self._rpo = order
+        return order
+
+    def idoms(self) -> dict[int, Block | None]:
+        """Immediate dominators (entry maps to None)."""
+        if self._idom is not None:
+            return self._idom
+        order = [b for b in self.rpo()]
+        index = {b.id: i for i, b in enumerate(order)}
+        idom: dict[int, Block | None] = {self.entry.id: self.entry}
+        changed = True
+        while changed:
+            changed = False
+            for b in order:
+                if b is self.entry:
+                    continue
+                new = None
+                for p, _k in b.preds:
+                    if p.id not in idom or p.id not in index:
+                        continue
+                    if new is None:
+                        new = p
+                    else:
+                        new = self._intersect(new, p, idom, index)
+                if new is not None and idom.get(b.id) is not new:
+                    idom[b.id] = new
+                    changed = True
+        out = {bid: (None if bid == self.entry.id else d)
+               for bid, d in idom.items()}
+        self._idom = out
+        return out
+
+    @staticmethod
+    def _intersect(a: Block, b: Block, idom, index) -> Block:
+        while a is not b:
+            while index[a.id] > index[b.id]:
+                a = idom[a.id]
+            while index[b.id] > index[a.id]:
+                b = idom[b.id]
+        return a
+
+    def dominates(self, a: Block, b: Block) -> bool:
+        """True iff every path entry->b passes through a."""
+        idom = self.idoms()
+        cur: Block | None = b
+        while cur is not None:
+            if cur is a:
+                return True
+            nxt = idom.get(cur.id)
+            if nxt is cur:
+                return cur is a
+            cur = nxt
+        return False
+
+    # ---- generic forward dataflow --------------------------------------
+
+    def forward(self, init, transfer, join):
+        """Iterate ``out[b] = transfer(b, in[b])`` with
+        ``in[b] = join([(pred, kind, out[pred])...])`` to fixpoint;
+        returns (in_facts, out_facts) keyed by block id.  ``init`` seeds
+        the entry's in-fact."""
+        in_f: dict[int, object] = {self.entry.id: init}
+        out_f: dict[int, object] = {}
+        order = self.rpo()
+        changed = True
+        guard = 0
+        while changed and guard < 200:
+            changed = False
+            guard += 1
+            for b in order:
+                if b is self.entry:
+                    inf = init
+                else:
+                    inf = join([(p, k, out_f.get(p.id)) for p, k in b.preds])
+                out = transfer(b, inf)
+                if in_f.get(b.id) != inf or out_f.get(b.id) != out:
+                    in_f[b.id] = inf
+                    out_f[b.id] = out
+                    changed = True
+        return in_f, out_f
+
+    # ---- reaching definitions ------------------------------------------
+
+    def reaching_defs(self):
+        """Forward may-analysis: which ``(name, stmt)`` definitions reach
+        each block entry.  Returns {block id: {name: set of def stmt
+        nodes}}.  Definition sites are Assign/AnnAssign/AugAssign
+        targets, For targets, With as-names, and (conservatively) the
+        function's own parameters at the entry."""
+        defs_of: dict[int, dict[str, list[ast.AST]]] = {}
+        for b in self.blocks:
+            d: dict[str, list[ast.AST]] = {}
+            for stmt in b.stmts:
+                for name in stmt_defs(stmt):
+                    d.setdefault(name, [])
+                    d[name] = [stmt]          # later def in block kills
+            defs_of[b.id] = d
+        params = [a.arg for a in (*self.fn.args.posonlyargs,
+                                  *self.fn.args.args,
+                                  *self.fn.args.kwonlyargs)]
+        init = {p: frozenset({id(self.fn)}) for p in params}
+
+        def transfer(b, inf):
+            out = dict(inf or {})
+            for name, sites in defs_of[b.id].items():
+                out[name] = frozenset(id(s) for s in sites)
+            return out
+
+        def join(preds):
+            acc: dict[str, frozenset] = {}
+            for _p, _k, of in preds:
+                if of is None:
+                    continue
+                for name, sites in of.items():
+                    acc[name] = acc.get(name, frozenset()) | sites
+            return acc
+
+        in_f, _out = self.forward(init, transfer, join)
+        return in_f
+
+
+def stmt_defs(stmt: ast.AST) -> list[str]:
+    """Bare names a statement (re)binds, nested defs excluded."""
+    out: list[str] = []
+
+    def targets(t):
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                targets(e)
+        elif isinstance(t, ast.Starred):
+            targets(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            targets(t)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                targets(item.optional_vars)
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        out.append(stmt.name)
+    return out
+
+
+def own_nodes(stmt: ast.AST):
+    """AST nodes evaluated AT this statement: a simple statement's whole
+    subtree, a compound statement's header expressions only (its body
+    statements live in their own blocks).  Nested def/lambda bodies are
+    skipped everywhere."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        roots: list[ast.AST] = [stmt.test]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        roots = [stmt.target, stmt.iter]
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        roots = [i.context_expr for i in stmt.items] + \
+            [i.optional_vars for i in stmt.items if i.optional_vars]
+    elif isinstance(stmt, ast.Try):
+        return
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        roots = list(stmt.decorator_list)
+    else:
+        roots = [stmt]
+    stack = roots
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def reachable_nodes(graph: CFG):
+    """(statement, node) pairs over ENTRY-REACHABLE blocks only — code
+    behind a `return`/`raise` cannot execute, so families migrated onto
+    the core stop reporting it."""
+    seen: set[int] = set()
+    work = [graph.entry]
+    while work:
+        b = work.pop()
+        if b.id in seen:
+            continue
+        seen.add(b.id)
+        for stmt in b.stmts:
+            for node in own_nodes(stmt):
+                yield stmt, node
+        for s, _k in b.succs:
+            work.append(s)
+
+
+_CFG_CACHE: dict[int, CFG] = {}
+# id()-keyed caches registered here are wiped whenever a new Tree is
+# built (core.Tree.__init__): a fresh parse may reuse the id of a
+# garbage-collected def node, so per-run caches must never outlive the
+# tree they were built against
+CACHES: list[dict] = [_CFG_CACHE]
+
+
+def register_cache(d: dict) -> dict:
+    CACHES.append(d)
+    return d
+
+
+def cfg_of(fn: ast.AST) -> CFG:
+    """Build (and memoize) the CFG of a function def.  Checker families
+    share one graph per function per run."""
+    c = _CFG_CACHE.get(id(fn))
+    if c is None:
+        c = CFG(fn)
+        _CFG_CACHE[id(fn)] = c
+    return c
+
+
+def clear_caches() -> None:
+    for d in CACHES:
+        d.clear()

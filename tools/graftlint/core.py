@@ -81,6 +81,10 @@ class Tree:
     """The analyzed file set + indexes the checkers share."""
 
     def __init__(self, root: str, paths: list[str] | None = None):
+        # a new tree invalidates every id()-keyed per-run cache (CFGs,
+        # statement indexes): a reused node id must never hit stale data
+        from tools.graftlint import cfg as _cfg
+        _cfg.clear_caches()
         self.root = os.path.abspath(root)
         self.modules: list[Module] = []
         self.errors: list[Finding] = []
@@ -192,9 +196,10 @@ def resolved_dotted(mod: Module, node: ast.AST) -> str | None:
 
 def run_checkers(tree: Tree, families: set[str]) -> list[Finding]:
     """Run the selected checker families over a tree (repo layout
-    assumed for wire/own; they no-op when their anchor files are not in
-    the tree, so fixture runs stay self-contained)."""
-    from tools.graftlint import (determinism, imports, ownership,
+    assumed for wire/own/gate; they no-op when their anchor files are
+    not in the tree, so fixture runs stay self-contained)."""
+    from tools.graftlint import (determinism, gateconsistency, imports,
+                                 jitstability, lifecycle, ownership,
                                  tracesafety, wireproto)
 
     findings: list[Finding] = list(tree.errors)
@@ -208,7 +213,14 @@ def run_checkers(tree: Tree, families: set[str]) -> list[Finding]:
         findings += ownership.check(tree)
     if "imports" in families:
         findings += imports.check(tree)
+    if "gate" in families:
+        findings += gateconsistency.check(tree)
+    if "life" in families:
+        findings += lifecycle.check(tree)
+    if "jit" in families:
+        findings += jitstability.check(tree)
     return tree.filter(findings)
 
 
-FAMILIES = ("trace", "det", "wire", "own", "imports")
+FAMILIES = ("trace", "det", "wire", "own", "imports", "gate", "life",
+            "jit")

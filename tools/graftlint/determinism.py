@@ -14,20 +14,29 @@ det-wallclock       `time.time`/`time_ns`/`datetime.now` in a replay-
                     relevant module — wall-clock values differ across
                     runs and nodes (use `time.monotonic` for intervals,
                     epoch-anchored stamps for protocol state).
-det-unordered-iter  a `for` loop over a set (or dict view) whose body
-                    reaches an order-sensitive sink (transport send,
-                    wire encoder, log record packing, state digest):
-                    set order is hash-seed/arrival dependent, so the
-                    emitted byte order diverges across runs/nodes.
-                    Wrap the iterable in `sorted(...)`.
+det-unordered-iter  iteration order of a set or dict escapes into an
+                    order-sensitive sink (transport send, wire encoder,
+                    log record packing, state digest).  v2 is
+                    flow-sensitive over the CFG core: the direct shape
+                    (a sink inside a `for` over a set/dict view) AND
+                    the round-9 soft spot — a plain `for k in d:` (or a
+                    `list(d)` materialization) whose iteration-order
+                    taint flows through locals and `.append`
+                    accumulators into a later sink.  Rebinding through
+                    `sorted(...)` kills the taint (that IS the fix);
+                    dict-ness is inferred from literals, constructors,
+                    annotations and view/setdefault call evidence.
+                    Findings anchor at the tainting iteration, with the
+                    sink line in the message.
 """
 
 from __future__ import annotations
 
 import ast
 
+from tools.graftlint import cfg as C
 from tools.graftlint.core import (Finding, Module, Tree, dotted,
-                                  resolved_dotted)
+                                  resolved_dotted, walk_funcs)
 
 # replay-relevant module prefixes (repo-relative)
 REPLAY_MODULES = (
@@ -43,6 +52,22 @@ REPLAY_MODULES = (
 _SEND_SINKS = frozenset(("send", "sendv", "sendv_many"))
 _NAME_SINKS = frozenset(("pack_record", "pack_record_views",
                          "state_digest"))
+# rebinding through these kills order taint: the result no longer
+# depends on the source's iteration order
+_ORDER_FIXERS = frozenset(("sorted", "len", "sum", "min", "max", "any",
+                           "all"))
+# commutative-associative elementwise folds: accumulating loop items
+# through these is order-insensitive by construction (bool/int AND, OR,
+# MAX — float `+` is NOT here: summation order changes bits)
+_FOLD_CALLS = frozenset(("numpy.maximum", "numpy.minimum", "numpy.fmax",
+                         "numpy.fmin", "numpy.logical_and",
+                         "numpy.logical_or", "jax.numpy.maximum",
+                         "jax.numpy.minimum"))
+_FOLD_OPS = (ast.BitAnd, ast.BitOr)
+_DICT_VIEWS = frozenset(("items", "keys", "values"))
+_DICT_EVIDENCE = _DICT_VIEWS | frozenset(("setdefault", "popitem"))
+_MUT_INTO = frozenset(("append", "add", "extend", "insert",
+                       "appendleft", "update"))
 
 
 def _relevant(rel: str, prefixes) -> bool:
@@ -82,61 +107,117 @@ def _wallclock_finding(mod: Module, node: ast.Call) -> Finding | None:
     return None
 
 
-class _SetVars:
-    """Names / self-attributes assigned a set in this module."""
+class _UnorderedVars:
+    """Names / self-attributes with SET or DICT evidence in a module.
+
+    Sets: assigned a set expression or set-annotated.  Dicts: assigned
+    a dict literal/constructor, dict-annotated, or receiving dict-view/
+    setdefault calls anywhere in the module (evidence-based — the
+    round-9 soft spot was exactly the bare name with no annotation)."""
+
+    _SET_ANN_HEADS = frozenset(("set", "frozenset", "Set", "FrozenSet",
+                                "MutableSet", "AbstractSet"))
+    _DICT_ANN_HEADS = frozenset(("dict", "Dict", "DefaultDict",
+                                 "OrderedDict", "Counter", "Mapping",
+                                 "MutableMapping"))
+    _DICT_CTORS = frozenset(("dict", "defaultdict", "OrderedDict",
+                             "Counter"))
 
     def __init__(self, mod: Module):
-        self.names: set[str] = set()
+        self.sets: set[str] = set()
+        self.dicts: set[str] = set()
+        # dicts PROVEN insertion-stable: built by a comprehension whose
+        # every generator is order-stable (sorted/range) — "sort at the
+        # source" makes every derived view replay-stable
+        self.ordered: set[str] = set()
         for node in ast.walk(mod.tree):
             value = None
-            targets = []
+            targets: list[ast.AST] = []
             if isinstance(node, ast.Assign):
                 value, targets = node.value, node.targets
             elif isinstance(node, ast.AnnAssign):
                 value, targets = node.value, [node.target]
-                if node.annotation is not None \
-                        and self._ann_is_set(node.annotation):
-                    self._add(node.target)
+                if node.annotation is not None:
+                    if self._ann_head(node.annotation, self._SET_ANN_HEADS):
+                        self._add(self.sets, node.target)
+                    elif self._ann_head(node.annotation,
+                                        self._DICT_ANN_HEADS):
+                        self._add(self.dicts, node.target)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _DICT_EVIDENCE:
+                self._add(self.dicts, node.func.value)
             if value is None:
                 continue
-            if self._is_set_expr(value):
+            if isinstance(value, ast.DictComp) and all(
+                    not _unwrap_iter(g.iter) for g in value.generators):
                 for t in targets:
-                    self._add(t)
-
-    _SET_ANN_HEADS = frozenset(("set", "frozenset", "Set", "FrozenSet",
-                                "MutableSet", "AbstractSet"))
+                    self._add(self.ordered, t)
+                continue
+            kind = (self.sets if self._is_set_expr(value) else
+                    self.dicts if self._is_dict_expr(value) else None)
+            if kind is not None:
+                for t in targets:
+                    self._add(kind, t)
 
     @classmethod
-    def _ann_is_set(cls, node: ast.AST) -> bool:
+    def _ann_head(cls, node: ast.AST, heads) -> bool:
         """Exact annotation-head match: `ds: Dataset` must not count
         just because "set" is a substring of the type name."""
-        if isinstance(node, ast.Subscript):       # set[int], Set[str]
-            return cls._ann_is_set(node.value)
+        if isinstance(node, ast.Subscript):       # set[int], dict[str, X]
+            return cls._ann_head(node.value, heads)
         if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
-            return cls._ann_is_set(node.left) or cls._ann_is_set(node.right)
+            return cls._ann_head(node.left, heads) \
+                or cls._ann_head(node.right, heads)
         if isinstance(node, ast.Constant) and isinstance(node.value, str):
             head = node.value.split("[", 1)[0].strip()
-            return head.rsplit(".", 1)[-1] in cls._SET_ANN_HEADS
+            return head.rsplit(".", 1)[-1] in heads
         d = dotted(node)
-        return d is not None and d.rsplit(".", 1)[-1] in cls._SET_ANN_HEADS
+        return d is not None and d.rsplit(".", 1)[-1] in heads
 
     @staticmethod
     def _is_set_expr(node: ast.AST) -> bool:
         if isinstance(node, (ast.Set, ast.SetComp)):
             return True
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
-                and node.func.id in ("set", "frozenset"):
-            return True
-        return False
+        return isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Name) \
+            and node.func.id in ("set", "frozenset")
 
-    def _add(self, target: ast.AST) -> None:
+    @classmethod
+    def _is_dict_expr(cls, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Dict, ast.DictComp)):
+            return True
+        return isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Name) \
+            and node.func.id in cls._DICT_CTORS
+
+    def _add(self, into: set[str], target: ast.AST) -> None:
         d = dotted(target)
         if d is not None:
-            self.names.add(d)
+            into.add(d)
 
-    def is_set(self, node: ast.AST) -> bool:
+    def kind_of(self, node: ast.AST) -> str | None:
+        """'set' / 'dict' when this expression is an unordered
+        collection (by structure or by evidence); None for dicts proven
+        insertion-stable."""
+        if self._is_set_expr(node):
+            return "set"
         d = dotted(node)
-        return d is not None and d in self.names
+        if d is not None:
+            if d in self.ordered:
+                return None
+            if d in self.sets:
+                return "set"
+            if d in self.dicts:
+                return "dict"
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _DICT_VIEWS:
+            base = dotted(node.func.value)
+            if base is not None and base in self.ordered:
+                return None
+            return self.kind_of(node.func.value) or "dict"
+        return None
 
 
 # wrappers that COPY their input's order rather than fixing it: a set
@@ -158,26 +239,198 @@ def _unwrap_iter(it: ast.AST) -> list[ast.AST]:
     return [it]
 
 
+def _iter_kind(uv: _UnorderedVars, it: ast.AST) -> str | None:
+    """'set'/'dict' when iterating this expression yields hash/arrival-
+    dependent order."""
+    for inner in _unwrap_iter(it):
+        k = uv.kind_of(inner)
+        if k is not None:
+            return k
+    return None
+
+
+def _sink_call(node: ast.AST) -> ast.Call | None:
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in _SEND_SINKS:
+            return node
+        if f.attr == "append" and "logger" in (dotted(f.value) or ""):
+            return node
+    if isinstance(f, ast.Name):
+        if f.id in _NAME_SINKS or f.id.startswith("encode_"):
+            return node
+    d = dotted(f)
+    if d is not None and (d.split(".")[-1] in _NAME_SINKS
+                          or d.split(".")[-1].startswith("encode_")):
+        return node
+    return None
+
+
 def _body_sink(body: list[ast.stmt]) -> ast.Call | None:
     """First order-sensitive sink call in a loop body."""
     for stmt in body:
         for node in ast.walk(stmt):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if isinstance(f, ast.Attribute):
-                if f.attr in _SEND_SINKS:
-                    return node
-                if f.attr == "append" and "logger" in (dotted(f.value) or ""):
-                    return node
-            if isinstance(f, ast.Name):
-                if f.id in _NAME_SINKS or f.id.startswith("encode_"):
-                    return node
-            d = dotted(f)
-            if d is not None and (d.split(".")[-1] in _NAME_SINKS
-                                  or d.split(".")[-1].startswith("encode_")):
-                return node
+            s = _sink_call(node)
+            if s is not None:
+                return s
     return None
+
+
+# ---- flow-sensitive order taint over the CFG core ----------------------
+
+class _OrderTaint:
+    """Forward dataflow: which names carry iteration-order taint, and
+    which unordered iteration seeded it.  Facts are {name: frozenset of
+    seed keys}; joins union, rebinds kill (`ks = sorted(ks)` cleanses),
+    `.append`-style mutations accumulate."""
+
+    def __init__(self, mod: Module, uv: _UnorderedVars, fn: ast.AST):
+        self.mod = mod
+        self.uv = uv
+        self.fn = fn
+        self.seeds: dict[int, tuple[ast.AST, str]] = {}
+        self.sink_hits: list[tuple[int, ast.AST]] = []  # (seed, sink)
+        graph = C.cfg_of(fn)
+
+        def transfer(block: C.Block, inf):
+            state = dict(inf or {})
+            for stmt in block.stmts:
+                self._stmt(stmt, state)
+            return state
+
+        def join(preds):
+            acc: dict[str, frozenset] = {}
+            for _p, _k, of in preds:
+                if of is None:
+                    continue
+                for name, s in of.items():
+                    acc[name] = acc.get(name, frozenset()) | s
+            return acc
+
+        graph.forward({}, transfer, join)
+
+    def _seed(self, node: ast.AST, kind: str) -> frozenset:
+        key = id(node)
+        self.seeds.setdefault(key, (node, kind))
+        return frozenset((key,))
+
+    def _expr(self, node: ast.AST, state) -> frozenset:
+        """Order taint of an expression: referenced tainted names plus
+        fresh materializations of unordered iterables; order-fixing
+        calls kill."""
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _ORDER_FIXERS:
+                return frozenset()
+            fd = resolved_dotted(self.mod, node.func)
+            if fd in _FOLD_CALLS:
+                # glo = np.maximum(glo, bnd) across loop items: a
+                # commutative-associative fold, order-insensitive
+                return frozenset()
+            # list(d)/tuple(s)/d.items() materialize unordered order
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _ORDER_COPYING:
+                kinds = [self.uv.kind_of(i) for i in _unwrap_iter(node)]
+                kinds = [k for k in kinds if k]
+                if kinds:
+                    return self._seed(node, kinds[0]) | frozenset().union(
+                        *(self._expr(a, state) for a in node.args))
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _DICT_VIEWS:
+                k = self.uv.kind_of(node)
+                if k is not None:
+                    return self._seed(node, k)
+        out: frozenset = frozenset()
+        d = dotted(node)
+        if d is not None and d in state:
+            return state[d]
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            out |= self._expr(child, state)
+        return out
+
+    def _assign(self, target: ast.AST, taint: frozenset, state) -> None:
+        d = dotted(target)
+        if d is not None:
+            if taint:
+                state[d] = taint
+            else:
+                state.pop(d, None)      # rebind kills (sorted() fix)
+            return
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign(e, taint, state)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, taint, state)
+        elif isinstance(target, ast.Subscript):
+            d = dotted(target.value)
+            if d is not None and taint:
+                state[d] = state.get(d, frozenset()) | taint
+
+    def _stmt(self, stmt: ast.AST, state) -> None:
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            kind = _iter_kind(self.uv, stmt.iter)
+            if kind is not None:
+                self._assign(stmt.target, self._seed(stmt, kind), state)
+            else:
+                self._assign(stmt.target, self._expr(stmt.iter, state),
+                             state)
+            return
+        if isinstance(stmt, (ast.If, ast.While, ast.Try,
+                             ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return                   # bodies live in their own blocks
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    self._assign(item.optional_vars,
+                                 self._expr(item.context_expr, state),
+                                 state)
+            return
+        # sinks first: the RHS state is the pre-statement state
+        for node in ast.walk(stmt):
+            sink = _sink_call(node)
+            if sink is None:
+                continue
+            taint = frozenset().union(
+                frozenset(),
+                *(self._expr(a, state) for a in sink.args),
+                *(self._expr(k.value, state) for k in sink.keywords))
+            for key in taint:
+                self.sink_hits.append((key, sink))
+        if isinstance(stmt, ast.Assign):
+            t = self._expr(stmt.value, state)
+            for target in stmt.targets:
+                self._assign(target, t, state)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._assign(stmt.target, self._expr(stmt.value, state),
+                         state)
+        elif isinstance(stmt, ast.AugAssign):
+            if isinstance(stmt.op, _FOLD_OPS):
+                # commit_g &= c / abort_g |= a across loop items:
+                # commutative-associative folds carry no order taint
+                return
+            t = self._expr(stmt.value, state) | self._expr(stmt.target,
+                                                           state)
+            if t:
+                self._assign(stmt.target, t, state)
+        else:
+            # weak defs: out.append(k) taints the accumulator
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUT_INTO:
+                    t = frozenset().union(
+                        frozenset(),
+                        *(self._expr(a, state) for a in node.args))
+                    if t:
+                        d = dotted(node.func.value)
+                        if d is not None:
+                            state[d] = state.get(d, frozenset()) | t
 
 
 def check(tree: Tree, prefixes=REPLAY_MODULES) -> list[Finding]:
@@ -185,38 +438,51 @@ def check(tree: Tree, prefixes=REPLAY_MODULES) -> list[Finding]:
     for m in tree.modules:
         if not _relevant(m.rel, prefixes):
             continue
-        setvars = _SetVars(m)
+        uv = _UnorderedVars(m)
         for node in ast.walk(m.tree):
             if isinstance(node, ast.Call):
                 for f in (_rng_finding(m, node), _wallclock_finding(m, node)):
                     if f is not None:
                         findings.append(f)
-            elif isinstance(node, ast.For):
-                unordered = None
-                for it in _unwrap_iter(node.iter):
-                    if setvars.is_set(it) or _SetVars._is_set_expr(it):
-                        unordered = "set"
-                    elif isinstance(it, ast.Call) \
-                            and isinstance(it.func, ast.Attribute) \
-                            and it.func.attr in ("items", "values", "keys") \
-                            and setvars.is_set(it.func.value):
-                        unordered = "set"    # set has no .items, but be safe
-                    elif isinstance(it, ast.Call) \
-                            and isinstance(it.func, ast.Attribute) \
-                            and it.func.attr in ("items", "values", "keys"):
-                        unordered = "dict"
-                    if unordered is not None:
-                        break
-                if unordered is None:
+        # direct shape: sink lexically inside an unordered for body
+        direct: set[int] = set()
+        for node in ast.walk(m.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            kind = _iter_kind(uv, node.iter)
+            if kind is None:
+                continue
+            sink = _body_sink(node.body)
+            if sink is None:
+                continue
+            direct.add(id(node))
+            what = ast.unparse(node.iter)
+            findings.append(Finding(
+                "det-unordered-iter", m.rel, node.lineno,
+                f"iteration over {kind} `{what}` reaches an "
+                f"order-sensitive sink (line {sink.lineno}) — {kind} "
+                f"order is not replay-stable; wrap in sorted(...)"))
+        # flow-sensitive shape: iteration-order taint reaching a sink
+        # through locals / accumulators (the round-9 bare-for-over-dict
+        # soft spot)
+        for fn, _cls in walk_funcs(m.tree):
+            ot = _OrderTaint(m, uv, fn)
+            reported: set[tuple[int, int]] = set()
+            for key, sink in ot.sink_hits:
+                seed, kind = ot.seeds[key]
+                if id(seed) in direct:
+                    continue         # already reported lexically
+                at = (seed.lineno, sink.lineno)
+                if at in reported:
                     continue
-                it = node.iter
-                sink = _body_sink(node.body)
-                if sink is None:
-                    continue
-                what = ast.unparse(it)
+                reported.add(at)
+                what = ast.unparse(seed.iter) \
+                    if isinstance(seed, (ast.For, ast.AsyncFor)) \
+                    else ast.unparse(seed)
                 findings.append(Finding(
-                    "det-unordered-iter", m.rel, node.lineno,
-                    f"iteration over {unordered} `{what}` reaches an "
-                    f"order-sensitive sink (line {sink.lineno}) — {unordered} "
-                    f"order is not replay-stable; wrap in sorted(...)"))
+                    "det-unordered-iter", m.rel, seed.lineno,
+                    f"{kind} iteration order of `{what}` flows into an "
+                    f"order-sensitive sink (line {sink.lineno}) — "
+                    f"{kind} order is not replay-stable; sort at the "
+                    f"source"))
     return findings

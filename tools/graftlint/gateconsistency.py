@@ -1,0 +1,673 @@
+"""gate family: default-off subsystems stay behind their flags.
+
+Every subsystem PR ships under the same contract — default-off,
+bit-identical when off (chaos PR 1, elastic PR 4, geo PR 7, overload
+PR 8).  The reviewable half of that contract is control flow: a use of
+the subsystem must be *dominated* by its registered config-flag check.
+The declarations live with the runtime (`deneva_tpu/runtime/gates.py`);
+gated rtypes are declared in `wiremodel.py` rows (``gate=``).
+
+Rules
+-----
+gate-unguarded-use    a call into a gated subsystem's home module, a
+                      deeper access on a subsystem object attr, or a
+                      registered use-call is reachable without the
+                      subsystem's flag having tested true on every
+                      path (CFG dominating-condition analysis; guard
+                      aliases through locals, IfExp/BoolOp short-
+                      circuit gating, `rtype == "<gated>"` route
+                      branches, and whole-functions-only-called-under-
+                      the-gate all count).
+gate-guard-shed       a ServerNode method REBINDS a GUARDED collection
+                      (`self.pending = ...`) outside __init__ — the
+                      owner_check wrapper lives on the object, so a
+                      rebind silently sheds the guard (PR 6's
+                      _rejoin_pending lesson).  Mutate in place.
+gate-escrow-raw       the raw workload `order_free` mask is consumed
+                      outside the registered escrow gate functions
+                      (cc/base.gate_order_free is "the ONE escrow
+                      gate"); an ungated consumer would honor
+                      commutativity the config said to ignore.
+gate-registry-drift   a registry flag is not a Config field / its
+                      default is not off; or a wiremodel row names an
+                      unregistered gate subsystem.
+gate-rtype-mask       a gated rtype is inside FAULT_RTYPE_MASK — gated
+                      control-plane traffic must never be silently
+                      droppable (the PR 4 "rtypes 15-17 outside the
+                      mask" rule, generalized).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import cfg as C
+from tools.graftlint.core import (Finding, Module, Tree,
+                                  resolved_dotted, walk_funcs)
+from tools.graftlint.wiremodel import WIRE_MODEL
+
+_FALSY = (False, 0, 0.0, "", None)
+
+
+def _load_decls():
+    from deneva_tpu.runtime import gates as g
+    return (g.GATES, g.EXEMPT_PREFIXES, g.ESCROW_GATE_FUNCS,
+            g.ESCROW_HOME_PREFIXES, g.CONFIG_MODULE)
+
+
+def _load_guarded():
+    from deneva_tpu.runtime import ownercheck as oc
+    return oc.GUARDED
+
+
+def _home_dotted(rel: str) -> str:
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+def _leaf(node: ast.AST) -> str | None:
+    """Final attribute (or bare name) of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class _Gates:
+    """Per-run state: registry, per-function analyses, call index."""
+
+    def __init__(self, tree: Tree, gates, exempt, model):
+        self.tree = tree
+        self.gates = gates
+        self.exempt = exempt
+        self.model = model
+        # guard leaf -> subsystems it gates
+        self.guard_subs: dict[str, set[str]] = {}
+        for name, spec in gates.items():
+            for g in spec.all_guards():
+                self.guard_subs.setdefault(g, set()).add(name)
+        # requires-closure: establishing S establishes everything S
+        # requires armed (config.validate enforces the implication)
+        self._closure_cache: dict[frozenset, frozenset] = {}
+        # gated rtype string -> subsystem
+        self.rtype_gate = {s.name: s.gate for s in model.values() if s.gate}
+        # home module dotted prefix -> subsystem
+        self.home_subs: list[tuple[str, str]] = []
+        for name, spec in gates.items():
+            for rel in spec.home:
+                self.home_subs.append((_home_dotted(rel), name))
+        self.use_attr_subs: dict[str, set[str]] = {}
+        self.use_call_subs: dict[str, set[str]] = {}
+        for name, spec in gates.items():
+            for a in spec.use_attrs:
+                self.use_attr_subs.setdefault(a, set()).add(name)
+            for c in spec.use_calls:
+                self.use_call_subs.setdefault(c, set()).add(name)
+        self.context_subs: dict[str, set[str]] = {}
+        for name, spec in gates.items():
+            for fq in spec.context:
+                self.context_subs.setdefault(fq, set()).add(name)
+        # fn analyses keyed by id(fn): (module, cfg, gates_in, aliases)
+        self._fn: dict[int, tuple] = {}
+        self._fn_meta: dict[int, tuple[Module, str | None]] = {}
+        for m in tree.modules:
+            for fn, cls in walk_funcs(m.tree):
+                self._fn_meta[id(fn)] = (m, cls)
+        # call index: callee name -> [(module, call node, enclosing fn)]
+        self.calls: dict[str, list[tuple[Module, ast.Call, ast.AST]]] = {}
+        for m in tree.modules:
+            for fn, _cls in walk_funcs(m.tree):
+                for node in _own_walk(fn):
+                    if isinstance(node, ast.Call):
+                        nm = None
+                        if isinstance(node.func, ast.Name):
+                            nm = node.func.id
+                        elif isinstance(node.func, ast.Attribute):
+                            nm = node.func.attr
+                        if nm:
+                            self.calls.setdefault(nm, []).append(
+                                (m, node, fn))
+        self._ctx_cache: dict[tuple[int, str], bool] = {}
+
+    # ---- guard classification ------------------------------------------
+
+    def closure(self, subs) -> frozenset:
+        key = frozenset(subs)
+        hit = self._closure_cache.get(key)
+        if hit is not None:
+            return hit
+        out = set(key)
+        work = list(key)
+        while work:
+            s = work.pop()
+            for req in getattr(self.gates.get(s), "requires", ()):
+                if req not in out:
+                    out.add(req)
+                    work.append(req)
+        res = frozenset(out)
+        self._closure_cache[key] = res
+        return res
+
+    def _base(self, node: ast.AST, aliases: dict[str, set[str]]
+              ) -> set[str]:
+        leaf = _leaf(node)
+        if leaf is None:
+            if isinstance(node, ast.Call):
+                return self._base(node.func, aliases)
+            return set()
+        subs = set(self.guard_subs.get(leaf, ()))
+        if isinstance(node, ast.Name):
+            subs |= aliases.get(leaf, set())
+        return subs
+
+    def classify(self, test: ast.AST, aliases) -> tuple[set, set]:
+        """(gates on the TRUE edge, gates on the FALSE edge).  Both
+        sides are closed over `requires` (geo true => elastic true)."""
+        pos, neg = self._classify(test, aliases)
+        return set(self.closure(pos)), set(self.closure(neg))
+
+    def _classify(self, test: ast.AST, aliases) -> tuple[set, set]:
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            pos, neg = self._classify(test.operand, aliases)
+            return neg, pos
+        if isinstance(test, ast.BoolOp):
+            parts = [self._classify(v, aliases) for v in test.values]
+            if isinstance(test.op, ast.And):
+                # `a and b` true => every conjunct true; false => at
+                # least one falsy (gates only when EVERY conjunct would
+                # establish it falsy)
+                return (set().union(*(p for p, _n in parts)),
+                        set.intersection(*(n for _p, n in parts)))
+            # `a or b` true => at least one truthy; gates only when
+            # EVERY disjunct establishes it (the three-fault-knob Or)
+            return (set.intersection(*(p for p, _n in parts)),
+                    set().union(*(n for _p, n in parts)))
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            left, op, right = test.left, test.ops[0], test.comparators[0]
+            # rtype route branch: `rtype == "LOG_ACK"` (either side)
+            for a, b in ((left, right), (right, left)):
+                if isinstance(b, ast.Constant) and isinstance(b.value, str) \
+                        and b.value in self.rtype_gate \
+                        and isinstance(op, ast.Eq) \
+                        and (isinstance(a, ast.Subscript)
+                             or _leaf(a) in ("rtype",)):
+                    return {self.rtype_gate[b.value]}, set()
+            # guard vs falsy constant / None (plus the `tenant_cnt > 1`
+            # shape: strictly above its inert default still arms it)
+            for a, b in ((left, right), (right, left)):
+                base = self._base(a, aliases)
+                if not base or not isinstance(b, ast.Constant):
+                    continue
+                falsy = b.value in _FALSY
+                if isinstance(op, ast.Gt) and (falsy or isinstance(
+                        b.value, (int, float))):
+                    return base, set()
+                if not falsy:
+                    continue
+                if isinstance(op, (ast.IsNot, ast.NotEq)):
+                    return base, set()
+                # NOT Lt: `guard < 0` being false proves only >= 0,
+                # which includes the off value
+                if isinstance(op, (ast.Is, ast.Eq, ast.LtE)):
+                    return set(), base
+            return set(), set()
+        base = self._base(test, aliases)
+        return base, set()
+
+    def _alias_defs(self, graph: C.CFG) -> list[tuple]:
+        """Guard-alias DEFINITION sites: [(block, name, subs)] for local
+        assigns whose RHS references a guard (`supervise =
+        cfg.faults_enabled and cfg.logging`, `kill =
+        cfg.fault_kill_spec()`).  An alias only counts at a branch its
+        def-block DOMINATES (core `dominates()`): guards want MUST
+        semantics — a def that happens on only some paths to the test
+        proves nothing there.  Two rounds resolve aliases of aliases."""
+        cands: list[tuple[C.Block, ast.Assign]] = []
+        for b in graph.blocks:
+            for stmt in b.stmts:
+                if isinstance(stmt, ast.Assign) \
+                        and len(stmt.targets) == 1 \
+                        and isinstance(stmt.targets[0], ast.Name):
+                    cands.append((b, stmt))
+        defs: list[tuple] = []
+        for _ in range(2):              # aliases of aliases
+            nxt: list[tuple] = []
+            for b, stmt in cands:
+                vis = _aliases_at(defs, graph, b)
+                subs: set[str] = set()
+                for sub in ast.walk(stmt.value):
+                    if isinstance(sub, (ast.Name, ast.Attribute)):
+                        subs |= self._base(sub, vis)
+                if subs:
+                    nxt.append((b, stmt.targets[0].id, subs))
+            defs = nxt
+        return defs
+
+    # ---- per-function dataflow -----------------------------------------
+
+    def analyze(self, fn: ast.AST):
+        """(cfg, gates_in per block id, alias defs) for a function."""
+        hit = self._fn.get(id(fn))
+        if hit is not None:
+            return hit
+        graph = C.cfg_of(fn)
+        alias_defs = self._alias_defs(graph)
+        in_f: dict[int, frozenset | None] = {graph.entry.id: frozenset()}
+        order = graph.rpo()
+        edge_cache: dict[int, tuple[set, set]] = {}
+
+        def edge_gates(pred: C.Block, kind: str) -> frozenset:
+            if pred.test is None or kind not in (C.TRUE, C.FALSE):
+                return frozenset()
+            pn = edge_cache.get(pred.id)
+            if pn is None:
+                pn = self.classify(pred.test,
+                                   _aliases_at(alias_defs, graph, pred))
+                edge_cache[pred.id] = pn
+            return frozenset(pn[0] if kind == C.TRUE else pn[1])
+
+        changed = True
+        guard = 0
+        while changed and guard < 100:
+            changed = False
+            guard += 1
+            for b in order:
+                if b is graph.entry:
+                    continue
+                acc: frozenset | None = None
+                for p, kind in b.preds:
+                    pf = in_f.get(p.id)
+                    if pf is None:
+                        continue        # optimistic: not yet computed
+                    ef = pf | edge_gates(p, kind)
+                    acc = ef if acc is None else (acc & ef)
+                if acc is not None and in_f.get(b.id) != acc:
+                    in_f[b.id] = acc
+                    changed = True
+        res = (graph, in_f, alias_defs)
+        self._fn[id(fn)] = res
+        return res
+
+    # ---- use detection --------------------------------------------------
+
+    def uses_in(self, mod: Module, node: ast.AST) -> set[str]:
+        """Subsystems this single expression node uses."""
+        subs: set[str] = set()
+        if isinstance(node, ast.Call):
+            rd = resolved_dotted(mod, node.func)
+            if rd:
+                for homed, s in self.home_subs:
+                    if rd == homed or rd.startswith(homed + "."):
+                        subs.add(s)
+            nm = _leaf(node.func)
+            if nm in self.use_call_subs:
+                subs |= self.use_call_subs[nm]
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            # deeper access on a subsystem object: self.adm.admit — the
+            # BARE attr (truthiness test) is the guard, not a use
+            inner = node.value
+            leaf = _leaf(inner)
+            if leaf in self.use_attr_subs:
+                subs |= self.use_attr_subs[leaf]
+        if not subs:
+            return subs
+        # lazy from-imports of a home module inside a function are uses
+        # only via the calls they enable, not by themselves.  A module
+        # homed to S2 is exempt from everything S2 requires armed (the
+        # geo tier may use the membership layer freely).
+        homed = self.closure(s2 for s2, spec in
+                             ((n, self.gates[n]) for n in self.gates)
+                             if mod.rel.startswith(tuple(spec.home)))
+        return {s for s in subs
+                if s not in homed
+                and not mod.rel.startswith(self.exempt)}
+
+    # ---- interprocedural context ----------------------------------------
+
+    def fn_context(self, fn: ast.AST, sub: str, stack: frozenset = frozenset()
+                   ) -> bool:
+        """Is this whole function only reachable with ``sub`` armed?
+        True when it is a declared context entry, defined in the
+        subsystem's home, or EVERY resolvable call site is guarded."""
+        key = (id(fn), sub)
+        hit = self._ctx_cache.get(key)
+        if hit is not None:
+            return hit
+        if id(fn) in stack:
+            return False
+        mod, cls = self._fn_meta.get(id(fn), (None, None))
+        if mod is None:
+            return False
+        ok = False
+        names = {fn.name}
+        if cls:
+            names.add(f"{cls}.{fn.name}")
+        if any(sub in self.context_subs.get(n, ()) for n in names):
+            ok = True
+        elif sub in self.closure(
+                n for n in self.gates
+                if mod.rel.startswith(tuple(self.gates[n].home))):
+            ok = True
+        else:
+            sites = self.calls.get(fn.name, ())
+            ok = bool(sites)
+            for sm, call, enc in sites:
+                if sm.rel.startswith(self.exempt) \
+                        or sm.rel.startswith(
+                            tuple(self.gates[sub].home) or ("-",)):
+                    continue
+                graph, in_f, _al = self.analyze(enc)
+                blk = graph.block_of.get(id(_stmt_of(enc, call)))
+                gates = in_f.get(blk.id) if blk is not None else None
+                if gates is not None and sub in gates:
+                    continue
+                if self.fn_context(enc, sub, stack | {id(fn)}):
+                    continue
+                ok = False
+                break
+        self._ctx_cache[key] = ok
+        return ok
+
+
+def _aliases_at(defs: list, graph: C.CFG, block: C.Block
+                ) -> dict[str, set[str]]:
+    """Guard aliases VALID at a block: defs whose block dominates it
+    (same-block defs precede the block-ending test by construction)."""
+    out: dict[str, set[str]] = {}
+    for db, name, subs in defs:
+        if db is block or graph.dominates(db, block):
+            out.setdefault(name, set()).update(subs)
+    return out
+
+
+def _own_walk(fn: ast.AST):
+    """Walk a function's own body, skipping nested defs/lambdas."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+_STMT_CACHE: dict[int, dict[int, ast.stmt]] = C.register_cache({})
+
+
+def _stmt_of(fn: ast.AST, node: ast.AST) -> ast.stmt | None:
+    """The function-level statement a nested expression node belongs
+    to (for block lookup)."""
+    index = _STMT_CACHE.get(id(fn))
+    if index is None:
+        index = {}
+        for node_, stmt in _stmt_pairs(fn):
+            index[id(node_)] = stmt
+        _STMT_CACHE[id(fn)] = index
+    return index.get(id(node))
+
+
+def _stmt_pairs(fn: ast.AST):
+    """(descendant node, owning statement) pairs; compound statements
+    own only their header expressions (their bodies' statements own
+    themselves)."""
+    work: list[tuple[ast.AST, ast.stmt | None]] = [
+        (s, None) for s in fn.body]
+    while work:
+        node, owner = work.pop()
+        if isinstance(node, ast.stmt):
+            owner = node
+        yield node, owner
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        for child in ast.iter_child_nodes(node):
+            work.append((child, owner))
+
+
+def _own_exprs(stmt: ast.AST):
+    """Expressions evaluated AT this statement (compound bodies live in
+    their own blocks and are scanned there)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        yield stmt.test
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        yield stmt.target
+        yield stmt.iter
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            yield item.context_expr
+    elif isinstance(stmt, ast.Try):
+        return
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+        return
+    else:
+        yield stmt
+
+
+def check(tree: Tree, gates=None, exempt=None, escrow_funcs=None,
+          escrow_home=None, config_module=None, guarded=None,
+          model=None) -> list[Finding]:
+    if gates is None:
+        try:
+            (gates, d_exempt, d_escrow_funcs, d_escrow_home,
+             d_config) = _load_decls()
+        except ImportError:
+            return []                  # fixture tree without the runtime
+        exempt = exempt if exempt is not None else d_exempt
+        escrow_funcs = escrow_funcs if escrow_funcs is not None \
+            else d_escrow_funcs
+        escrow_home = escrow_home if escrow_home is not None \
+            else d_escrow_home
+        config_module = config_module or d_config
+    exempt = tuple(exempt or ())
+    model = model if model is not None else WIRE_MODEL
+    st = _Gates(tree, gates, exempt, model)
+    findings: list[Finding] = []
+    findings += _check_registry(tree, gates, model, config_module)
+    findings += _check_uses(tree, st)
+    findings += _check_guard_shed(tree, guarded)
+    findings += _check_escrow(tree, escrow_funcs or (),
+                              tuple(escrow_home or ()), exempt)
+    return findings
+
+
+def _check_registry(tree: Tree, gates, model, config_module
+                    ) -> list[Finding]:
+    findings: list[Finding] = []
+    cfg_mod = tree.module(config_module) if config_module else None
+    if cfg_mod is not None:
+        fields: dict[str, ast.AST | None] = {}
+        props: set[str] = set()
+        for node in ast.walk(cfg_mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Config":
+                for stmt in node.body:
+                    if isinstance(stmt, ast.AnnAssign) \
+                            and isinstance(stmt.target, ast.Name):
+                        fields[stmt.target.id] = stmt.value
+                    elif isinstance(stmt, (ast.FunctionDef,)):
+                        props.add(stmt.name)
+        for name, spec in sorted(gates.items()):
+            for flag in spec.flags:
+                if flag not in fields:
+                    findings.append(Finding(
+                        "gate-registry-drift", cfg_mod.rel, 1,
+                        f"gate {name!r} registers flag {flag!r} which is "
+                        f"not a Config field (runtime/gates.py has "
+                        f"drifted from config.py)"))
+                    continue
+                default = fields[flag]
+                if not (isinstance(default, ast.Constant)
+                        and (default.value in _FALSY
+                             and default.value is not True)):
+                    findings.append(Finding(
+                        "gate-registry-drift", cfg_mod.rel,
+                        getattr(default, "lineno", 1) or 1,
+                        f"gate {name!r} flag {flag!r} does not default "
+                        f"OFF — a default-on subsystem breaks the "
+                        f"bit-identical-when-off contract"))
+    # wiremodel gate names must be registered subsystems, and a gated
+    # rtype must be OUTSIDE the fault mask
+    reg_rel = config_module or "deneva_tpu/config.py"
+    for spec in model.values():
+        if not spec.gate:
+            continue
+        if spec.gate not in gates:
+            findings.append(Finding(
+                "gate-registry-drift", reg_rel, 1,
+                f"wiremodel rtype {spec.name!r} names unregistered gate "
+                f"subsystem {spec.gate!r}"))
+        if spec.fault_mask:
+            findings.append(Finding(
+                "gate-rtype-mask", reg_rel, 1,
+                f"rtype {spec.name!r} is gated by {spec.gate!r} but "
+                f"sits INSIDE FAULT_RTYPE_MASK — gated control-plane "
+                f"traffic must never be silently droppable"))
+    return findings
+
+
+def _check_uses(tree: Tree, st: _Gates) -> list[Finding]:
+    findings: list[Finding] = []
+    seen: set[tuple] = set()
+    for m in tree.modules:
+        if not m.rel.startswith("deneva_tpu/") \
+                or m.rel.startswith(st.exempt):
+            continue
+        for fn, _cls in walk_funcs(m.tree):
+            graph = None
+            for stmt_node in _own_walk(fn):
+                if not isinstance(stmt_node, ast.stmt):
+                    continue
+                for expr in _own_exprs(stmt_node):
+                    pending = _scan_expr(st, m, expr, frozenset())
+                    if not pending:
+                        continue
+                    if graph is None:
+                        graph, in_f, _al = st.analyze(fn)
+                    blk = graph.block_of.get(id(stmt_node))
+                    blk_gates = in_f.get(blk.id, frozenset()) \
+                        if blk is not None else frozenset()
+                    if blk_gates is None:
+                        blk_gates = frozenset()
+                    for node, sub, local in pending:
+                        if sub in blk_gates or sub in local:
+                            continue
+                        if st.fn_context(fn, sub):
+                            continue
+                        key = (m.rel, node.lineno, sub)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        spec = st.gates[sub]
+                        findings.append(Finding(
+                            "gate-unguarded-use", m.rel, node.lineno,
+                            f"use of default-off subsystem {sub!r} in "
+                            f"`{fn.name}` is not dominated by its flag "
+                            f"check ({'/'.join(spec.flags)}) — gate it "
+                            f"or register the context in "
+                            f"runtime/gates.py"))
+    return findings
+
+
+def _scan_expr(st: _Gates, m: Module, expr: ast.AST,
+               gates: frozenset) -> list[tuple[ast.AST, str, frozenset]]:
+    """(node, subsystem, local expression gates) for uses under this
+    expression, honoring IfExp / and-or short-circuit gating."""
+    out: list[tuple[ast.AST, str, frozenset]] = []
+
+    def rec(node: ast.AST, g: frozenset):
+        if isinstance(node, ast.IfExp):
+            pos, neg = st.classify(node.test, {})
+            rec(node.test, g)
+            rec(node.body, g | pos)
+            rec(node.orelse, g | neg)
+            return
+        if isinstance(node, ast.BoolOp):
+            acc = g
+            for v in node.values:
+                rec(v, acc)
+                pos, neg = st.classify(v, {})
+                acc = acc | (pos if isinstance(node.op, ast.And) else neg)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        for sub in st.uses_in(m, node):
+            out.append((node, sub, g))
+        for child in ast.iter_child_nodes(node):
+            rec(child, g)
+
+    rec(expr, gates)
+    return out
+
+
+def _check_guard_shed(tree: Tree, guarded) -> list[Finding]:
+    from tools.graftlint.ownership import SERVER_CLASS, SERVER_MODULE
+    mod = tree.module(SERVER_MODULE)
+    if mod is None:
+        return []
+    if guarded is None:
+        try:
+            guarded = _load_guarded()
+        except ImportError:
+            return []
+    findings: list[Finding] = []
+    gset = set(guarded)
+    for fn, cls in walk_funcs(mod.tree):
+        if cls != SERVER_CLASS or fn.name == "__init__":
+            continue
+        for node in _own_walk(fn):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self" and t.attr in gset:
+                    findings.append(Finding(
+                        "gate-guard-shed", mod.rel, node.lineno,
+                        f"`{fn.name}` REBINDS guarded collection "
+                        f"self.{t.attr} — the owner_check wrapper lives "
+                        f"on the object, so rebinding sheds it; mutate "
+                        f"in place (clear()/update()/extend())"))
+    return findings
+
+
+def _check_escrow(tree: Tree, gate_funcs, home, exempt) -> list[Finding]:
+    if not gate_funcs:
+        return []
+    findings: list[Finding] = []
+    gate_set = set(gate_funcs)
+    for m in tree.modules:
+        if not m.rel.startswith("deneva_tpu/") or m.rel.startswith(home) \
+                or m.rel.startswith(exempt):
+            continue
+        sanctioned: set[int] = set()
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) and _leaf(node.func) in gate_set:
+                for a in (*node.args, *(k.value for k in node.keywords)):
+                    for sub in ast.walk(a):
+                        sanctioned.add(id(sub))
+        for node in ast.walk(m.tree):
+            bad = None
+            if isinstance(node, ast.Attribute) and node.attr == "order_free":
+                bad = node
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "get" and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and node.args[0].value == "order_free":
+                bad = node
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.slice, ast.Constant) \
+                    and node.slice.value == "order_free":
+                bad = node
+            if bad is not None and id(bad) not in sanctioned:
+                findings.append(Finding(
+                    "gate-escrow-raw", m.rel, bad.lineno,
+                    f"raw order_free mask consumed outside the escrow "
+                    f"gate ({'/'.join(gate_funcs)}) — undeclared "
+                    f"commutativity bypasses escrow_order_free/"
+                    f"escrow_sweep"))
+    return findings

@@ -1,0 +1,282 @@
+"""jit family: silent-recompilation and trace-breakage hazards inside
+jit/shard_map entry graphs.
+
+Every backend is ONE jitted epoch program (ROADMAP), so anything that
+changes an entry's abstract signature between calls re-traces the whole
+program — the recompile-storm bug class is invisible in tests (they
+pass, slowly) and fatal at cluster scale.  This family rides the trace
+family's interprocedural taint fixpoint (same entries, same
+reachability) and adds four hazards the trace rules do not cover:
+
+jit-dynamic-shape     a call whose OUTPUT SHAPE depends on traced
+                      VALUES (`jnp.nonzero`/`unique`/`argwhere`/
+                      `where(cond)` one-arg/...), or a traced value in
+                      a shape position (`jnp.zeros(n)` with tracer
+                      `n`).  Under jit this raises Concretization/
+                      NonConcreteBooleanIndex at best; at worst it
+                      silently retraces per shape.
+jit-unhashable-static a jit entry declares static_argnums/argnames but
+                      the static parameter carries a MUTABLE default
+                      (list/dict/set): every default-using call hashes
+                      (fails) or retraces.
+jit-mutable-global    jit-reachable code reads a module-level mutable
+                      collection that the module ALSO mutates: the
+                      traced program baked the capture at trace time,
+                      so later mutations are silently invisible (or
+                      force a retrace when used as a static).
+jit-weak-dtype        a call site of a jit-wrapped function passes a
+                      bare Python scalar literal in a traced position:
+                      weak-typed scalars alternate avals with any
+                      strongly-typed caller (f(x, 1.0) vs f(x, arr))
+                      and every alternation is a silent retrace.  Wrap
+                      in jnp.asarray(..., dtype=...) or declare the
+                      position static.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import (Finding, Tree, dotted,
+                                  resolved_dotted)
+from tools.graftlint.tracesafety import (_Taint, _find_entries, _param_names,
+                                         _solve_taint, _walk_own)
+
+# result shape is a function of traced VALUES
+_DYNSHAPE = frozenset((
+    "jax.numpy.nonzero", "jax.numpy.flatnonzero", "jax.numpy.argwhere",
+    "jax.numpy.unique", "jax.numpy.extract", "jax.numpy.compress",
+    "jax.numpy.union1d", "jax.numpy.intersect1d", "jax.numpy.setdiff1d",
+))
+# (function, index of the shape argument)
+_SHAPE_POS = {
+    "jax.numpy.zeros": 0, "jax.numpy.ones": 0, "jax.numpy.empty": 0,
+    "jax.numpy.full": 0, "jax.numpy.arange": 0,
+    "jax.numpy.broadcast_to": 1,
+}
+_MUTATORS = frozenset((
+    "append", "extend", "insert", "pop", "remove", "clear", "add",
+    "update", "discard", "setdefault", "popitem", "appendleft",
+))
+_MUTABLE_CTORS = frozenset(("list", "dict", "set", "bytearray", "deque",
+                            "defaultdict", "Counter", "OrderedDict"))
+
+
+def _mutable_globals(mod) -> set[str]:
+    """Module-level names bound to a mutable collection AND mutated
+    somewhere in the module (a constant lookup table that nobody writes
+    is jit-bakeable by design and stays exempt)."""
+    bound: set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            value = node.value
+            mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                         ast.ListComp, ast.DictComp,
+                                         ast.SetComp))
+            if isinstance(value, ast.Call) \
+                    and isinstance(value.func, ast.Name) \
+                    and value.func.id in _MUTABLE_CTORS:
+                mutable = True
+            if not mutable:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bound.add(t.id)
+    if not bound:
+        return set()
+    mutated: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in bound:
+            mutated.add(node.func.value.id)
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id in bound:
+                    mutated.add(t.value.id)
+    return mutated
+
+
+def _scalar_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and type(node.value) in (int, float):
+        return True
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        return _scalar_literal(node.operand)
+    return False
+
+
+def check(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    entries, statics = _find_entries(tree)
+
+    # jit-unhashable-static: static params with mutable defaults
+    seen_static: set[tuple] = set()
+    for name, specs in statics.items():
+        for nums, names, dm in specs:
+            for fm, fdef, _cls in tree.funcs.get(name, ()):
+                if fm is not dm:
+                    # the spec binds the def in ITS module — a bare-name
+                    # collision elsewhere is a different, unjitted fn
+                    continue
+                params = _param_names(fdef)
+                defaults = fdef.args.defaults
+                offset = len(params) - len(defaults)
+                for i, d in enumerate(defaults):
+                    pname = params[offset + i]
+                    if not isinstance(d, (ast.List, ast.Dict, ast.Set)) \
+                            and not (isinstance(d, ast.Call)
+                                     and isinstance(d.func, ast.Name)
+                                     and d.func.id in _MUTABLE_CTORS):
+                        continue
+                    if (offset + i) in nums or pname in names:
+                        key = (fm.rel, d.lineno, pname)
+                        if key in seen_static:
+                            continue
+                        seen_static.add(key)
+                        findings.append(Finding(
+                            "jit-unhashable-static", fm.rel, d.lineno,
+                            f"static arg {pname!r} of jitted `{name}` "
+                            f"has a mutable default — unhashable (or "
+                            f"retraced) every default-using call"))
+
+    # taint-driven rules over jit-reachable functions
+    mut_globals = {m.rel: _mutable_globals(m) for m in tree.modules}
+    for m, fn, seeds in _solve_taint(tree, entries).values():
+        t = _Taint(m, seeds)
+        t.propagate(fn)
+        module_muts = mut_globals.get(m.rel, set())
+        if module_muts:
+            findings += _mutable_reads(m, fn, module_muts)
+        for node in _walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            fd = resolved_dotted(m, node.func)
+            if fd in _DYNSHAPE and (any(t.expr(a) for a in node.args)
+                                    or any(t.expr(k.value)
+                                           for k in node.keywords)):
+                findings.append(Finding(
+                    "jit-dynamic-shape", m.rel, node.lineno,
+                    f"`{dotted(node.func)}` on a traced value inside "
+                    f"jit-reachable `{fn.name}` — output shape depends "
+                    f"on traced VALUES (use fixed-width masks, "
+                    f"jnp.where(c, a, b), or size=...)"))
+            elif fd == "jax.numpy.where" and len(node.args) == 1 \
+                    and t.expr(node.args[0]):
+                findings.append(Finding(
+                    "jit-dynamic-shape", m.rel, node.lineno,
+                    f"one-argument jnp.where on a traced value inside "
+                    f"jit-reachable `{fn.name}` returns data-dependent "
+                    f"shapes — use the three-argument form"))
+            elif fd in _SHAPE_POS:
+                i = _SHAPE_POS[fd]
+                shape_args = [a for j, a in enumerate(node.args) if j == i]
+                shape_args += [k.value for k in node.keywords
+                               if k.arg in ("shape", "stop")]
+                if any(t.expr(a) for a in shape_args):
+                    findings.append(Finding(
+                        "jit-dynamic-shape", m.rel, node.lineno,
+                        f"traced value in the shape position of "
+                        f"`{dotted(node.func)}` inside jit-reachable "
+                        f"`{fn.name}` — shapes must be static under "
+                        f"trace (hoist to the host or pad to a bound)"))
+
+    # jit-weak-dtype: Python scalar literals in traced positions of
+    # jit-wrapped call sites
+    findings += _check_weak_scalars(tree, statics)
+    return findings
+
+
+def _mutable_reads(m, fn: ast.AST, module_muts: set[str]) -> list:
+    """jit-mutable-global over the core's REACHING DEFINITIONS: a read
+    is shadowed (exempt) only where a local definition of the name (a
+    parameter, or an assignment on some path) actually REACHES it; a
+    read BEFORE the local shadow still captures the module global and
+    still fires (the v1 flow-insensitive name set wrongly exempted
+    that)."""
+    from tools.graftlint.cfg import cfg_of, reachable_nodes, stmt_defs
+    out: list[Finding] = []
+    graph = cfg_of(fn)
+    rd = graph.reaching_defs()
+    seen: set[int] = set()
+    for stmt, node in reachable_nodes(graph):
+        if not (isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+                and node.id in module_muts) or id(node) in seen:
+            continue
+        seen.add(id(node))
+        blk = graph.block_of.get(id(stmt))
+        reach = rd.get(blk.id, {}) if blk is not None else {}
+        if node.id in reach:
+            continue                 # a local def reaches: shadowed
+        if blk is not None and any(
+                node.id in stmt_defs(s) for s in blk.stmts
+                if s is not stmt and s.lineno < getattr(
+                    stmt, "lineno", 0)):
+            continue                 # defined earlier in the same block
+        out.append(Finding(
+            "jit-mutable-global", m.rel, node.lineno,
+            f"jit-reachable `{fn.name}` reads module-level "
+            f"mutable `{node.id}` which this module mutates — "
+            f"the trace baked the capture; later mutations are "
+            f"silently invisible"))
+    return out
+
+
+def _check_weak_scalars(tree: Tree, statics: dict) -> list[Finding]:
+    """Bare Python scalar literals passed in TRACED positions of
+    jit-wrapped functions (the statics index doubles as the set of
+    known-jitted names; statically-declared positions are exempt —
+    they hash, they do not trace)."""
+    findings: list[Finding] = []
+    from tools.graftlint.tracesafety import _static_spec_for
+    for m in tree.modules:
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = None
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+            elif isinstance(node.func, ast.Attribute):
+                name = node.func.attr
+            if name not in statics:
+                continue
+            spec = _static_spec_for(m, node, name, statics[name])
+            if spec is None:
+                continue
+            nums, names = spec
+            # an arg declared static by NAME may be passed positionally:
+            # map names -> positions via the callee defs (union across
+            # same-named defs — exemption errs conservative)
+            static_pos = set(nums)
+            if names:
+                for _fm, fdef, _cls in tree.funcs.get(name, ()):
+                    for i, p in enumerate(_param_names(fdef)):
+                        if p in names:
+                            static_pos.add(i)
+            for i, a in enumerate(node.args):
+                if i in static_pos or not _scalar_literal(a):
+                    continue
+                findings.append(Finding(
+                    "jit-weak-dtype", m.rel, a.lineno,
+                    f"bare Python scalar in traced position {i} of "
+                    f"jitted `{name}` — weak-typed avals alternate "
+                    f"with any array-passing call site and every "
+                    f"alternation silently retraces; wrap in "
+                    f"jnp.asarray(..., dtype=...) or declare it "
+                    f"static"))
+            for kw in node.keywords:
+                if kw.arg and kw.arg not in names \
+                        and _scalar_literal(kw.value):
+                    findings.append(Finding(
+                        "jit-weak-dtype", m.rel, kw.value.lineno,
+                        f"bare Python scalar for traced argname "
+                        f"{kw.arg!r} of jitted `{name}` — wrap in "
+                        f"jnp.asarray(..., dtype=...) or declare it "
+                        f"static"))
+    return findings

@@ -1,0 +1,394 @@
+"""life family: resources released on ALL paths, exception edges
+included.
+
+PR 1 learned this the hard way (codec futures drained via try/finally
+so an exception cannot unwind past transport teardown while a worker
+still holds it); PR 3/7/8 repeat the discipline for wire/retire
+workers, follower loops and replica sockets.  This family checks it
+instead of remembering it, using the CFG core's exception edges: a
+release that is not in a ``finally`` does not cover the path an
+exception takes, and the checker sees exactly that.
+
+Rules
+-----
+life-unjoined-thread   a locally-created, started, non-daemon
+                       ``threading.Thread`` / ``multiprocessing.
+                       Process`` has a path to function exit (incl.
+                       exception edges) with no ``join``; or a
+                       ``self.x``-stored thread whose class never joins
+                       it anywhere.
+life-undrained-future  a local future (or list of futures) from
+                       ``pool.submit(...)`` has a path to exit with no
+                       drain (``result``/``cancel``/``wait``/
+                       ``as_completed``/``shutdown``).  An abandoned
+                       future can outlive the resources its closure
+                       captured (the PR 1 bcast-vs-transport-close
+                       race).
+life-unclosed-resource a local closable — a registered constructor
+                       (``NativeTransport``, ``EpochLogger``, ``open``,
+                       ``socket``), or ANY local the function closes on
+                       one path (evidence it owns a close obligation) —
+                       has a path to exit with no ``close``; or a
+                       ``self.x``-stored registered closable whose
+                       class never closes it.
+
+Objects that escape the function (returned, stored into self/containers
+passed on, handed to other calls) are exempt from the local path check:
+ownership moved, and the attribute-level class check picks up the
+``self.x`` half.  ``with`` acquisitions are release-by-construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint import cfg as C
+from tools.graftlint.core import (Finding, Module, Tree, resolved_dotted,
+                                  walk_funcs)
+
+# constructors that yield a thread-like (join) or closable (close) local
+THREAD_CTORS = ("threading.Thread", "multiprocessing.Process",
+                "multiprocessing.context.Process", "Thread", "Process")
+CLOSE_CTORS = ("open", "socket.socket",
+               "deneva_tpu.runtime.native.NativeTransport",
+               "deneva_tpu.runtime.logger.EpochLogger",
+               "NativeTransport", "EpochLogger",
+               "deneva_tpu.runtime.server.ServerNode",
+               "deneva_tpu.runtime.client.ClientNode",
+               "deneva_tpu.runtime.replica.ReplicaNode",
+               "ServerNode", "ClientNode", "ReplicaNode")
+_JOIN = frozenset(("join",))
+_DRAIN = frozenset(("result", "cancel", "shutdown"))
+_DRAIN_FUNCS = frozenset(("wait", "as_completed"))
+_CLOSE = frozenset(("close",))
+
+
+def _ctor_kind(mod: Module, value: ast.AST) -> str | None:
+    """'thread' / 'close' / 'future' for a recognized acquire RHS."""
+    if not isinstance(value, ast.Call):
+        return None
+    fd = resolved_dotted(mod, value.func)
+    if fd in THREAD_CTORS:
+        return "thread"
+    if fd in CLOSE_CTORS:
+        return "close"
+    if isinstance(value.func, ast.Attribute) and value.func.attr == "submit":
+        return "future"
+    return None
+
+
+def _is_daemon(fn: ast.AST, name: str, ctor: ast.Call) -> bool:
+    for kw in ctor.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value:
+            return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and t.attr == "daemon" \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == name \
+                        and isinstance(node.value, ast.Constant) \
+                        and node.value.value:
+                    return True
+    return False
+
+
+def _mentions(stmt: ast.AST, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               for n in ast.walk(stmt))
+
+
+def _release_methods(kind: str) -> frozenset:
+    return {"thread": _JOIN, "future": _DRAIN, "close": _CLOSE}[kind]
+
+
+def _is_release(mod: Module, stmt: ast.AST, name: str, kind: str) -> bool:
+    """Does this statement release `name` (x.join()/x.close()/
+    f.result() over x / wait(x) / x.cancel())?"""
+    if not _mentions(stmt, name):
+        return False
+    methods = _release_methods(kind)
+    for node in ast.walk(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in methods:
+            return True
+        if kind == "future":
+            fd = resolved_dotted(mod, node.func)
+            leaf = (fd or "").rsplit(".", 1)[-1]
+            if leaf in _DRAIN_FUNCS:
+                return True
+    return False
+
+
+def _escapes(fn: ast.AST, mod: Module, name: str, kind: str,
+             acquire: ast.AST) -> bool:
+    """Ownership leaves the function: returned/yielded, stored into an
+    attribute/subscript/container literal, or passed to a non-release
+    call.  `x.start()` / `x.append(submit(...))` / release calls do not
+    count."""
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(fn):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Name) and node.id == name):
+            continue
+        p = parents.get(id(node))
+        if p is None or p is acquire:
+            continue
+        if isinstance(p, (ast.Return, ast.Yield, ast.YieldFrom,
+                          ast.Dict, ast.List, ast.Tuple, ast.Set)):
+            return True
+        if isinstance(p, ast.Attribute):
+            gp = parents.get(id(p))
+            # x.join() / x.close() / x.start() receiver: not an escape
+            if isinstance(gp, ast.Call) and gp.func is p:
+                continue
+            return True
+        if isinstance(p, ast.Subscript):
+            return True
+        if isinstance(p, ast.Call) and node in p.args:
+            fd = resolved_dotted(mod, p.func)
+            leaf = (fd or "").rsplit(".", 1)[-1]
+            if kind == "future" and leaf in _DRAIN_FUNCS:
+                continue
+            return True
+        if isinstance(p, ast.Assign) and node is p.value:
+            # x aliased / stored: follow-up alias is beyond this checker
+            return True
+        if isinstance(p, ast.keyword):
+            return True
+    return False
+
+
+def _leak_path(graph: C.CFG, mod: Module, acquire_stmt: ast.AST,
+               name: str, kind: str) -> bool:
+    """Is there a path from (just after) the acquire to the exit that
+    passes no release of `name`?  Exception edges count — that is the
+    whole point."""
+    start = graph.block_of.get(id(acquire_stmt))
+    if start is None:
+        return False
+
+    def released(block: C.Block) -> bool:
+        return any(_is_release(mod, s, name, kind) for s in block.stmts)
+
+    work: list[C.Block] = []
+    for succ, edge in start.succs:
+        if edge != C.EXC:           # exception DURING acquire: nothing
+            work.append(succ)       # was acquired, nothing to release
+    seen: set[int] = set()
+    while work:
+        b = work.pop()
+        if b.id in seen:
+            continue
+        seen.add(b.id)
+        if released(b):
+            continue
+        if b is graph.exit:
+            return True
+        for succ, edge in b.succs:
+            # exception edges OUT of a finally body are already inside
+            # the hardened region this rule exists to demand
+            if edge == C.EXC and b.in_finally:
+                continue
+            work.append(succ)
+    return False
+
+
+def _local_findings(tree: Tree, m: Module, fn: ast.AST) -> list[Finding]:
+    findings: list[Finding] = []
+    graph = None
+    checked: set[tuple[str, str]] = set()
+    for node in _own_stmts(fn):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        value = node.value
+        kind = _ctor_kind(m, value)
+        if kind is None or not isinstance(target, ast.Name):
+            continue
+        name = target.id
+        if (name, kind) in checked:
+            continue
+        checked.add((name, kind))
+        track_from = node
+        if kind == "thread":
+            # the join obligation begins at start(): an exception
+            # DURING start leaves nothing to join
+            start_stmt = None
+            for s in _own_stmts(fn):
+                if isinstance(s, ast.Expr) \
+                        and isinstance(s.value, ast.Call) \
+                        and isinstance(s.value.func, ast.Attribute) \
+                        and s.value.func.attr == "start" \
+                        and isinstance(s.value.func.value, ast.Name) \
+                        and s.value.func.value.id == name:
+                    start_stmt = s
+                    break
+            if start_stmt is None or _is_daemon(fn, name, value):
+                continue
+            track_from = start_stmt
+        if _escapes(fn, m, name, kind, node):
+            continue
+        if graph is None:
+            graph = C.cfg_of(fn)
+        if _leak_path(graph, m, track_from, name, kind):
+            findings.append(_leak_finding(m, fn, node, name, kind))
+    # futures accumulated into a local list: futs = [] ... futs.append(
+    # pool.submit(...)) — the list is the resource
+    for coll, append_stmt in _future_collections(fn):
+        if (coll, "future") in checked:
+            continue
+        checked.add((coll, "future"))
+        if _escapes(fn, m, coll, "future", append_stmt):
+            continue
+        if graph is None:
+            graph = C.cfg_of(fn)
+        if _leak_path(graph, m, append_stmt, coll, "future"):
+            findings.append(_leak_finding(m, fn, append_stmt, coll,
+                                          "future"))
+    # evidence-based closables: the function closes x on SOME path —
+    # then x must be closed on every path out
+    for name, acq in _evidence_closables(m, fn):
+        if (name, "close") in checked:
+            continue
+        checked.add((name, "close"))
+        if _escapes(fn, m, name, "close", acq):
+            continue
+        if graph is None:
+            graph = C.cfg_of(fn)
+        if _leak_path(graph, m, acq, name, "close"):
+            findings.append(_leak_finding(m, fn, acq, name, "close"))
+    return findings
+
+
+def _leak_finding(m: Module, fn: ast.AST, node: ast.AST, name: str,
+                  kind: str) -> Finding:
+    rule, verb, how = {
+        "thread": ("life-unjoined-thread", "joined",
+                   "join it in a finally (or make it daemon)"),
+        "future": ("life-undrained-future", "drained",
+                   "drain via result()/wait() in a finally — an "
+                   "abandoned future can outlive the transport its "
+                   "closure captured"),
+        "close": ("life-unclosed-resource", "closed",
+                  "close it in a finally or use `with`"),
+    }[kind]
+    return Finding(rule, m.rel, node.lineno,
+                   f"`{name}` in `{fn.name}` is not {verb} on every "
+                   f"path to exit (exception edges included) — {how}")
+
+
+def _own_stmts(fn: ast.AST):
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.stmt):
+            yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt) or not isinstance(child,
+                                                             ast.expr):
+                stack.append(child)
+
+
+def _future_collections(fn: ast.AST):
+    """(collection name, first append-of-submit stmt) pairs."""
+    seen: dict[str, ast.AST] = {}
+    for stmt in _own_stmts(fn):
+        if not isinstance(stmt, ast.Expr) \
+                or not isinstance(stmt.value, ast.Call):
+            continue
+        call = stmt.value
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "append" \
+                and isinstance(call.func.value, ast.Name) \
+                and call.args and isinstance(call.args[0], ast.Call) \
+                and isinstance(call.args[0].func, ast.Attribute) \
+                and call.args[0].func.attr == "submit":
+            seen.setdefault(call.func.value.id, stmt)
+    return sorted(seen.items(), key=lambda kv: kv[1].lineno)
+
+
+def _evidence_closables(m: Module, fn: ast.AST):
+    """Locals the function itself closes somewhere: `x = f(); ...;
+    x.close()` — evidence of a close obligation for path checking."""
+    closed: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "close" \
+                and isinstance(node.func.value, ast.Name):
+            closed.add(node.func.value.id)
+    out = []
+    if not closed:
+        return out
+    for stmt in _own_stmts(fn):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and stmt.targets[0].id in closed \
+                and isinstance(stmt.value, ast.Call):
+            out.append((stmt.targets[0].id, stmt))
+            closed.discard(stmt.targets[0].id)
+    return out
+
+
+def _attr_findings(tree: Tree, m: Module) -> list[Finding]:
+    """self.x-stored threads/closables: the class must join/close them
+    SOMEWHERE (the run/close pairing); path sensitivity across methods
+    is out of scope, existence is not."""
+    findings: list[Finding] = []
+    # class -> {attr: (kind, line, ctor call, owning fn)}
+    classes: dict[str, dict[str, tuple]] = {}
+    releases: dict[str, set[tuple[str, str]]] = {}
+    for fn, cls in walk_funcs(m.tree):
+        if cls is None:
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Attribute) \
+                    and isinstance(node.targets[0].value, ast.Name) \
+                    and node.targets[0].value.id == "self":
+                kind = _ctor_kind(m, node.value)
+                if kind in ("thread", "close"):
+                    classes.setdefault(cls, {}).setdefault(
+                        node.targets[0].attr,
+                        (kind, node.lineno, node.value, fn))
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in (_JOIN | _CLOSE | _DRAIN) \
+                    and isinstance(node.func.value, ast.Attribute) \
+                    and isinstance(node.func.value.value, ast.Name) \
+                    and node.func.value.value.id == "self":
+                releases.setdefault(cls, set()).add(
+                    (node.func.value.attr, node.func.attr))
+    for cls, attrs in sorted(classes.items()):
+        done = releases.get(cls, set())
+        for attr, (kind, line, ctor, fn) in sorted(attrs.items()):
+            want = _release_methods(kind)
+            if any(a == attr and meth in want for a, meth in done):
+                continue
+            if kind == "thread" and _is_daemon(fn, "---", ctor):
+                continue
+            noun = "joins" if kind == "thread" else "closes"
+            findings.append(Finding(
+                "life-unjoined-thread" if kind == "thread"
+                else "life-unclosed-resource", m.rel, line,
+                f"{cls}.{attr} is a {'thread' if kind == 'thread' else 'closable'} "
+                f"but no method of {cls} ever {noun} it"))
+    return findings
+
+
+def check(tree: Tree) -> list[Finding]:
+    findings: list[Finding] = []
+    for m in tree.modules:
+        for fn, _cls in walk_funcs(m.tree):
+            findings += _local_findings(tree, m, fn)
+        findings += _attr_findings(tree, m)
+    return findings

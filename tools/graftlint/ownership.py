@@ -57,9 +57,12 @@ def _class_functions(mod, class_name: str) -> dict[str, list[ast.AST]]:
 
 
 def _writes_of(fn: ast.AST, mutators) -> list[tuple[str, int, str]]:
-    """(attr, line, how) for every write to self.<attr> in a function."""
+    """(attr, line, how) for every write to self.<attr> in a function —
+    v2: enumerated over the shared CFG core's reachable blocks, so
+    writes in dead code (after a return/raise) no longer count."""
+    from tools.graftlint.cfg import cfg_of, reachable_nodes
     writes: list[tuple[str, int, str]] = []
-    for node in ast.walk(fn):
+    for _stmt, node in reachable_nodes(cfg_of(fn)):
         if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
             targets = node.targets if isinstance(node, ast.Assign) \
                 else [node.target]

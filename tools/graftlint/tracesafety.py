@@ -66,6 +66,11 @@ _STATIC_ATTRS = frozenset(("shape", "ndim", "dtype", "size", "nbytes",
                            "name", "value", "capacity", "full_row",
                            "ring", "anchor_rows"))
 _HOST_CASTS = frozenset(("float", "int", "bool", "complex"))
+# jnp functions whose RESULT is static python metadata, not a tracer
+_STATIC_RETURNING = frozenset((
+    "jax.numpy.shape", "jax.numpy.ndim", "jax.numpy.size",
+    "jax.numpy.result_type", "jax.numpy.iinfo", "jax.numpy.finfo",
+))
 _EXEMPT_CALLS = frozenset(("len", "isinstance", "hasattr", "getattr",
                            "type", "repr", "str", "print", "id",
                            "issubclass"))
@@ -221,7 +226,7 @@ class _Taint:
             return self.expr(node.value) or self.expr(node.slice)
         if isinstance(node, ast.Call):
             fd = resolved_dotted(self.mod, node.func)
-            if fd in _EXEMPT_CALLS:
+            if fd in _EXEMPT_CALLS or fd in _STATIC_RETURNING:
                 return False
             if fd is not None and fd.startswith(_TRACED_MODULES):
                 return True
@@ -261,28 +266,49 @@ class _Taint:
         return False
 
     def propagate(self, fn: ast.AST) -> None:
-        """Two forward passes over the statements (enough for the
-        straight-line + simple-loop shapes of jitted code).  Nested def
-        bodies are excluded — they get their own seeded pass."""
-        for _ in range(2):
-            for node in _walk_own(fn):
-                if isinstance(node, ast.Assign):
-                    if self.expr(node.value):
-                        for t in node.targets:
-                            self._mark(t)
-                elif isinstance(node, ast.AnnAssign):
-                    if node.value is not None and self.expr(node.value):
-                        self._mark(node.target)
-                elif isinstance(node, ast.AugAssign):
-                    if self.expr(node.value) or self.expr(node.target):
-                        self._mark(node.target)
-                elif isinstance(node, ast.For):
-                    if self.expr(node.iter):
-                        self._mark(node.target)
-                elif isinstance(node, (ast.withitem,)):
-                    if node.optional_vars is not None \
-                            and self.expr(node.context_expr):
-                        self._mark(node.optional_vars)
+        """v2: forward fixpoint over the function's CFG blocks in
+        reverse postorder (the shared cfg core).  The v1 two-pass
+        statement walk missed taint chains longer than two assignments
+        laid out against source order; RPO iteration to fixpoint
+        converges any chain, and loop back edges re-run naturally.
+        The result stays the flow-insensitive UNION of tainted names
+        (the trace rules ask "can this name be a tracer here", not
+        "is it on every path").  Nested def bodies are excluded — they
+        get their own seeded pass."""
+        from tools.graftlint.cfg import cfg_of
+        graph = cfg_of(fn)
+        order = graph.rpo()
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            before = len(self.names)
+            for b in order:
+                for stmt in b.stmts:
+                    self._transfer(stmt)
+            if len(self.names) != before:
+                changed = True
+
+    def _transfer(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Assign):
+            if self.expr(node.value):
+                for t in node.targets:
+                    self._mark(t)
+        elif isinstance(node, ast.AnnAssign):
+            if node.value is not None and self.expr(node.value):
+                self._mark(node.target)
+        elif isinstance(node, ast.AugAssign):
+            if self.expr(node.value) or self.expr(node.target):
+                self._mark(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            if self.expr(node.iter):
+                self._mark(node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None \
+                        and self.expr(item.context_expr):
+                    self._mark(item.optional_vars)
 
     def _mark(self, target: ast.AST) -> None:
         # taint the assigned container, never subscript INDEX names

@@ -57,11 +57,18 @@ class RtypeSpec:
     codec_decode: tuple = ()
     routes: tuple = ()
     note: str = ""
+    # default-off subsystem (runtime/gates.py key) whose flag arms this
+    # rtype: such a message exists on the wire ONLY once the subsystem
+    # is on, so a route branch on its name establishes the gate for the
+    # gate-consistency family — and a gated rtype must stay OUTSIDE
+    # FAULT_RTYPE_MASK (control plane: its fault mode is process death,
+    # never silent loss).  "" = always-on protocol.
+    gate: str = ""
 
 
-def _s(name, fault_mask, enc=(), dec=(), routes=(), note=""):
+def _s(name, fault_mask, enc=(), dec=(), routes=(), note="", gate=""):
     return RtypeSpec(name, fault_mask, tuple(enc), tuple(dec),
-                     tuple(routes), note)
+                     tuple(routes), note, gate)
 
 
 WIRE_MODEL: dict[str, RtypeSpec] = {s.name: s for s in (
@@ -123,47 +130,47 @@ WIRE_MODEL: dict[str, RtypeSpec] = {s.name: s for s in (
        enc=("encode_vote",), dec=("decode_vote",),
        routes=("ServerNode._route",),
        note="MAAT position-verify round: the commit protocol"),
-    _s("REJOIN", False,
+    _s("REJOIN", False, gate="fault",
        enc=("encode_shutdown",), dec=("decode_shutdown",),
        routes=("ServerNode._route", "ReplicaNode._handle"),
        note="crash-recovery handshake (resume epoch); failover control "
             "plane"),
-    _s("MIGRATE_BEGIN", False,
+    _s("MIGRATE_BEGIN", False, gate="elastic",
        enc=("encode_map_msg",), dec=("decode_map_msg",),
        routes=("ServerNode._route",),
        note="rebalance announcement (PR 4): control plane, outside the "
             "fault mask by design — its fault mode is process death"),
-    _s("MIGRATE_ROWS", False,
+    _s("MIGRATE_ROWS", False, gate="elastic",
        enc=("encode_migrate_rows",),
        dec=("decode_migrate_rows", "peek_rows_version"),
        routes=("ServerNode._route",),
        note="row migration stream: control plane, like the epoch "
             "exchange (the PR 4 'rtypes 15-17 outside the mask' rule)"),
-    _s("MAP_UPDATE", False,
+    _s("MAP_UPDATE", False, gate="elastic",
        enc=("encode_map_msg",), dec=("decode_map_msg",),
        routes=("ServerNode._route", "ClientNode._route"),
        note="client map install / redirect NACK: loss self-heals via "
             "the resend sweep's retargeting, but it is control plane"),
-    _s("LOG_ACK", False,
+    _s("LOG_ACK", False, gate="geo",
        enc=("encode_log_ack",), dec=("decode_log_ack",),
        routes=("ServerNode._route",),
        note="geo quorum durability ack (acked + applied horizon): the "
             "commit protocol itself, outside the mask like rtypes "
             "15-17"),
-    _s("REGION_READ", False,
+    _s("REGION_READ", False, gate="geo",
        enc=("encode_region_read", "region_read_parts"),
        dec=("decode_region_read",),
        routes=("ReplicaNode._handle",),
        note="follower snapshot read request: control plane; the client "
             "re-issues from its outstanding ledger, it has no "
             "resend+idempotent-admission story"),
-    _s("REGION_READ_RSP", False,
+    _s("REGION_READ_RSP", False, gate="geo",
        enc=("encode_region_read_rsp", "region_read_rsp_parts"),
        dec=("decode_region_read_rsp",),
        routes=("ClientNode._route",),
        note="follower snapshot read answer (boundary + values + row "
             "version stamps): control plane, same lost-read ledger"),
-    _s("ADMIT_NACK", False,
+    _s("ADMIT_NACK", False, gate="admission",
        enc=("encode_admit_nack", "admit_nack_parts"),
        dec=("decode_admit_nack",),
        routes=("ClientNode._route",),

@@ -61,12 +61,15 @@ def _is_rtype_expr(node: ast.AST) -> bool:
 
 def _rtype_branch_consts(mod: Module, fn_name: str) -> list[tuple[str, int]]:
     """(string const, line) of == compares against an rtype expression
-    inside a function (see `_is_rtype_expr`)."""
+    inside a function (see `_is_rtype_expr`) — v2: over the shared CFG
+    core's reachable blocks, so a branch stranded behind a `return` no
+    longer counts as routing the rtype."""
+    from tools.graftlint.cfg import cfg_of, reachable_nodes
     out: list[tuple[str, int]] = []
     for fn, _cls in walk_funcs(mod.tree):
         if fn.name != fn_name:
             continue
-        for node in ast.walk(fn):
+        for _stmt, node in reachable_nodes(cfg_of(fn)):
             if not (isinstance(node, ast.Compare)
                     and any(isinstance(op, ast.Eq) for op in node.ops)):
                 continue
