@@ -18,7 +18,11 @@ from typing import Any, Callable
 from deneva_tpu.config import CCAlg, Config
 from deneva_tpu.cc.base import (AccessBatch, Incidence, Verdict,  # noqa: F401
                                 build_conflict_incidence, build_incidence,
-                                gate_order_free)
+                                committed_write_frontier, gate_order_free)
+from deneva_tpu.cc import maat as _maat
+from deneva_tpu.cc import occ as _occ
+from deneva_tpu.cc import timestamp as _tsmod
+from deneva_tpu.cc import twopl as _twopl
 from deneva_tpu.cc.calvin import validate_calvin, validate_tpu_batch
 from deneva_tpu.cc.maat import validate_maat
 from deneva_tpu.cc.nocc import validate_nocc
@@ -53,6 +57,17 @@ class CCBackend:
     # GLOBALLY decided commit set (local validation's state output is
     # discarded at prepare time).  None = stateless backend.
     commit_state: Any = None
+    # transaction repair hook (engine/repair.py, gated by Config.repair):
+    # the backend's invalidated-read frontier rule
+    # ``(cfg, cc_state, batch, inc, committed, losers) -> bool[B, A]`` —
+    # which of a loser's reads saw a value the committed set overwrote
+    # (OCC: read-set vs winner write-set; 2PL: lock-edge losers; T/O:
+    # wts/rts watermark re-check; MAAT: range re-intersection).  The
+    # repair sub-round re-validates losers through the backend's OWN
+    # ``validate`` on the loser-masked batch, so the in-round conflict
+    # semantics cannot diverge from the main round's.  None = not
+    # repairable (chained backends never abort; NOCC never conflicts).
+    repair_rule: Any = None
 
 
 _NO_STATE = lambda cfg: ()  # noqa: E731
@@ -68,20 +83,26 @@ _REGISTRY: dict[CCAlg, CCBackend] = {
     # them within the window (row_lock.cpp:86-151) where epoch-snapshot
     # validation used to admit a single winner and abort-storm the rest
     CCAlg.NO_WAIT: CCBackend(CCAlg.NO_WAIT, validate_no_wait, _NO_STATE,
-                             exempt_order_free=True),
+                             exempt_order_free=True,
+                             repair_rule=_twopl.repair_frontier),
     CCAlg.WAIT_DIE: CCBackend(CCAlg.WAIT_DIE, validate_wait_die, _NO_STATE,
                               fresh_ts_on_restart=False,
-                              exempt_order_free=True),
+                              exempt_order_free=True,
+                              repair_rule=_twopl.repair_frontier),
     CCAlg.OCC: CCBackend(CCAlg.OCC, validate_occ, _NO_STATE,
-                         exempt_order_free=True),
+                         exempt_order_free=True,
+                         repair_rule=_occ.repair_frontier),
     CCAlg.TIMESTAMP: CCBackend(CCAlg.TIMESTAMP, validate_timestamp,
                                init_to_state, commit_state=commit_to_state,
-                               exempt_order_free=True),
+                               exempt_order_free=True,
+                               repair_rule=_tsmod.repair_frontier_timestamp),
     CCAlg.MVCC: CCBackend(CCAlg.MVCC, validate_mvcc, init_mvcc_state,
                           commit_state=commit_to_state,
-                          exempt_order_free=True),
+                          exempt_order_free=True,
+                          repair_rule=_tsmod.repair_frontier_mvcc),
     CCAlg.MAAT: CCBackend(CCAlg.MAAT, validate_maat, _NO_STATE,
-                          exempt_order_free=True),
+                          exempt_order_free=True,
+                          repair_rule=_maat.repair_frontier),
     # forward=True: on blind-write workloads (YCSB) the forwarding
     # executor is the closed form of the reference Calvin's RFWD dirty-
     # read forwarding — the whole batch commits whatever the chain depth,

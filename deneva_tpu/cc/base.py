@@ -190,6 +190,41 @@ def build_conflict_incidence(cfg, be, batch: AccessBatch,
                            order_free=order_free)
 
 
+def committed_write_frontier(cfg, batch: AccessBatch, inc: Incidence,
+                             committed, losers):
+    """Invalidated-read frontier: bool[B, A] marking each LOSER's ordered
+    read lanes whose bucket some txn in ``committed`` wrote — the reads
+    that observed a value the winners overwrote, i.e. exactly the slice
+    transaction repair must re-execute (PAPERS: *Transaction Repair*;
+    the conflict incidence the sweep already materialized answers it
+    with one [B]x[B,K] matvec per hash family).
+
+    Bucket-space over-approximation, stated the same way as every sweep
+    input: a collision can only ADD frontier lanes, never hide one — and
+    an added lane is harmless because a re-read of a key nobody
+    overwrote returns the identical value (which is also why the
+    executors' full re-gather IS the masked re-read, bit for bit).
+    Escrow (``order_free``) reads are excluded: they are declared-
+    immutable columns, so repair of an escrow access is a no-op by
+    contract (cc/timestamp.py escrow rules; documented in README)."""
+    import jax.numpy as jnp
+
+    wrote = jnp.matmul(committed.astype(inc.w1.dtype)[None, :], inc.w1,
+                       preferred_element_type=jnp.float32)[0] > 0
+    hit = jnp.take(wrote, inc.bucket1)
+    if inc.w2 is not None:
+        ident = combine_key(batch.table_ids, batch.keys)
+        b2 = bucket_hash(ident, inc.w2.shape[1], family=1)
+        wrote2 = jnp.matmul(committed.astype(inc.w2.dtype)[None, :],
+                            inc.w2, preferred_element_type=jnp.float32
+                            )[0] > 0
+        hit = hit & jnp.take(wrote2, b2)
+    rmask = batch.valid & losers[:, None] & batch.is_read
+    if batch.order_free is not None:
+        rmask = rmask & ~batch.order_free
+    return rmask & hit
+
+
 def build_incidence(batch: AccessBatch, n_buckets: int, exact: bool,
                     order_free: jax.Array | None = None) -> Incidence:
     # `shard_buckets` is a no-op single-device; under a parallel.use_mesh

@@ -74,7 +74,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, get_overlap
+from deneva_tpu.cc.base import (AccessBatch, Incidence, Verdict,
+                                committed_write_frontier, get_overlap)
 from deneva_tpu.ops import (earlier_edges, greedy_first_fit,
                             precedence_levels)
 
@@ -101,6 +102,24 @@ def must_precede(cfg, inc: Incidence, b: int):
     ro2 = inc.r2 if inc.ro1 is None else inc.ro2
     p = ov(ro1, inc.w1, ro2, inc.w2)
     return p & ~jnp.eye(b, dtype=bool)
+
+
+def repair_frontier(cfg, state, batch: AccessBatch, inc: Incidence,
+                    committed, losers):
+    """MAAT invalidation rule (transaction repair, engine/repair.py):
+    range re-intersection.  A MAAT loser's commit-timestamp range closed
+    — a mutual must-precede pair or a peeled cycle pinned its lower
+    bound at or above its upper.  Every closing constraint is a
+    reader-before-writer edge ``P[i, j]`` (under epoch snapshots the
+    ONLY constraint MAAT has), so the loser's range re-opens exactly by
+    re-reading the keys on its P-edges into the committed set: the
+    re-read inverts the edge (j's value is now i's input, so i orders
+    AFTER j with an open upper bound) — in access space that is the
+    ordered-read-vs-committed-write frontier.  The repair sub-round then
+    re-runs this module's validate restricted to the losers: mutual
+    pairs re-sweep, residual cycles re-peel — the range re-intersection
+    one snapshot later, against ranges that all start open."""
+    return committed_write_frontier(cfg, batch, inc, committed, losers)
 
 
 def validate_maat(cfg, state, batch: AccessBatch, inc: Incidence):

@@ -35,8 +35,26 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, get_overlap
+from deneva_tpu.cc.base import (AccessBatch, Incidence, Verdict,
+                                committed_write_frontier, get_overlap)
 from deneva_tpu.ops import earlier_edges, greedy_first_fit
+
+
+def repair_frontier(cfg, state, batch: AccessBatch, inc: Incidence,
+                    committed, losers):
+    """OCC invalidation rule (transaction repair, engine/repair.py):
+    read-set vs winner write-set.  A Kung-Robinson loser aborted because
+    an admitted j's writes intersected its validated set; the READ half
+    of that intersection is what made its execution stale — those reads
+    observed the epoch-start snapshot where they should have seen j's
+    value.  Re-executing them against the post-winner state moves the
+    loser's serialization point after every winner, after which the
+    repair sub-round re-runs this module's own serial-admission test
+    restricted to the losers (``validate_occ`` on the loser-masked
+    batch) — the same validation, one snapshot later.  Write-only
+    intersections need no re-read (blind writes recompute); they show up
+    as an EMPTY frontier and salvage in the first sub-round."""
+    return committed_write_frontier(cfg, batch, inc, committed, losers)
 
 
 def validate_occ(cfg, state, batch: AccessBatch, inc: Incidence):

@@ -171,14 +171,16 @@ def _readonly(batch: AccessBatch) -> jax.Array:
     return ~(v & batch.is_write).any(axis=1)
 
 
-def _watermark_aborts(cfg, state, batch: AccessBatch,
+def _stale_read_lanes(cfg, state, batch: AccessBatch,
                       mvcc: bool) -> jax.Array:
-    """bool[B]: txn violates a cross-epoch watermark (escrow accesses
-    follow the relaxed rules in the module docstring)."""
+    """bool[B, A]: read lanes violating the cross-epoch ``wts`` watermark
+    at the txn's CURRENT ts (the read half of ``_watermark_aborts``,
+    exposed per access so the repair frontier can name exactly which
+    reads went stale).  Escrow reads are exempt per the module
+    docstring."""
     wm = _wm_bucket(cfg, batch)
     v = batch.valid & batch.active[:, None]
     wts_at = jnp.take(state.wts, wm)                   # [B, A]
-    rts_at = jnp.take(state.rts, wm)
     ts = batch.ts[:, None]
     if mvcc:
         # pure reads serve the retained version at their ts; only reads
@@ -191,19 +193,69 @@ def _watermark_aborts(cfg, state, batch: AccessBatch,
                         | (rmw & (wts_at > ts)))
     else:
         read_bad = v & batch.is_read & (wts_at > ts)
+    if batch.order_free is not None:
+        # escrow reads check nothing (declared-immutable columns)
+        read_bad = read_bad & ~batch.order_free
+    return read_bad
+
+
+def _watermark_aborts(cfg, state, batch: AccessBatch,
+                      mvcc: bool) -> jax.Array:
+    """bool[B]: txn violates a cross-epoch watermark (escrow accesses
+    follow the relaxed rules in the module docstring)."""
+    wm = _wm_bucket(cfg, batch)
+    v = batch.valid & batch.active[:, None]
+    wts_at = jnp.take(state.wts, wm)                   # [B, A]
+    rts_at = jnp.take(state.rts, wm)
+    ts = batch.ts[:, None]
+    read_bad = _stale_read_lanes(cfg, state, batch, mvcc)
     if batch.order_free is None:
         write_bad = v & batch.is_write & ((rts_at > ts) | (wts_at > ts))
     else:
-        # escrow reads check nothing; escrow writes (deltas) check only
-        # rts — deltas commute with prior deltas, never with a committed
-        # ordered read whose ts-past they would rewrite
-        read_bad = read_bad & ~batch.order_free
+        # escrow writes (deltas) check only rts — deltas commute with
+        # prior deltas, never with a committed ordered read whose
+        # ts-past they would rewrite
         write_bad = v & batch.is_write & jnp.where(
             batch.order_free, rts_at > ts, (rts_at > ts) | (wts_at > ts))
     bad = (read_bad | write_bad).any(axis=1)
     if mvcc:
         bad = bad & ~_readonly(batch)       # read-only: snapshot
     return bad
+
+
+def _repair_frontier(cfg, state, batch: AccessBatch, inc: Incidence,
+                     committed, losers, mvcc: bool):
+    """T/O invalidation rule (transaction repair, engine/repair.py):
+    the wts/rts watermark re-check.  A T/O loser is a watermark
+    violator — its birth ts sits in the PAST of committed state (a
+    value "from its future" was already on disk), which whole-txn retry
+    fixes by restamping next epoch.  Repair restamps NOW: the frontier
+    is the union of (a) this epoch's winner overwrites of the loser's
+    ordered reads (the generic bucket frontier) and (b) the cross-epoch
+    stale-read lanes that caused the abort (``wts_at > birth ts``, the
+    per-access view of ``_watermark_aborts``).  The repair sub-round
+    then re-runs this module's validate at a fresh ts above every stamp
+    in the epoch — the same watermark check, which now passes exactly
+    when the re-read serves the committed value, and the same
+    later-reader-waits sweep restricted to the losers.  Repaired
+    commits record watermarks at the fresh ts, so a second sub-round's
+    reader of a first-sub-round write re-checks against it (and falls
+    back to the retry queue if its own stamp is older — conservative,
+    never a wrong commit)."""
+    from deneva_tpu.cc.base import committed_write_frontier
+    base = committed_write_frontier(cfg, batch, inc, committed, losers)
+    stale = _stale_read_lanes(cfg, state, batch, mvcc) & losers[:, None]
+    return base | stale
+
+
+def repair_frontier_timestamp(cfg, state, batch, inc, committed, losers):
+    return _repair_frontier(cfg, state, batch, inc, committed, losers,
+                            mvcc=False)
+
+
+def repair_frontier_mvcc(cfg, state, batch, inc, committed, losers):
+    return _repair_frontier(cfg, state, batch, inc, committed, losers,
+                            mvcc=True)
 
 
 def _rw_later_reader_edges(cfg, batch: AccessBatch, inc: Incidence):

@@ -59,9 +59,29 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from deneva_tpu.cc.base import AccessBatch, Incidence, Verdict, get_overlap
+from deneva_tpu.cc.base import (AccessBatch, Incidence, Verdict,
+                                committed_write_frontier, get_overlap)
 from deneva_tpu.cc.nocc import validate_nocc
 from deneva_tpu.ops import earlier_edges, greedy_first_fit
+
+
+def repair_frontier(cfg, state, batch: AccessBatch, inc: Incidence,
+                    committed, losers):
+    """2PL invalidation rule (transaction repair, engine/repair.py):
+    lock-edge losers.  A NO_WAIT/WAIT_DIE loser was refused a lock some
+    winner held; by the repair sub-round every winner has committed and
+    "released", so the loser re-acquires against the epoch-end state.
+    Its invalidated reads are the ones an earlier winner's WRITE lock
+    covered — ordered reads overlapping committed writes — the same
+    access set under every isolation level (READ_COMMITTED's early-
+    released read locks and READ_UNCOMMITTED's lock-free reads change
+    which REQUESTS conflict, not which read VALUES went stale; the
+    generic frontier is the conservative superset for both).  Write-only
+    lock losers (WW refusals) re-apply their blind writes with an empty
+    frontier.  The sub-round's re-acquisition is this module's own edge
+    derivation restricted to the losers (``validate_no_wait``/
+    ``validate_wait_die`` on the loser-masked batch)."""
+    return committed_write_frontier(cfg, batch, inc, committed, losers)
 
 
 def _lock_edges(cfg, batch: AccessBatch, inc: Incidence):

@@ -246,6 +246,27 @@ class Config:
     #                                Chained backends ignore this flag
     #                                (their exemption is escrow_order_free
     #                                alone, as before).
+    repair: bool = False           # transaction repair (engine/repair.py):
+    #                                salvage sweep-backend ABORTS by
+    #                                re-executing only the invalidated
+    #                                slice as chained sub-rounds within
+    #                                the SAME epoch — losers whose
+    #                                re-validation passes against the
+    #                                post-winner state commit instead of
+    #                                re-entering the retry queue (PAPERS:
+    #                                *Transaction Repair: Full
+    #                                Serializability Without Locks*;
+    #                                DGCC's dependency-graph batching).
+    #                                Default off: losers take the retry
+    #                                queue exactly as before — every
+    #                                code path, log byte and verdict
+    #                                plane is bit-identical to pre-repair.
+    repair_rounds: int = 2         # repair sub-rounds per epoch before
+    #                                leftovers (cyclic re-invalidation:
+    #                                each pass's winners re-invalidate
+    #                                the rest) fall back to the retry
+    #                                queue; 0 = arm the machinery but
+    #                                salvage nothing (ablation floor)
     seq_batch_timer_us: float = 5000.0  # Calvin epoch cadence (config.h:348)
 
     # ---- device mesh ----
@@ -868,6 +889,35 @@ class Config:
             _check(self.tenant_quota == 0.0
                    and self.admission_slo_ms == 0.0,
                    "tenant_quota/admission_slo_ms need --admission=true")
+        # ---- transaction repair gating (same discipline as elastic/geo/
+        # overload: defaults take the pre-repair paths exactly) ----
+        _check(self.repair_rounds >= 0 and self.repair_rounds <= 8,
+               "repair_rounds must be in [0, 8] (each round is a fused "
+               "re-validation + re-execution pass inside the epoch jit)")
+        if self.repair:
+            _check(self.cc_alg in (CCAlg.NO_WAIT, CCAlg.WAIT_DIE,
+                                   CCAlg.OCC, CCAlg.TIMESTAMP, CCAlg.MVCC,
+                                   CCAlg.MAAT),
+                   "repair applies to the six sweep backends only "
+                   "(CALVIN/TPU_BATCH never abort — there is nothing to "
+                   "salvage; NOCC has no conflicts)")
+            _check(self.mode == Mode.NORMAL,
+                   "repair re-executes committed state; degraded modes "
+                   "(SIMPLE/NOCC/QRY_ONLY) have no abort path to salvage")
+            _check(self.device_parts == 1,
+                   "repair sub-rounds do not compose with multi-chip "
+                   "execution yet (the frontier matvec and the chained "
+                   "re-execution are single-device)")
+            _check(self.workload in (WorkloadKind.YCSB, WorkloadKind.TPCC),
+                   "repair re-execution closures are wired for YCSB and "
+                   "TPCC (workloads declare re_execute); PPS keeps "
+                   "retry-only semantics")
+            if self.node_cnt > 1:
+                _check(self.dist_protocol == "merged",
+                       "cluster repair needs --dist_protocol=merged: the "
+                       "repair sub-rounds are part of the replicated "
+                       "deterministic verdict, which the VOTE protocol's "
+                       "partitioned local validation cannot express")
         if self.elastic and self.fault_kill:
             # failover-with-reassignment: survivors absorb the dead
             # node's slots by log replay — never restart it

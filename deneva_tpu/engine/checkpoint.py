@@ -28,8 +28,10 @@ import numpy as np
 #          2 = round-3 (MVCC per-row VersionRing joins the db pytree);
 #          3 = round-4 (PoolState.defer_cnt for the defer budget);
 #          4 = round-4 (per-type latency_hist + retry/wait hist leaves);
-#          5 = round-5 (VersionRing flattened to [R*H] storage).
-SCHEMA_VERSION = 5
+#          5 = round-5 (VersionRing flattened to [R*H] storage);
+#          6 = round-13 (rep_* transaction-repair counters in
+#              device stats).
+SCHEMA_VERSION = 6
 
 
 def save_state(path: str, state) -> None:

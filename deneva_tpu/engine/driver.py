@@ -218,6 +218,15 @@ def run_simulation(cfg: Config, chunk: int = 50,
               "total_txn_abort_cnt", "unique_txn_abort_cnt", "defer_cnt",
               "write_cnt"):
         st.set(k, float(after[k] - before[k]))
+    if cfg.repair:
+        # repair counters ([summary] satellite): salvaged txns committed
+        # (NOT double-counted as aborts — total_txn_abort_cnt already
+        # excludes them at the source, engine/repair.run_repair),
+        # invalidated read lanes, and retry-queue fallbacks.  Emitted
+        # only when armed so the default summary line is byte-identical.
+        for k in ("rep_salvaged_cnt", "rep_frontier_cnt",
+                  "rep_fallback_cnt"):
+            st.set(k, float(after[k] - before[k]))
     for i, nm in enumerate(getattr(wl, "txn_type_names", ())):
         for fam in ("commit", "abort"):
             key = f"{fam}_by_type"

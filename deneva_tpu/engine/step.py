@@ -88,6 +88,14 @@ def init_device_stats(n_txn_types: int = 1) -> dict:
         # many waited epochs (defer_cnt) each committed txn paid
         "retry_hist": jnp.zeros((RETRY_BUCKETS,), jnp.uint32),
         "wait_hist": jnp.zeros((RETRY_BUCKETS,), jnp.uint32),
+        # transaction repair (engine/repair.py, Config.repair): txns
+        # salvaged by in-epoch re-execution (committed, NOT counted in
+        # total_txn_abort_cnt), invalidated read lanes observed, and
+        # losers that exhausted repair_rounds and fell back to the
+        # retry queue.  Always present (pytree structure is config-
+        # independent); stay zero unless repair is armed.
+        "rep_salvaged_cnt": z(), "rep_frontier_cnt": z(),
+        "rep_fallback_cnt": z(),
         # per-txn-kind commit/abort breakdown (reference Stats_thd's
         # per-type counter families); names come from
         # Workload.txn_type_names at summary time
@@ -198,6 +206,7 @@ class Engine:
         # 4. validate
         forwarding = forwarding_applies(be, wl) and cfg.mode == Mode.NORMAL
         fwd = None
+        inc = None
         forced = forced_sentinel_mask(batch) if cfg.ycsb_abort_mode else None
         if cfg.mode == Mode.NOCC:
             nocc = get_backend("NOCC")
@@ -284,6 +293,27 @@ class Engine:
                                 stats)
         # Mode.SIMPLE / QRY_ONLY: ack without touching tables
         # (reference SIMPLE_MODE / QRY_ONLY_MODE, config.h:276-281)
+
+        # 5b. transaction repair (engine/repair.py, default off): the
+        # losers of the sweep re-execute as chained sub-rounds against
+        # the post-winner state inside this same jitted step; salvaged
+        # txns move abort -> commit (and release their slot like any
+        # commit) before the pool update and the counters below ever
+        # see them.  Gated exactly like the validate path it extends:
+        # sweep backend, NORMAL mode, single device.
+        if cfg.repair and cfg.mode == Mode.NORMAL and not forwarding \
+                and be.repair_rule is not None and cfg.device_parts == 1:
+            from deneva_tpu.engine.repair import run_repair
+            # ts_base: the pool's reserved restamp space — the exact
+            # stamp authority pool.update uses for abort restamps, so
+            # repaired stamps sit strictly above every committed
+            # watermark and every stamp in this epoch
+            db, cc_state, verdict, salvaged = run_repair(
+                cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
+                stats, exec_commit, forced,
+                ts_base=pool.next_seq - jnp.int32(self.pool.b))
+            exec_commit = exec_commit | salvaged
+            release = release | salvaged
 
         # 6. update pool + counters (forced txns release like commits)
         pre_abort_cnt = sel(pool.abort_cnt)   # pre-update: 0 = never aborted

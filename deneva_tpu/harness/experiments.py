@@ -214,6 +214,56 @@ def tpcc_escrow(quick: bool) -> list[Config]:
             for a in sweep for esc in (True, False)]
 
 
+def repair_ablation(quick: bool) -> list[Config]:
+    """Transaction repair round-13 (engine/repair.py): the high-
+    contention points escrow cannot touch — YCSB zipf-0.9 WRITE-HEAVY
+    (90% blind writes: pure read-modify-write conflict pressure, no
+    commutativity to exploit) and hot-row TPC-C with the escrow
+    exemption OFF (re-flooring the hot rows so repair, not escrow, is
+    the only salvage channel) — for OCC and MAAT (the headline pair)
+    plus NO_WAIT and TIMESTAMP (one lock + one ts representative).
+
+    The ablation axis is ``repair_rounds`` 0/1/2 at ``repair=true``
+    against the ``repair=false`` retry-only baseline: rounds=0 arms the
+    machinery but salvages nothing (the structural-overhead floor),
+    rounds=1 salvages conflict-free losers, rounds=2 additionally
+    salvages losers blocked only by round-1 winners; the acceptance
+    curve is committed txns/s and abort rate vs the baseline
+    (rep_salvaged_cnt / rep_fallback_cnt in each [summary] line break
+    the ratio down).  Quick mode shrinks shapes for CI; the full mode
+    keeps the paper shape for chip runs (capture provenance recorded by
+    ``python bench.py --experiment repair_ablation``, the PR 2 wedge
+    protocol)."""
+    base = paper_base(quick).replace(zipf_theta=0.9, read_perc=0.1,
+                                     write_perc=0.9)
+    if quick:
+        # the calibrated CPU operating point (same reasoning as
+        # tpcc_escrow quick mode: paper-shape epochs on a host CPU floor
+        # both sides by epoch rate and hide the ratio): 16k rows,
+        # 8 accesses/txn, eb=512 — measured commit-per-epoch ratios
+        # repair-on/off of ~2x (OCC) and 2.4-3.1x (MAAT) land here
+        base = base.replace(synth_table_size=1 << 14, req_per_query=8,
+                            max_accesses=8, epoch_batch=512,
+                            conflict_buckets=2048,
+                            max_txn_in_flight=2048)
+    tpcc = paper_base(quick).replace(workload="TPCC", max_accesses=32,
+                                     num_wh=4, perc_payment=0.5,
+                                     escrow_sweep=False)
+    if quick:
+        tpcc = tpcc.replace(max_accesses=18, epoch_batch=256,
+                            conflict_buckets=2048, max_txn_in_flight=1024)
+    algs = ("OCC", "MAAT") if quick else ("OCC", "MAAT", "NO_WAIT",
+                                          "TIMESTAMP")
+    out = []
+    for wl_base in ((base,) if quick else (base, tpcc)):
+        for a in algs:
+            out.append(wl_base.replace(cc_alg=CCAlg(a), repair=False))
+            for rounds in (0, 1, 2):
+                out.append(wl_base.replace(cc_alg=CCAlg(a), repair=True,
+                                           repair_rounds=rounds))
+    return out
+
+
 def tpcc_order_index(quick: bool) -> list[Config]:
     """Dynamic ordered ORDER index A/B (VERDICT r5 next #5): the two
     deterministic backends at 2-3 warehouse shapes with
@@ -379,6 +429,7 @@ experiment_map: dict[str, Callable[[bool], list[Config]]] = {
     "isolation_levels": isolation_levels,
     "operating_points": operating_points,
     "escrow_ablation": escrow_ablation,
+    "repair_ablation": repair_ablation,
     "tpcc_scaling": tpcc_scaling,
     "tpcc_escrow": tpcc_escrow,
     "tpcc_order_index": tpcc_order_index,

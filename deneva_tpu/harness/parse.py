@@ -192,6 +192,35 @@ def parse_admission(lines) -> list[dict[str, Any]]:
     return out
 
 
+_REPAIR = re.compile(r"\[repair\] (.*)")
+
+
+def parse_repair(lines) -> list[dict[str, Any]]:
+    """Per-node ``[repair]`` summary lines (engine/repair.py via
+    runtime/server.py) -> [{node, salvaged, frontier, fallback, rounds,
+    plane_cnt}].  ``salvaged`` counts txns that committed via in-epoch
+    repair — by contract they are NOT in ``total_txn_abort_cnt``, so
+    abort-rate parsing keeps its pre-repair semantics (the
+    ``rep_salvaged_cnt`` [summary] field carries the same number).
+    Logs predating the repair tier yield [] — and every other parser
+    here ignores ``[repair]`` lines — the same forward/backward-compat
+    contract as ``parse_membership``/``parse_replication``/
+    ``parse_admission`` (tested in tests/test_harness.py)."""
+    out = []
+    for line in lines:
+        m = _REPAIR.search(line)
+        if not m:
+            continue
+        d: dict[str, Any] = {}
+        for kv in m.group(1).split():
+            if "=" not in kv:
+                continue
+            k, v = kv.split("=", 1)
+            d[k] = _auto(v)
+        out.append(d)
+    return out
+
+
 def cfg_header(cfg: Config) -> str:
     """`# cfg key=value` echo lines the runner prepends to each output file
     so parsing never has to re-derive the config from the filename."""

@@ -60,6 +60,13 @@ def run_point(cfg: Config, out_dir: str, quiet: bool = True) -> str:
     return path
 
 
+RESULT_DIRS = {
+    # experiment -> canonical results/ leaf when they differ (the
+    # repair_ablation sweep IS the "results/repair" record)
+    "repair_ablation": "repair",
+}
+
+
 def run_experiment(name: str, quick: bool = False,
                    out_root: str = "results", quiet: bool = False,
                    bench: bool = False) -> list[dict]:
@@ -72,7 +79,7 @@ def run_experiment(name: str, quick: bool = False,
     cfgs = get_experiment(name, quick=quick)
     if bench:
         cfgs = [c.replace(warmup_secs=1.5, done_secs=4.0) for c in cfgs]
-    out_dir = os.path.join(out_root, name)
+    out_dir = os.path.join(out_root, RESULT_DIRS.get(name, name))
     if not quiet:
         print(f"[{name}] {len(cfgs)} points -> {out_dir}", flush=True)
     written = [os.path.basename(run_point(cfg, out_dir, quiet=quiet))

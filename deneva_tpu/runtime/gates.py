@@ -119,6 +119,18 @@ GATES: dict[str, GateSpec] = {s.name: s for s in (
         use_attrs=("adm", "_arrival", "_ledger", "ring_tenants"),
     ),
     GateSpec(
+        "repair",
+        # transaction repair: salvage sweep-backend aborts by in-epoch
+        # re-execution sub-rounds (engine/repair.py).  repair_rounds is
+        # a depth knob, not a flag (its default is a live value, like
+        # sweep_rounds) — arming is `repair` alone.  _repair is the
+        # ServerNode's cached boolean; the engine/step.py and server
+        # epoch-body call sites gate on cfg.repair directly.
+        flags=("repair",),
+        guards=("repair", "_repair"),
+        home=("deneva_tpu/engine/repair.py",),
+    ),
+    GateSpec(
         "fault",
         flags=("fault_drop_prob", "fault_dup_prob",
                "fault_delay_jitter_us", "fault_kill", "recover"),

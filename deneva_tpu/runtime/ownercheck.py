@@ -82,6 +82,11 @@ OWNER: dict[str, str] = {
     "_promote_cnt": DISPATCH, "_quorum_hold_t": DISPATCH,
     "_quorum_stall_s": DISPATCH, "_quorum_release_cnt": DISPATCH,
     "_geo_spans": DISPATCH,
+    # transaction repair (engine/repair.py): the rep-plane accounting
+    # happens only at the dispatch thread's retire positions (the
+    # retire worker PREFETCH returns the plane; _retire consumes it)
+    "_repair": DISPATCH, "_rep_salvaged": DISPATCH,
+    "_rep_meas": DISPATCH, "_rep_span": DISPATCH,
     # elastic membership control plane (cutovers at group boundaries,
     # always applied on the dispatch thread)
     "smap": DISPATCH, "_mig_pending": DISPATCH, "_mig_rows": DISPATCH,

@@ -215,6 +215,20 @@ class Stats:
         return f"[prog] {body}" + (f",{tail}" if tail else "")
 
 
+def tagged_line(tag: str, fields: dict) -> str:
+    """``[tag] k=v k=v ...`` emitter for subsystem summary-line families
+    (currently ``[repair]``; the older ``[membership]``/
+    ``[replication]``/``[admission]`` lines predate it and keep their
+    own per-family float formatting).  All four share the same
+    space-separated k=v SHAPE, parsed by the matching `harness.parse`
+    regex parsers — which by contract ignore every tag they do not
+    know, so new families never break old tooling."""
+    body = " ".join(
+        f"{k}={_fmt(v) if isinstance(v, (int, float)) else v}"
+        for k, v in fields.items())
+    return f"[{tag}] {body}"
+
+
 def make_prog_line(runtime: float, counters: dict,
                    extra: dict[str, float] | None = None) -> str:
     """Shared [prog] emitter for the in-process driver and cluster servers:

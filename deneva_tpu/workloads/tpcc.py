@@ -642,6 +642,25 @@ class TPCCWorkload:
                     is_write=is_write, valid=valid, order_free=order_free,
                     owner=owner)
 
+    # -- repair re-execution (engine/repair.py, Config.repair) ---------
+    def re_execute(self, db, q: TPCCQuery, mask: jax.Array,
+                   order: jax.Array, stats: dict):
+        """Pure re-execution closure, keyed by txn slot: re-running a
+        repaired txn is ``execute`` on the same query row against
+        CURRENT state.  NewOrder re-reads D_NEXT_O_ID, stock quantities
+        and the immutable price columns post-winners — the masked
+        re-read (non-frontier gathers return values nothing overwrote)
+        — recomputes its RMW writes and appends its ORDER/NEW-ORDER/
+        ORDER-LINE rows in the sub-round wave, so per-district o_ids
+        stay dense across waves (oracle: tests/test_repair.py audit).
+        Escrow contract, documented and tested: repair of an escrow
+        (order_free) delta is a NO-OP semantically — the delta
+        recomputes identically from the query row (pure function,
+        independent of any read) and scatter-adds once, exactly the
+        write the main wave would have applied; escrow reads are
+        declared-immutable columns and never enter the frontier."""
+        return self.execute(db, q, mask, order, stats)
+
     # -- execution ------------------------------------------------------
     # NewOrder's stock update is a true RMW (the new quantity depends on
     # the read), so the single-pass forwarding executor does not apply

@@ -479,6 +479,21 @@ class YCSBWorkload:
             slots = jnp.where(owned, slots, jnp.int32(self.n_local))
         return slots
 
+    # -- repair re-execution (engine/repair.py, Config.repair) ---------
+    def re_execute(self, db, q: YCSBQuery, mask: jax.Array,
+                   order: jax.Array, stats: dict):
+        """Pure re-execution closure, keyed by txn slot: the query
+        pytree row IS the captured plan, so re-running a repaired txn is
+        ``execute`` on the same row against CURRENT state.  Reads
+        re-gather F0 — the masked re-read of the invalidated keys, bit
+        for bit: every lane OUTSIDE the frontier re-reads a value no
+        committed txn overwrote (the frontier is a bucket-space
+        superset of true overwrites, cc/base.committed_write_frontier)
+        — and blind writes recompute from ``(key, order)`` exactly as
+        any wave's writes do.  One gather + one scatter per sub-round,
+        same as the main wave."""
+        return self.execute(db, q, mask, order, stats)
+
     # -- execution (ycsb_txn.cpp:177-209 collapsed to one batch) -------
     def execute(self, db, q: YCSBQuery, mask: jax.Array, order: jax.Array,
                 stats: dict, fwd_rank=None, level_exec: bool = False):

@@ -1,0 +1,8 @@
+"""Fixture config: just the repair flag, default OFF (the registry
+drift check cross-parses this module against the REAL repair GateSpec)."""
+
+
+class Config:
+    repair: bool = False
+    repair_rounds: int = 2
+    node_cnt: int = 1

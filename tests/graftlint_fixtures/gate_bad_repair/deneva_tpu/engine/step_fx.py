@@ -1,0 +1,32 @@
+"""Seeded graftlint violations: the REAL ``repair`` GateSpec
+(runtime/gates.py) checked against fixture call sites — an unguarded
+call into the repair home module must fail the lint, the guarded
+idioms the runtime actually uses (``cfg.repair`` at the engine call
+site, the server's cached ``self._repair``) must stay silent."""
+
+from deneva_tpu.engine.repair import repair_line, run_repair
+
+
+class EngineFx:
+    def __init__(self, cfg):
+        self._repair = cfg.repair
+
+    def ok_step(self, cfg, wl, be, db, q, batch, inc, v, st, stats, ec):
+        # the engine/step.py idiom: flag test dominates the call
+        if cfg.repair and be.repair_rule is not None:
+            db, st, v, _ = run_repair(cfg, wl, be, db, q, batch, inc,
+                                      v, st, stats, ec)
+        return db, st, v
+
+    def ok_summary(self):
+        # the server idiom: the cached boolean stamped in __init__
+        if self._repair:
+            print(repair_line(0, {"salvaged": 1}))
+
+    def bad_step(self, cfg, wl, be, db, q, batch, inc, v, st, stats, ec):
+        # no dominating repair-flag test on any path to the call
+        return run_repair(cfg, wl, be, db, q,  # EXPECT[gate-unguarded-use]
+                          batch, inc, v, st, stats, ec)
+
+    def bad_line(self):
+        return repair_line(0, {})            # EXPECT[gate-unguarded-use]

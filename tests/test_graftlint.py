@@ -129,6 +129,24 @@ def test_gate_fixture_rules_fire_exactly():
         "\n".join(f.render() for f in findings)
 
 
+def test_repair_gate_fires_on_unguarded_use():
+    """The REAL ``repair`` GateSpec (runtime/gates.py, not a fixture
+    registry) catches an unguarded call into engine/repair.py and
+    accepts the two guarded idioms the runtime uses (``cfg.repair`` at
+    the engine/server call sites, the server's cached ``self._repair``)
+    — the CI teeth behind the default-off bit-identity contract."""
+    from deneva_tpu.runtime.gates import GATES
+
+    root = os.path.join(FIX, "gate_bad_repair")
+    tree = Tree(root, ["."])
+    findings = tree.filter(gateconsistency.check(
+        tree, gates={"repair": GATES["repair"]}, exempt=(),
+        escrow_funcs=(), escrow_home=(),
+        config_module="deneva_tpu/config.py", guarded=(), model={}))
+    assert _got(findings) == _expected(root), \
+        "\n".join(f.render() for f in findings)
+
+
 def test_gate_registry_matches_config():
     """Executable half of gate-registry-drift: every registered flag is
     a real Config field defaulting OFF, every wiremodel gate names a

@@ -311,6 +311,52 @@ def test_parse_admission_forward_backward_compat(tmp_path):
     assert parse_file(str(old_log))["tput"] == 5
 
 
+def test_parse_repair_forward_backward_compat(tmp_path):
+    """[repair] lines (transaction-repair satellite): per-node salvage
+    accounting; old logs yield [], the new lines perturb no other
+    parser, and the [summary] rep_* fields parse through the standard
+    summary path with abort semantics preserved (salvaged txns are NOT
+    in total_txn_abort_cnt — rep_salvaged_cnt carries them)."""
+    from deneva_tpu.harness.parse import (parse_admission, parse_file,
+                                          parse_membership, parse_repair,
+                                          parse_replication)
+    from deneva_tpu.harness.timeline import parse_timeline
+
+    new_log = tmp_path / "repair.out"
+    new_log.write_text(
+        "# cfg node_cnt=2\n"
+        "[repair] node=0 salvaged=1750 frontier=4196 fallback=11544 "
+        "rounds=2 plane_cnt=1422\n"
+        "[timeline] node=0 epoch=64 loop=1.0ms repair=0.2ms\n"
+        "[summary] total_runtime=2,tput=1800,txn_cnt=3600,"
+        "total_txn_commit_cnt=3600,total_txn_abort_cnt=11544,"
+        "rep_salvaged_cnt=1750,rep_frontier_cnt=4196,"
+        "rep_fallback_cnt=11544\n")
+    rows = parse_repair(new_log.read_text().splitlines())
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["node"] == 0 and r["salvaged"] == 1750
+    assert r["fallback"] == 11544 and r["rounds"] == 2
+    assert r["plane_cnt"] == 1422
+    # abort-semantics contract: fallbacks ARE the aborts, salvage rides
+    # its own counter — a pre-repair consumer reading abort_rate sees
+    # retry-queue behavior unchanged
+    row = parse_file(str(new_log))
+    assert row["total_txn_abort_cnt"] == row["rep_fallback_cnt"]
+    assert row["rep_salvaged_cnt"] == 1750
+    # other parsers ignore the new lines entirely
+    text = new_log.read_text().splitlines()
+    assert parse_membership(text) == []
+    assert parse_replication(text) == []
+    assert parse_admission(text) == []
+    assert len(parse_timeline(text)) == 1
+    # old log: no repair lines -> [] and unchanged parsing
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_repair(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5
+
+
 def test_timeline_chrome_trace_admission_track(tmp_path):
     """Admission spans (per-group max queue delay) export on their own
     per-node "admission" thread track (tid 2), beside — never inside —

@@ -18,6 +18,13 @@
 #                                         diurnal (bounded admission queue,
 #                                         shed + recovery, tenant fairness,
 #                                         exactly-once under NACK+resend)
+#   tools/smoke.sh repair                 transaction-repair gate:
+#                                         repair-contention (zipf-0.9
+#                                         write-heavy OCC with repair on +
+#                                         crash/recovery: exactly-once with
+#                                         salvaged txns acked as commits,
+#                                         bit-identical replay through the
+#                                         repair sub-rounds, salvage > 0)
 #   tools/smoke.sh lint                   static-analysis gate: graftlint v2
 #                                         (trace/det/wire/own/imports + the
 #                                         gate/life/jit families on the
@@ -73,6 +80,10 @@ case "$SCEN" in
     T="${SMOKE_TIMEOUT_SECS:-${OVERLOAD_TIMEOUT_SECS:-900}}"
     run "$T" python -m deneva_tpu.harness.chaos overload --quick
     ;;
+  repair)
+    T="${SMOKE_TIMEOUT_SECS:-${REPAIR_TIMEOUT_SECS:-600}}"
+    run "$T" python -m deneva_tpu.harness.chaos repair-contention --quick
+    ;;
   lint)
     # static gate; budget 30 s total on the 2-core CI box (graftlint v2
     # measures ~6.5 s full-tree over the 8 families / 78 files, ruff
@@ -95,7 +106,7 @@ case "$SCEN" in
     fi
     ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|lint> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|repair|lint> [args...]" >&2
     exit 2
     ;;
 esac
