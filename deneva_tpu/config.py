@@ -384,6 +384,40 @@ class Config:
     recover: bool = False          # start this server in recovery mode:
     #                                replay the command log, rejoin the
     #                                mesh at the next group boundary
+    failover_timeout_s: float = 60.0  # the failover wall family: the
+    #                                REJOIN replica-handshake wait, the
+    #                                MIGRATE_ROWS donor-stream wait and
+    #                                the reassignment-replay flush wait
+    #                                all read this single knob (they were
+    #                                hidden 30/60 s constants — the PR 4
+    #                                clamped-window lesson: hidden walls
+    #                                flake slow CI boxes; raise it there)
+    fault_partition: str = ""      # network partition injection (native
+    #                                dt_set_partition blackholes):
+    #                                comma-separated "A-B:START"
+    #                                (bidirectional) or "A>B:START"
+    #                                (one-way: A's frames to B are
+    #                                dropped) entries; A/B are SERVER
+    #                                ids, START is seconds after the run
+    #                                barrier.  Each endpoint applies its
+    #                                own TX-side drops at its group
+    #                                boundaries, so the first silenced
+    #                                epoch is identical on every
+    #                                receiver.  "" = off.
+    fault_partition_flap_s: float = 0.0  # flapping link: every armed
+    #                                partition toggles on/off with this
+    #                                period from its START (on for
+    #                                flap_s, off for flap_s, ...).  0 =
+    #                                partitions are permanent.
+    fault_peer_stall: str = ""     # gray-slow peer (native
+    #                                dt_set_peer_stall_us): "NODE:MS:
+    #                                START_S" — server NODE delays ALL
+    #                                its outbound frames by MS
+    #                                milliseconds from START_S seconds
+    #                                after the barrier.  Models a
+    #                                stalled-but-alive process: sockets
+    #                                never close, peer_alive stays true,
+    #                                only the suspicion score sees it.
 
     # ---- elastic membership (slot-map routing + live rebalance;
     # runtime/membership.py).  All defaults OFF: with elastic=False every
@@ -461,6 +495,50 @@ class Config:
     #                                issued as follower snapshot reads
     #                                (REGION_READ to the nearest live
     #                                follower); 0 disables the read path.
+
+    # ---- partition & gray-failure tolerance (heartbeat failure
+    # detector, fenced slot ownership, quorum reassignment;
+    # runtime/faildet.py).  All defaults OFF: with fencing=False no
+    # heartbeat is ever sent, no frame grows a fence header, and every
+    # log byte / replica stream / digest / wire byte is bit-identical
+    # to the pre-fencing runtime. ----
+    fencing: bool = False          # arm the membership fencing layer:
+    #                                HEARTBEAT frames feed a phi-accrual
+    #                                per-peer suspicion score (gray
+    #                                failures that never close a socket);
+    #                                EPOCH_BLOB/LOG_MSG carry the
+    #                                sender's map_version and receivers
+    #                                reject stale incarnations with
+    #                                FENCE_NACK; a fenced-out primary
+    #                                self-halts with exit 18 instead of
+    #                                serving split-brain writes; dead-
+    #                                peer reassignment only fires on the
+    #                                majority side of the live set
+    #                                (minority partitions self-fence,
+    #                                ties resolve to the side holding
+    #                                the lowest id); and CL_RSPs gate on
+    #                                a majority having CONFIRMED receipt
+    #                                of the acked epoch's blob (the
+    #                                epoch-boundary ack lease that makes
+    #                                a partitioned primary's acks
+    #                                causally impossible, not just
+    #                                unlikely).  Requires elastic +
+    #                                logging (reassignment rebuilds rows
+    #                                by log replay).
+    fencing_phi: float = 8.0       # phi-accrual suspicion threshold: a
+    #                                peer is SUSPECTED once
+    #                                phi = log10(e) * elapsed/mean_gap
+    #                                crosses this (8.0 at the 100 ms
+    #                                heartbeat cadence ~= 1.8 s silent)
+    fencing_heartbeat_ms: float = 100.0  # standalone HEARTBEAT cadence
+    #                                per live peer link (any received
+    #                                frame also counts as a heartbeat —
+    #                                the epoch exchange piggybacks)
+    fencing_suspect_s: float = 2.0  # wall-clock silence floor a
+    #                                suspicion must ALSO clear before it
+    #                                may drive reassignment / self-
+    #                                fencing — hysteresis so a flapping
+    #                                link heals instead of fencing
 
     # ---- overload robustness tier (open-loop load generation +
     # per-tenant admission control + SLO backpressure; runtime/loadgen.py
@@ -569,6 +647,7 @@ class Config:
         default config runs byte-identical to the pre-chaos runtime."""
         return (self.fault_drop_prob > 0 or self.fault_dup_prob > 0
                 or self.fault_delay_jitter_us > 0 or bool(self.fault_kill)
+                or bool(self.fault_partition) or bool(self.fault_peer_stall)
                 or self.recover)
 
     def fault_kill_spec(self) -> tuple[int, int] | None:
@@ -577,6 +656,48 @@ class Config:
             return None
         node, epoch = self.fault_kill.split(":")
         return int(node), int(epoch)
+
+    def fault_partition_spec(self) -> list[tuple[int, int, bool, float]]:
+        """Parse fault_partition into [(a, b, bidirectional, start_s)].
+        "A-B:S" blackholes both directions from S seconds after the
+        barrier; "A>B:S" only frames A sends to B.  [] when unset."""
+        out: list[tuple[int, int, bool, float]] = []
+        if not self.fault_partition:
+            return out
+        for ent in self.fault_partition.split(","):
+            ent = ent.strip()
+            sep = ">" if ">" in ent else "-"
+            try:
+                pair, start = ent.split(":")
+                a, b = (int(x) for x in pair.split(sep))
+                start = float(start)
+            except ValueError:
+                raise ValueError(
+                    f"config: fault_partition entry {ent!r} must be "
+                    "'A-B:START_S' (bidirectional) or 'A>B:START_S' "
+                    "(one-way)")
+            _check(0 <= a < self.node_cnt and 0 <= b < self.node_cnt
+                   and a != b and start >= 0,
+                   f"fault_partition entry {ent!r}: A/B must name "
+                   "distinct server nodes and START_S must be >= 0")
+            out.append((a, b, sep == "-", start))
+        return out
+
+    def fault_peer_stall_spec(self) -> tuple[int, float, float] | None:
+        """Parse fault_peer_stall 'NODE:MS:START_S' (None when unset)."""
+        if not self.fault_peer_stall:
+            return None
+        try:
+            node, ms, start = self.fault_peer_stall.split(":")
+            node, ms, start = int(node), float(ms), float(start)
+        except ValueError:
+            raise ValueError(
+                f"config: fault_peer_stall {self.fault_peer_stall!r} "
+                "must be 'NODE:MS:START_S'")
+        _check(0 <= node < self.node_cnt and ms > 0 and start >= 0,
+               "fault_peer_stall: NODE must name a server, MS > 0, "
+               "START_S >= 0")
+        return node, ms, start
 
     def geo_wan_spec(self) -> dict[tuple[int, int], int]:
         """Parse geo_wan_us into a directed {(region_a, region_b): us}
@@ -774,6 +895,27 @@ class Config:
             _check(self.logging,
                    "fault_kill/recover need --logging: recovery rebuilds "
                    "state by replaying the command log")
+        _check(self.failover_timeout_s > 0,
+               "failover_timeout_s must be > 0")
+        self.fault_partition_spec()     # raises on a malformed spec
+        self.fault_peer_stall_spec()
+        _check(self.fault_partition_flap_s >= 0,
+               "fault_partition_flap_s must be >= 0")
+        if self.fault_partition_flap_s > 0:
+            _check(bool(self.fault_partition),
+                   "fault_partition_flap_s needs fault_partition entries "
+                   "to flap")
+        # ---- fencing gating (same discipline as elastic/geo/overload/
+        # repair: defaults take the pre-fencing paths exactly) ----
+        _check(self.fencing_phi > 0 and self.fencing_heartbeat_ms > 0
+               and self.fencing_suspect_s > 0,
+               "fencing_phi/fencing_heartbeat_ms/fencing_suspect_s must "
+               "be > 0")
+        if self.fencing:
+            _check(self.elastic and self.logging,
+                   "fencing needs --elastic=true and --logging: quorum "
+                   "reassignment retires a fenced peer in place and "
+                   "rebuilds its rows by log replay")
         if self.elastic:
             _check(self.workload == WorkloadKind.YCSB,
                    "elastic membership currently supports YCSB only (the "
@@ -918,6 +1060,35 @@ class Config:
                        "repair sub-rounds are part of the replicated "
                        "deterministic verdict, which the VOTE protocol's "
                        "partitioned local validation cannot express")
+        if self.fencing and self.fault_peer_stall:
+            # the gray-slow node ends up fenced and retired in place —
+            # same coordinator constraint as the elastic kill below
+            _check(int(self.fault_peer_stall.split(":")[0]) != 0,
+                   "fencing cannot retire node 0 (the measure/stop "
+                   "coordinator); stall a node >= 1")
+        if self.fencing and self.fault_partition:
+            # node 0's partition side must win the quorum decision
+            # (majority, or the lowest-id tiebreak — which node 0 holds
+            # by construction): a spec that isolates the measure/stop
+            # coordinator into a minority would fence it and strand the
+            # survivors on multi-minute recovery timeouts instead of
+            # failing fast here.  Approximate the sides by connected
+            # components over the UNDIRECTED uncut link graph (any
+            # entry, either direction, severs its pair).
+            cut = {frozenset((a, b))
+                   for a, b, _bi, _s in self.fault_partition_spec()}
+            comp, frontier = {0}, [0]
+            while frontier:
+                u = frontier.pop()
+                for v in range(self.node_cnt):
+                    if v != u and v not in comp \
+                            and frozenset((u, v)) not in cut:
+                        comp.add(v)
+                        frontier.append(v)
+            _check(2 * len(comp) >= self.node_cnt,
+                   "fencing cannot fence node 0 (the measure/stop "
+                   "coordinator): this fault_partition isolates it on "
+                   "a minority side — cut around a node >= 1")
         if self.elastic and self.fault_kill:
             # failover-with-reassignment: survivors absorb the dead
             # node's slots by log replay — never restart it

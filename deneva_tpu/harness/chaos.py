@@ -62,10 +62,41 @@ three — the tools/smoke.sh ``geo`` gate):
                    its digest again bit-identical to independent
                    replay.
 
+Partition & gray-failure scenarios (runtime/faildet.py, fencing=true;
+`partition` expands to all four — the tools/smoke.sh ``partition``
+gate).  All four audit the same safety core: exactly-once accounting,
+the SINGLE-WRITER-PER-SLOT bound (the fenced primary's last released
+ack strictly precedes the survivors' takeover boundary — the
+epoch-boundary ack lease makes a later ack causally impossible), and
+the digest-vs-independent-replay oracle (every surviving server's final
+state is bit-identical to a replay of its own log under its FINAL map):
+
+* **partition-split**  symmetric blackhole isolates node 2 from both
+                   peers (sockets stay open — peer_alive never trips).
+                   The majority side {0,1} suspects, reassigns node 2's
+                   slots by log replay and continues; node 2 detects it
+                   is the minority and self-fences with exit 18
+                   (reported as "fenced", not a crash).
+* **partition-asym**   one-way blackhole: node 2's frames vanish but it
+                   hears everything — the purest gray failure.  The
+                   majority fences it with FENCE_NACK (deliverable on
+                   the open half-link); its acks were already frozen by
+                   the ack lease, so nothing it served conflicts.
+* **partition-grayslow**  node 1 turns gray-SLOW (4 s outbound stall on
+                   every link; frames arrive, eventually).  Suspicion —
+                   not socket death — retires it; the late stragglers
+                   of its old incarnation are rejected as stale.
+* **partition-flap**   the link to node 2 flaps (1.2 s on/off) below
+                   the fencing hysteresis: suspicion rises and HEALS
+                   (suspect_cnt/heal_cnt > 0), missed blobs re-ship
+                   through the REJOIN catch-up path, nobody is fenced
+                   (map_version stays 0) and commits stay identical on
+                   all three servers.
+
 Every scenario runs from a fixed fault_seed, so failures reproduce.
 
-CLI:  python -m deneva_tpu.harness.chaos [scenario ...|all|elastic|geo]
-                                         [--quick]
+CLI:  python -m deneva_tpu.harness.chaos
+          [scenario ...|all|elastic|geo|overload|partition] [--quick]
 """
 
 from __future__ import annotations
@@ -206,6 +237,42 @@ SCENARIOS: dict[str, dict] = {
         admission_queue_max=1024, arrival_process="diurnal",
         arrival_rate=5000.0, arrival_period_s=2.0, arrival_amp=0.8,
         done_secs=6.0),
+    # partition & gray-failure tolerance (runtime/faildet.py): fencing
+    # armed on a 3-server elastic cluster, the native partition/stall
+    # blackholes driving it.  Windows stay FULL under --quick like the
+    # elastic/geo/overload families (the PR 4 clamped-window lesson):
+    # the fault fires ~3 s in (past warmup, leaving a healthy commit
+    # prefix inside the measured window), suspicion needs its 2 s
+    # silence floor, and the survivors' replay-jit takeover stall
+    # measured 4-5 s on the 2-core CI box — a clamped window would
+    # swallow all of it and report zero commits.
+    "partition-split": dict(
+        node_cnt=3, epoch_batch=256, elastic=True, fencing=True,
+        logging=True, fault_partition="2-0:3.0,2-1:3.0", done_secs=10.0,
+        log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
+    "partition-asym": dict(
+        node_cnt=3, epoch_batch=256, elastic=True, fencing=True,
+        logging=True, fault_partition="2>0:3.0,2>1:3.0", done_secs=10.0,
+        log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
+    # stall 4 s against the 2 s suspicion floor: the initial bubble is
+    # what the detector sees (a constant delay pipelines afterwards —
+    # only the first gap is silence), so it must clear the floor with
+    # margin on a loaded box
+    "partition-grayslow": dict(
+        node_cnt=3, epoch_batch=256, elastic=True, fencing=True,
+        logging=True, fault_peer_stall="1:4000:3.0", done_secs=10.0,
+        log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
+    # flap 1.2 s on/off under a LOWERED phi threshold (suspicion crosses
+    # ~0.9 s into each outage) but a RAISED 3 s fencing floor (no outage
+    # ever clears it): suspicion must rise and heal repeatedly with
+    # nobody fenced — the hysteresis contract, plus the REJOIN blob
+    # catch-up that makes a healed link's dropped epochs recoverable
+    "partition-flap": dict(
+        node_cnt=3, epoch_batch=256, elastic=True, fencing=True,
+        logging=True, fault_partition="2-0:2.0,2-1:2.0",
+        fault_partition_flap_s=1.2, fencing_phi=4.0,
+        fencing_suspect_s=3.0, done_secs=8.0,
+        log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
 }
 
 # `elastic` on the CLI expands to the three membership scenarios (the
@@ -217,6 +284,8 @@ GEO_SCENARIOS = ("geo-region-loss", "geo-asymmetric-wan",
                  "geo-replica-lag")
 OVERLOAD_SCENARIOS = ("overload-flash", "overload-aggressor",
                       "overload-diurnal")
+PARTITION_SCENARIOS = ("partition-split", "partition-asym",
+                       "partition-grayslow", "partition-flap")
 
 
 class ChaosViolation(AssertionError):
@@ -239,7 +308,8 @@ def run_scenario(name: str, quick: bool = False,
         raise KeyError(f"unknown scenario {name!r} "
                        f"(have {sorted(SCENARIOS)})")
     spec = dict(SCENARIOS[name])
-    if quick and not name.startswith(("elastic-", "geo-", "overload-")):
+    if quick and not name.startswith(("elastic-", "geo-", "overload-",
+                                      "partition-")):
         # elastic scenarios keep their full window: the cutover stall
         # (row stream + boundary sync, 1.4-2.2 s measured on the CI box;
         # ~5 s replay-jit for kill-reassign) would otherwise swallow a
@@ -333,6 +403,8 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
         _check_geo(name, cfg, out, run_id, report)
     if name.startswith("overload-"):
         _check_overload(name, cfg, srv, cls, report)
+    if name.startswith("partition-"):
+        _check_partition(name, cfg, out, run_id, report)
 
 
 def _check_elastic(name: str, cfg: Config, out: dict, report: dict) -> None:
@@ -557,6 +629,159 @@ def _check_overload(name: str, cfg: Config, srv: list[dict],
                  f"{name}: a server admitted nothing across the wave")
 
 
+def _check_partition(name: str, cfg: Config, out: dict, run_id: str,
+                     report: dict) -> None:
+    """Fencing invariants.  The safety core every scenario audits:
+
+    * **single-writer-per-slot** — the fenced primary's last RELEASED
+      ack (its ``fenced.json`` sidecar records it) strictly precedes
+      the survivors' takeover boundary, so no slot was ever acked by
+      two primaries at overlapping epochs.  The epoch-boundary ack
+      lease is what makes this causal (an epoch's CL_RSPs release only
+      after a majority confirmed its blob), and this check is its
+      end-to-end teeth.
+    * **digest-vs-independent-replay** — every surviving server's final
+      state is bit-identical to a full replay of its OWN log under its
+      FINAL map (for a survivor that absorbed slots, replaying the
+      whole stream under the post-reassignment ownership reproduces
+      both its original rows and the adopted ones — the same argument
+      `_adopt_by_replay` rests on).
+    * per-scenario shape: who got fenced, how (minority vs FENCE_NACK),
+      slot coverage after the takeover, heal counting for the flap.
+    """
+    import numpy as np
+
+    from deneva_tpu.cc import get_backend
+    from deneva_tpu.engine.step import init_device_stats
+    from deneva_tpu.runtime.logger import (iter_record_spans, replay_into,
+                                           state_digest)
+    from deneva_tpu.runtime.membership import MEMBER_KEY, initial_map
+    from deneva_tpu.runtime.server import make_dist_step
+    from deneva_tpu.workloads import get_workload
+
+    n_srv = cfg.node_cnt
+    log_dir = os.path.join(cfg.log_dir, run_id)
+    srv = {s: parse_summary(out[s][1]) for s in range(n_srv)
+           if out[s][0] == "server"}
+    for s, v in srv.items():
+        _require(all(k in v for k in ("fence_nack_cnt", "suspect_cnt",
+                                      "heal_cnt", "phi_peak")),
+                 f"{name}: server {s} summary lacks fencing accounting")
+    fenced = {"partition-split": 2, "partition-asym": 2,
+              "partition-grayslow": 1}.get(name)
+    report["fenced_node"] = fenced
+    if fenced is None:
+        # flap: suspicion must rise AND heal, with nobody fenced and
+        # the map untouched — the hysteresis half of the contract
+        _require(len(srv) == n_srv,
+                 f"{name}: a server was fenced under a sub-floor flap: "
+                 f"{ {s: out[s][0] for s in range(n_srv)} }")
+        _require(all(v.get("map_version", -1) == 0 for v in srv.values()),
+                 f"{name}: the map moved under a flap that should heal")
+        report["suspects"] = sum(v.get("suspect_cnt", 0)
+                                 for v in srv.values())
+        report["heals"] = sum(v.get("heal_cnt", 0) for v in srv.values())
+        _require(report["suspects"] > 0,
+                 f"{name}: the flap never crossed the (lowered) phi "
+                 "threshold — is the detector live?")
+        _require(report["heals"] > 0,
+                 f"{name}: suspicions rose but never healed")
+    else:
+        _require(out[fenced][0] == "fenced",
+                 f"{name}: node {fenced} reported "
+                 f"{out[fenced][0]!r}, expected the exit-18 'fenced' "
+                 "outcome")
+        _require(fenced not in srv and len(srv) == n_srv - 1,
+                 f"{name}: unexpected server reports: {sorted(srv)}")
+        n_slots = initial_map(cfg).n_slots
+        owned = {s: v.get("owned_slots", -1) for s, v in srv.items()}
+        report["owned_slots"] = owned
+        _require(sum(owned.values()) == n_slots,
+                 f"{name}: survivors do not cover the slot space: "
+                 f"{owned} != {n_slots}")
+        _require(all(v.get("map_version", -1) == 1 for v in srv.values()),
+                 f"{name}: survivor map versions diverged")
+        _require(all(v.get("rows_migrated_in", 0) > 0
+                     for v in srv.values()),
+                 f"{name}: a survivor rebuilt no rows by replay")
+        # every survivor derived the same takeover boundary with no
+        # negotiation (group-aligned TX-side silence)
+        re_eps = {int(v.get("fence_reassign_epoch", -2))
+                  for v in srv.values()}
+        _require(len(re_eps) == 1 and min(re_eps) >= 0,
+                 f"{name}: survivors disagree on the takeover boundary: "
+                 f"{sorted(re_eps)}")
+        boundary = re_eps.pop()
+        report["reassign_epoch"] = boundary
+        side_path = os.path.join(log_dir, f"node{fenced}.fenced.json")
+        _require(os.path.exists(side_path),
+                 f"{name}: fenced sidecar missing at {side_path}")
+        with open(side_path) as f:
+            fside = json.load(f)
+        report["fence_reason"] = fside["reason"]
+        report["fenced_last_ack"] = fside["last_acked_epoch"]
+        _require(fside["map_version"] == 0,
+                 f"{name}: the fenced node installed a map of its own "
+                 f"(version {fside['map_version']}) — dual-map merge")
+        # SINGLE-WRITER-PER-SLOT: the fenced primary's last released
+        # ack strictly precedes the survivors' takeover of its slots
+        _require(fside["last_acked_epoch"] < boundary,
+                 f"{name}: the fenced node acked epoch "
+                 f"{fside['last_acked_epoch']} at/after the takeover "
+                 f"boundary {boundary} — split-brain ack")
+        # and its pipeline could not have logged meaningfully past the
+        # boundary (bounded by the in-flight window)
+        with open(os.path.join(log_dir, f"node{fenced}.log.bin"),
+                  "rb") as f:
+            buf = f.read()
+        last = max((e for e, _, _ in iter_record_spans(buf)), default=-1)
+        window = (cfg.pipeline_groups + 1) * cfg.pipeline_epochs
+        _require(last <= boundary + window,
+                 f"{name}: the fenced node logged epoch {last}, far "
+                 f"past the takeover boundary {boundary}")
+        if name == "partition-split":
+            _require(fside["reason"] == "minority",
+                     f"{name}: expected the minority self-fence, got "
+                     f"{fside['reason']!r}")
+        else:
+            # asym/grayslow: the fenced node could still HEAR — the
+            # targeted FENCE_NACK (or the healed-out map) retired it
+            _require(sum(v.get("fence_nack_cnt", 0)
+                         for v in srv.values()) > 0,
+                     f"{name}: no survivor ever sent a FENCE_NACK")
+            _require(fside["reason"] in ("fence_nack", "healed_out"),
+                     f"{name}: unexpected fence reason "
+                     f"{fside['reason']!r}")
+    # digest-vs-independent-replay under each survivor's FINAL map
+    for s in sorted(srv):
+        with open(os.path.join(log_dir, f"node{s}.fencing.json")) as f:
+            side = json.load(f)
+        node_cfg = cfg.replace(node_id=s, part_cnt=n_srv,
+                               fault_partition="",
+                               fault_partition_flap_s=0.0,
+                               fault_peer_stall="")
+        wl = get_workload(node_cfg)
+        be = get_backend(node_cfg.cc_alg)
+        step = make_dist_step(node_cfg, wl, be)
+        db0 = wl.load()
+        db0[MEMBER_KEY] = np.asarray(side["owners"], np.int32)
+        stats0 = init_device_stats(
+            len(getattr(wl, "txn_type_names", ("txn",))))
+        db0, _, _, last = replay_into(
+            os.path.join(log_dir, f"node{s}.log.bin"), node_cfg, wl,
+            step, db0, be.init_state(node_cfg), stats0,
+            stop_epoch=side["epochs_run"])
+        _require(last == side["epochs_run"] - 1,
+                 f"{name}: node {s} log replay ended at {last}, ran "
+                 f"{side['epochs_run']} epochs")
+        digest = state_digest(db0)
+        _require(digest == side["state_digest"],
+                 f"{name}: node {s} state diverged from independent "
+                 f"replay under its final map ({digest[:16]} != "
+                 f"{side['state_digest'][:16]})")
+    report["digest_match"] = True
+
+
 def _check_recovery(cfg: Config, out: dict, run_id: str,
                     report: dict) -> None:
     """Safety of the failover path: the killed server recovered by log
@@ -641,6 +866,7 @@ def main(argv: list[str]) -> int:
              for x in (ELASTIC_SCENARIOS if n == "elastic"
                        else GEO_SCENARIOS if n == "geo"
                        else OVERLOAD_SCENARIOS if n == "overload"
+                       else PARTITION_SCENARIOS if n == "partition"
                        else (n,))]
     rc = 0
     for name in names:

@@ -221,6 +221,35 @@ def parse_repair(lines) -> list[dict[str, Any]]:
     return out
 
 
+_FENCING = re.compile(r"\[fencing\] (.*)")
+
+
+def parse_fencing(lines) -> list[dict[str, Any]]:
+    """Per-node ``[fencing]`` lines (runtime/faildet.py via
+    runtime/server.py) -> [{node, phi_peak, suspect_cnt,
+    fence_nack_cnt, self_halt, heal_cnt, ...}].  Servers emit one at
+    summary time (``self_halt=0``); a fenced-out primary emits one just
+    before its exit-18 self-halt (``self_halt=1`` plus the reason and
+    epoch).  Logs predating the fencing tier yield [] — and every
+    other parser here ignores ``[fencing]`` lines — the same
+    forward/backward-compat contract as ``parse_membership``/
+    ``parse_replication``/``parse_admission``/``parse_repair`` (tested
+    in tests/test_harness.py)."""
+    out = []
+    for line in lines:
+        m = _FENCING.search(line)
+        if not m:
+            continue
+        d: dict[str, Any] = {}
+        for kv in m.group(1).split():
+            if "=" not in kv:
+                continue
+            k, v = kv.split("=", 1)
+            d[k] = _auto(v)
+        out.append(d)
+    return out
+
+
 def cfg_header(cfg: Config) -> str:
     """`# cfg key=value` echo lines the runner prepends to each output file
     so parsing never has to re-derive the config from the filename."""

@@ -46,6 +46,14 @@ REPLICATION_SPANS = frozenset(("quorum", "promote", "follower_read",
 # widening band beside the phase track, never inside it.
 ADMISSION_SPANS = frozenset(("adm_wait",))
 
+# fencing spans (partition-tolerance tier): suspicion windows ("suspect"
+# — the silence a peer accrued before being retired), heal gaps ("heal"
+# — the outage a flapping link recovered from) and fence rejections
+# ("fence").  Same latency-ledger treatment on a fourth track (tid 3,
+# "fencing"), so a partition episode reads as a band beside the phase
+# track instead of distorting it.
+FENCING_SPANS = frozenset(("suspect", "heal", "fence"))
+
 
 def parse_timeline(lines) -> list[dict]:
     """[{node, epoch, phases: {name: ms}}] from raw log lines."""
@@ -93,10 +101,12 @@ def chrome_trace(rows: list[dict]) -> dict:
     clock: dict[int, float] = {}          # node -> phase track time (us)
     rclock: dict[int, float] = {}         # node -> replication track time
     aclock: dict[int, float] = {}         # node -> admission track time
+    fclock: dict[int, float] = {}         # node -> fencing track time
     for r in rows:
         t = clock.get(r["node"], 0.0)
         rt = rclock.get(r["node"], 0.0)
         at = aclock.get(r["node"], 0.0)
+        ft = fclock.get(r["node"], 0.0)
         for name, ms in r["phases"].items():
             dur = ms * 1000.0
             if name in REPLICATION_SPANS:
@@ -122,6 +132,16 @@ def chrome_trace(rows: list[dict]) -> dict:
                 at += dur
                 aclock.setdefault(r["node"], 0.0)
                 continue
+            if name in FENCING_SPANS:
+                # fencing spans: same latency-ledger treatment on a
+                # fourth track (tid 3, "fencing")
+                events.append({"name": name, "ph": "X", "pid": r["node"],
+                               "tid": 3, "ts": round(ft, 3),
+                               "dur": round(dur, 3), "cat": "fencing",
+                               "args": {"epoch": r["epoch"]}})
+                ft += dur
+                fclock.setdefault(r["node"], 0.0)
+                continue
             events.append({"name": name, "ph": "X", "pid": r["node"],
                            "tid": 0, "ts": round(t, 3),
                            "dur": round(dur, 3),
@@ -132,12 +152,16 @@ def chrome_trace(rows: list[dict]) -> dict:
             rclock[r["node"]] = rt
         if r["node"] in aclock:
             aclock[r["node"]] = at
+        if r["node"] in fclock:
+            fclock[r["node"]] = ft
     meta = [{"name": "process_name", "ph": "M", "pid": n, "tid": 0,
              "args": {"name": f"node {n}"}} for n in sorted(clock)]
     meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": 1,
               "args": {"name": "replication"}} for n in sorted(rclock)]
     meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": 2,
               "args": {"name": "admission"}} for n in sorted(aclock)]
+    meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": 3,
+              "args": {"name": "fencing"}} for n in sorted(fclock)]
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 

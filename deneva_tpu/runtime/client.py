@@ -699,7 +699,8 @@ class ClientNode:
             st.set("follower_read_ver_viol", float(self._fr_ver_viol))
         for k, v in self.tp.stats().items():
             if not self._fault_mode and k in ("msg_dropped", "msg_dup",
-                                              "reconnects"):
+                                              "reconnects",
+                                              "msg_blackholed"):
                 continue   # keep the default-config summary line as-is
             st.set(f"net_{k}", float(v))
         return st

@@ -1,0 +1,269 @@
+"""Partition & gray-failure tolerance: heartbeat failure detection,
+fenced slot ownership, split-brain-free quorum decisions.
+
+Every failover path before this PR detected death via
+``tp.peer_alive(p)`` — a transport flag the receiver thread sets on
+socket teardown — and installed a new slot map on purely local
+observation.  A network partition or a stalled-but-alive process trips
+none of that, or trips it on BOTH sides: two primaries install
+conflicting maps and both serve writes for the same slots.  This module
+is the trust-nobody half of the membership layer (armed by
+``Config.fencing``, default off and bit-identical off):
+
+* **Failure detector** — a phi-accrual-style per-peer suspicion score
+  (Hayashibara et al.; simplified to the exponential-arrival form):
+  every received frame from a peer is a heartbeat observation, standalone
+  HEARTBEAT frames cover idle links, and
+  ``phi = log10(e) * elapsed / mean_gap`` grows without bound while a
+  peer is silent.  ``peer_alive`` socket death remains the fast path;
+  suspicion is what catches gray failures (stalled process, one-way
+  link) that never close a socket.
+* **Fenced ownership** — EPOCH_BLOB and LOG_MSG frames carry the
+  sender's slot-map version in a 12-byte fence envelope
+  (``fence_wrap``/``fence_peek``); receivers reject stale incarnations
+  with FENCE_NACK and a fenced-out primary self-halts with exit 18
+  (the launcher retires it as a scenario outcome).  MIGRATE/MAP frames
+  already carry the version in their body (PR 4).
+* **Epoch-boundary ack lease** — HEARTBEAT payloads carry, per link,
+  the highest epoch whose EPOCH_BLOB the sender has received from that
+  peer.  A primary releases an epoch's CL_RSPs only once a MAJORITY of
+  the live server set has confirmed receipt of that epoch's blob
+  (``majority_confirms``) — so a partitioned primary's acks for epochs
+  the surviving side never saw are causally impossible, not merely
+  unlikely.  The testbed's epoch boundaries are exactly the natural
+  fencing points (cf. PAPERS: epoch-based OCC in geo-replicated
+  databases).
+* **Quorum reassignment** — dead/suspected peers are retired in place
+  only by the side holding a majority of the live server set
+  (``majority_side``; ties resolve to the side holding the lowest live
+  id).  Minority partitions self-fence instead of installing a second
+  map.  Partition heal goes through the existing REJOIN path (retained-
+  blob resend + measure/stop echo) with map catch-up via HEAL frames —
+  never a dual-map merge.
+
+Wire bodies (rtypes 22-24, pinned OUTSIDE ``FAULT_RTYPE_MASK`` like
+every control-plane rtype since 15: their fault mode is process death /
+partition, never silent single-frame loss):
+
+* HEARTBEAT   (map_version, blob_seen, epoch) — per-link liveness +
+              lease grant; ``blob_seen`` is per-destination.
+* FENCE_NACK  (my_version, stale_version, epoch) — "your incarnation
+              is fenced out"; the receiver self-halts with exit 18.
+* HEAL        (epoch, map_version, owners[]) — post-partition map
+              catch-up, sent on a suspected→fresh transition.
+"""
+
+from __future__ import annotations
+
+import math
+import struct
+
+import numpy as np
+
+# exit sentinel of a fenced-out primary: the launcher retires it as a
+# scenario outcome ("fenced"), exactly like the planned-kill exit 17 —
+# anything else still fails loudly (runtime/launch.py)
+FENCED_EXIT = 18
+
+_LOG10_E = math.log10(math.e)
+
+_HB = struct.Struct("<qqq")         # map_version, blob_seen, epoch
+_NACK = struct.Struct("<qqq")       # my_version, stale_version, epoch
+_HEAL = struct.Struct("<qqI")       # epoch, map_version, n_slots
+_FENCE = struct.Struct("<Iq")       # magic, map_version
+_FENCE_MAGIC = 0xFE9CE001
+
+
+# ---- failure detector --------------------------------------------------
+
+class FailureDetector:
+    """Phi-accrual-style per-peer suspicion over message inter-arrival
+    gaps.  ``observe`` feeds it (ANY frame from a peer counts — the
+    epoch exchange piggybacks as heartbeats); ``phi`` is the suspicion
+    score; ``suspected`` latches the SUSPECTED state at the configured
+    threshold and ``observe`` clears it (a heal transition, counted).
+    ``fence_ready`` additionally requires the wall-clock silence floor
+    (``fencing_suspect_s``) — the hysteresis that lets a flapping link
+    heal instead of fencing.
+
+    The inter-arrival mean is an EWMA floored at the heartbeat cadence:
+    heavy epoch traffic must not shrink the expected gap so far that a
+    sub-second jit or GC stall reads as death."""
+
+    def __init__(self, cfg, peers, now_s: float):
+        self.threshold = cfg.fencing_phi
+        self.floor_s = cfg.fencing_suspect_s
+        self.interval_s = cfg.fencing_heartbeat_ms / 1e3
+        self._last = {p: now_s for p in peers}
+        self._mean = {p: self.interval_s for p in peers}
+        self._suspected: set[int] = set()
+        self.suspect_cnt = 0
+        self.heal_cnt = 0
+        self.phi_peak = 0.0
+
+    def peers(self):
+        return self._last.keys()
+
+    def observe(self, peer: int, now_s: float) -> float | None:
+        """Record a frame arrival; on a suspected→fresh HEAL transition
+        returns the silence gap in seconds (the caller drives the
+        REJOIN catch-up and the timeline span), else None."""
+        last = self._last.get(peer)
+        if last is None:
+            return None
+        gap = max(now_s - last, 0.0)
+        self._last[peer] = now_s
+        # EWMA floored at the heartbeat cadence (see class docstring)
+        self._mean[peer] = max(0.9 * self._mean[peer] + 0.1 * gap,
+                               self.interval_s)
+        if peer in self._suspected:
+            self._suspected.discard(peer)
+            self.heal_cnt += 1
+            return gap
+        return None
+
+    def phi(self, peer: int, now_s: float) -> float:
+        """Suspicion score: under exponential arrivals with the observed
+        mean gap, phi = -log10 P(silence >= elapsed)."""
+        elapsed = max(now_s - self._last[peer], 0.0)
+        return _LOG10_E * elapsed / max(self._mean[peer], 1e-6)
+
+    def suspected(self, peer: int, now_s: float) -> bool:
+        """phi-threshold check; latches the SUSPECTED state (cleared by
+        the next ``observe``) and tracks the peak score."""
+        ph = self.phi(peer, now_s)
+        if ph > self.phi_peak:
+            self.phi_peak = ph
+        if ph >= self.threshold:
+            if peer not in self._suspected:
+                self._suspected.add(peer)
+                self.suspect_cnt += 1
+            return True
+        return peer in self._suspected
+
+    def fence_ready(self, peer: int, now_s: float) -> bool:
+        """True once a suspicion may drive fencing/reassignment: the phi
+        threshold AND the wall-clock silence floor both crossed."""
+        return (self.suspected(peer, now_s)
+                and now_s - self._last[peer] >= self.floor_s)
+
+    def warming(self, peer: int, now_s: float) -> bool:
+        """Half-threshold early warning: a simultaneous link cut reaches
+        each peer's clock with skew (heartbeat cadence + delivery
+        jitter), so cohort settling must treat a peer at phi >=
+        threshold/2 as possibly-in-the-same-cohort rather than healthy
+        — acting while one member is mid-window would mis-count the
+        partition's sides."""
+        return self.phi(peer, now_s) >= self.threshold / 2
+
+    def elapsed(self, peer: int, now_s: float) -> float:
+        return now_s - self._last[peer]
+
+
+# ---- quorum decisions --------------------------------------------------
+
+def majority_side(mine, theirs) -> bool:
+    """True when ``mine`` (live ids on THIS side of a partition,
+    including self) may proceed with reassignment against ``theirs``
+    (the dead/suspected side).  Strict majority of the combined live
+    set wins; an exact tie resolves to the side holding the lowest id
+    (both sides compute the same answer from their own view, so exactly
+    one proceeds and the other self-fences)."""
+    mine, theirs = list(mine), list(theirs)
+    total = len(mine) + len(theirs)
+    if 2 * len(mine) > total:
+        return True
+    if 2 * len(mine) == total:
+        return min(mine) < min(theirs)
+    return False
+
+
+def majority_confirms(n_alive: int, n_confirms: int) -> bool:
+    """Epoch-boundary ack lease: an epoch's CL_RSPs may release once
+    ``n_confirms`` members of the ``n_alive`` live server set (self
+    included) have confirmed receiving that epoch's blob."""
+    return n_confirms >= n_alive // 2 + 1
+
+
+# ---- wire codecs -------------------------------------------------------
+
+def encode_heartbeat(map_version: int, blob_seen: int, epoch: int) -> bytes:
+    return _HB.pack(map_version, blob_seen, epoch)
+
+
+def decode_heartbeat(buf: bytes) -> tuple[int, int, int]:
+    """-> (map_version, blob_seen, epoch)."""
+    return _HB.unpack_from(buf)
+
+
+def heartbeat_parts(map_version: int, blob_seen: int, epoch: int) -> list:
+    """HEARTBEAT as sendv parts; concatenated == encode_heartbeat."""
+    return [_HB.pack(map_version, blob_seen, epoch)]
+
+
+def encode_fence_nack(my_version: int, stale_version: int,
+                      epoch: int) -> bytes:
+    return _NACK.pack(my_version, stale_version, epoch)
+
+
+def decode_fence_nack(buf: bytes) -> tuple[int, int, int]:
+    """-> (nacker's map_version, the stale version it saw, epoch)."""
+    return _NACK.unpack_from(buf)
+
+
+def fence_nack_parts(my_version: int, stale_version: int,
+                     epoch: int) -> list:
+    """FENCE_NACK as sendv parts; concatenated == encode_fence_nack."""
+    return [_NACK.pack(my_version, stale_version, epoch)]
+
+
+def encode_heal(epoch: int, map_version: int, owners: np.ndarray) -> bytes:
+    owners = np.ascontiguousarray(owners, np.int32)
+    return _HEAL.pack(epoch, map_version, len(owners)) + owners.tobytes()
+
+
+def decode_heal(buf: bytes) -> tuple[int, int, np.ndarray]:
+    """-> (epoch, map_version, owners int32[S])."""
+    epoch, version, n = _HEAL.unpack_from(buf)
+    owners = np.frombuffer(buf, np.int32, count=n,
+                           offset=_HEAL.size).copy()
+    return epoch, version, owners
+
+
+def heal_parts(epoch: int, map_version: int, owners: np.ndarray) -> list:
+    """HEAL as sendv parts; concatenated == encode_heal."""
+    owners = np.ascontiguousarray(owners, np.int32)
+    return [_HEAL.pack(epoch, map_version, len(owners)), owners]
+
+
+# ---- fence envelope (EPOCH_BLOB / LOG_MSG version stamp) ---------------
+
+def fence_parts(map_version: int) -> bytes:
+    """The 12-byte fence header prepended (as a sendv part) to
+    EPOCH_BLOB and LOG_MSG payloads when fencing is armed."""
+    return _FENCE.pack(_FENCE_MAGIC, map_version)
+
+
+def fence_wrap(payload: bytes, map_version: int) -> bytes:
+    return fence_parts(map_version) + payload
+
+
+def fence_peek(buf: bytes) -> tuple[int, int]:
+    """-> (sender's map_version, payload offset past the header)."""
+    magic, version = _FENCE.unpack_from(buf)
+    if magic != _FENCE_MAGIC:
+        raise ValueError("frame lacks a fence header (fencing armed on "
+                         "one side of a link only?)")
+    return version, _FENCE.size
+
+
+# ---- summary line ------------------------------------------------------
+
+def fencing_line(node: int, fields: dict) -> str:
+    """The per-node `[fencing]` log line (parsed by
+    `harness.parse.parse_fencing`).  Emitted at summary time with
+    ``self_halt=0``, or once by a fenced-out primary just before its
+    exit-18 self-halt (``self_halt=1`` + the reason)."""
+    body = " ".join(f"{k}={v:.2f}" if isinstance(v, float) else f"{k}={v}"
+                    for k, v in fields.items())
+    return f"[fencing] node={node} {body}"

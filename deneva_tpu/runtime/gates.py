@@ -133,16 +133,35 @@ GATES: dict[str, GateSpec] = {s.name: s for s in (
     GateSpec(
         "fault",
         flags=("fault_drop_prob", "fault_dup_prob",
-               "fault_delay_jitter_us", "fault_kill", "recover"),
-        # fault_kill_spec() is a pure parser (None when unarmed): its
-        # RESULT is the guard (`kill = cfg.fault_kill_spec()` then
-        # `if kill is not None:`), calling it is not a use
+               "fault_delay_jitter_us", "fault_kill", "recover",
+               "fault_partition", "fault_peer_stall"),
+        # fault_kill_spec() / fault_partition_spec() /
+        # fault_peer_stall_spec() are pure parsers (None/[] when
+        # unarmed): their RESULTS are the guards (`kill =
+        # cfg.fault_kill_spec()` then `if kill is not None:`), calling
+        # them is not a use
         guards=("faults_enabled", "_fault_mode", "_failover",
                 "_dedup_on", "fault_kill", "recover", "_kill_at",
-                "fault_kill_spec"),
+                "fault_kill_spec", "fault_partition_spec",
+                "fault_peer_stall_spec", "_partitions", "_stall"),
         home=(),
         use_attrs=("_retryq",),
-        use_calls=("set_fault",),
+        use_calls=("set_fault", "set_partition", "set_peer_stall_us"),
+    ),
+    GateSpec(
+        "fencing",
+        # partition & gray-failure tolerance: heartbeat failure
+        # detection, fenced slot ownership, quorum reassignment
+        # (runtime/faildet.py).  fencing_phi/heartbeat_ms/suspect_s are
+        # depth knobs with live defaults (like repair_rounds), not
+        # flags — arming is `fencing` alone.  _fencing is the cached
+        # boolean nodes stamp in __init__; _fd is the detector object
+        # (None until armed) and doubles as its own guard.
+        flags=("fencing",),
+        guards=("fencing", "_fencing", "_fd", "_fence_ver"),
+        home=("deneva_tpu/runtime/faildet.py",),
+        use_attrs=("_fd", "_FD"),
+        requires=("elastic",),
     ),
 )}
 

@@ -197,15 +197,21 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
                                 # failover-with-reassignment: the
                                 # survivors absorb the dead node's slots
                                 # by log replay — never restart it; its
-                                # report slot closes as "killed". Only
-                                # the deliberate fault_kill exit
-                                # (os._exit(17)) is planned; any other
-                                # code is a genuine crash
-                                if p.exitcode != 17:
+                                # report slot closes as "killed".  Two
+                                # planned exits only: the deliberate
+                                # fault_kill sentinel (os._exit(17))
+                                # and the fencing self-halt sentinel
+                                # (os._exit(18) — a minority/fenced-out
+                                # primary retiring itself instead of
+                                # serving split-brain writes, reported
+                                # as "fenced").  Any other code is a
+                                # genuine crash and still fails loudly.
+                                if p.exitcode not in (17, 18):
                                     raise RuntimeError(
                                         f"server {s} crashed (exitcode "
                                         f"{p.exitcode}) in elastic mode")
-                                out[s] = ("killed", "")
+                                out[s] = ("fenced" if p.exitcode == 18
+                                          else "killed", "")
                                 continue
                             rp = ctx.Process(
                                 target=_server_main,

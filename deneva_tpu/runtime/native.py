@@ -52,12 +52,20 @@ RTYPE = {
     # re-NACKed or admitted), so it needs no loss story of its own —
     # and faulting it would only re-test the CL_QRY_BATCH path.
     "ADMIT_NACK": 21,
+    # partition & gray-failure tolerance (runtime/faildet.py): per-link
+    # liveness + ack-lease grants, stale-incarnation rejection, and
+    # post-partition map catch-up.  Deliberately OUTSIDE FAULT_RTYPE_MASK
+    # like every control-plane rtype since 15: a heartbeat is re-sent on
+    # its cadence, a FENCE_NACK is re-triggered by the next stale frame,
+    # and HEAL rides the heal transition — their fault mode is the
+    # partition itself, never silent single-frame loss.
+    "HEARTBEAT": 22, "FENCE_NACK": 23, "HEAL": 24,
 }
 RTYPE_NAME = {v: k for k, v in RTYPE.items()}
 
 STAT_NAMES = ("msg_sent", "msg_rcvd", "bytes_sent", "bytes_rcvd",
               "batches_sent", "send_queue_depth", "recv_queue_depth",
-              "msg_dropped", "msg_dup", "reconnects")
+              "msg_dropped", "msg_dup", "reconnects", "msg_blackholed")
 
 # Fault-eligible message classes (chaos harness): only the client<->server
 # open-loop traffic may be dropped/duplicated/jittered — it has an
@@ -117,6 +125,12 @@ def _load() -> C.CDLL:
             lib.dt_set_delay_us.argtypes = [C.c_void_p, C.c_uint64]
             lib.dt_set_peer_delay_us.restype = C.c_int
             lib.dt_set_peer_delay_us.argtypes = [C.c_void_p, C.c_uint32,
+                                                 C.c_uint64]
+            lib.dt_set_partition.restype = C.c_int
+            lib.dt_set_partition.argtypes = [C.c_void_p, C.c_uint32,
+                                             C.c_uint32]
+            lib.dt_set_peer_stall_us.restype = C.c_int
+            lib.dt_set_peer_stall_us.argtypes = [C.c_void_p, C.c_uint32,
                                                  C.c_uint64]
             lib.dt_set_fault.restype = C.c_int
             lib.dt_set_fault.argtypes = [C.c_void_p, C.c_uint32,
@@ -293,6 +307,27 @@ class NativeTransport:
         region distance matrix)."""
         if self._lib.dt_set_peer_delay_us(self._h, peer, int(us)) != 0:
             raise RuntimeError(f"set_peer_delay_us({peer}) failed")
+
+    # partition blackhole directions (native dt_part_mode)
+    PART_NONE = 0
+    PART_TX = 1
+    PART_RX = 2
+
+    def set_partition(self, peer: int, mode: int) -> None:
+        """Per-link partition blackhole (chaos partition scenarios):
+        PART_TX discards frames we send to ``peer``, PART_RX frames
+        arriving from it — every rtype, but the sockets stay open so
+        ``peer_alive`` keeps reporting True (the gray failure only the
+        fencing layer's suspicion score can see).  0 heals the link."""
+        if self._lib.dt_set_partition(self._h, peer, int(mode)) != 0:
+            raise RuntimeError(f"set_partition({peer}) failed")
+
+    def set_peer_stall_us(self, peer: int, us: int) -> None:
+        """Gray-slow peer: extra per-link outbound stall, additive with
+        the global/WAN delays (a fault knob, kept separate from the geo
+        topology profile so scenarios compose)."""
+        if self._lib.dt_set_peer_stall_us(self._h, peer, int(us)) != 0:
+            raise RuntimeError(f"set_peer_stall_us({peer}) failed")
 
     def set_fault(self, drop_prob: float = 0.0, dup_prob: float = 0.0,
                   jitter_us: float = 0.0, seed: int = 0,

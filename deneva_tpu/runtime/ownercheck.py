@@ -87,6 +87,20 @@ OWNER: dict[str, str] = {
     # retire worker PREFETCH returns the plane; _retire consumes it)
     "_repair": DISPATCH, "_rep_salvaged": DISPATCH,
     "_rep_meas": DISPATCH, "_rep_span": DISPATCH,
+    # fencing layer (runtime/faildet.py): detector, heartbeat ledgers
+    # and fence counters all live on the dispatch thread (_route runs
+    # there; workers only READ smap/_FD for the envelope header)
+    "_fencing": DISPATCH, "_fd": DISPATCH, "_FD": DISPATCH,
+    "_hb_next_s": DISPATCH, "_epoch_cur": DISPATCH,
+    "_blob_seen_from": DISPATCH, "_hb_peer_seen": DISPATCH,
+    "_fence_nacks": DISPATCH, "_fence_nack_rx": DISPATCH,
+    "_fence_last_ack": DISPATCH, "_fence_reassign_epoch": DISPATCH,
+    "_fence_spans": DISPATCH,
+    # partition/stall fault surface (wall-clock ticks at dispatch-loop
+    # positions only)
+    "_partitions": DISPATCH, "_part_links": DISPATCH,
+    "_part_on": DISPATCH, "_stall": DISPATCH, "_stall_on": DISPATCH,
+    "_t_run0": DISPATCH,
     # elastic membership control plane (cutovers at group boundaries,
     # always applied on the dispatch thread)
     "smap": DISPATCH, "_mig_pending": DISPATCH, "_mig_rows": DISPATCH,
@@ -134,7 +148,7 @@ GUARDED = (
     "_committed_set", "_committed_recent", "_held_rsp", "_held_commit",
     "_feed_free", "_mig_rows", "_reassigned", "_rejoin_pending",
     "_contrib_gone", "repl_acked", "repl_applied", "_quorum_hold_t",
-    "_geo_spans",
+    "_geo_spans", "_blob_seen_from", "_hb_peer_seen", "_fence_spans",
 )
 
 

@@ -50,6 +50,15 @@ class ReplicaNode:
         self.follower = None
         self._kill_at = None
         self.region = 0
+        # fencing (runtime/faildet.py): LOG_MSG arrives wrapped in a
+        # fence envelope carrying the primary's map version; the replica
+        # strips it before appending (its log must stay a byte prefix
+        # of the primary's) and rejects a REGRESSED version with
+        # FENCE_NACK — a fenced-out primary must not extend the
+        # durability stream its successor already owns
+        self._fencing = cfg.fencing
+        self._fence_ver = -1
+        self._fence_nacks = 0
         if self._geo:
             self.region = georepl.region_of(cfg, self.me)
             kill = cfg.fault_kill_spec()
@@ -85,6 +94,17 @@ class ReplicaNode:
 
     def _handle(self, src: int, rtype: str, payload: bytes) -> None:
         if rtype == "LOG_MSG":
+            if self._fencing:
+                from deneva_tpu.runtime import faildet
+                ver, off = faildet.fence_peek(payload)
+                if ver < self._fence_ver:
+                    self._fence_nacks += 1
+                    self.tp.send(src, "FENCE_NACK",
+                                 faildet.encode_fence_nack(
+                                     self._fence_ver, ver, -1))
+                    return
+                self._fence_ver = ver
+                payload = payload[off:]
             _, epoch = _EPOCH_HDR.unpack_from(payload)
             if self._kill_at is not None and epoch >= self._kill_at:
                 # region loss: die BEFORE appending the boundary record,
@@ -185,6 +205,8 @@ class ReplicaNode:
             self.stats.set("follower_read_cnt", float(f.rows_served))
             self.stats.set("stale_read_max_epochs", float(f.stale_max))
             self.stats.set("geo_region", float(self.region))
+        if self._fencing:
+            self.stats.set("fence_nack_cnt", float(self._fence_nacks))
         self._f.close()
         self.stats.set("total_runtime", time.monotonic() - t0)
         return self.stats

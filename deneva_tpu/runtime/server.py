@@ -555,6 +555,63 @@ class ServerNode:
         self._quorum_release_cnt = 0
         self._geo_spans = {"quorum": 0.0, "promote": 0.0}
 
+        # ---- partition & gray-failure tolerance (fencing layer;
+        # runtime/faildet.py — all off on a default config: no
+        # heartbeat is ever sent, no frame grows a fence envelope, and
+        # every wire/log byte is bit-identical to pre-fencing) ----
+        self._fencing = cfg.fencing
+        self._fd = None                 # detector; built AFTER the
+        #                                 barrier (jit compile time must
+        #                                 not read as peer silence)
+        self._FD = None
+        if self._fencing:
+            from deneva_tpu.runtime import faildet as _FD
+            self._FD = _FD
+            self._hb_next_s = 0.0
+            self._epoch_cur = 0
+            # per-peer: highest epoch whose EPOCH_BLOB we received from
+            # them (our lease grant, shipped in heartbeats) and the
+            # highest of OUR epochs they confirmed (their grant to us —
+            # the ack-lease quorum input)
+            self._blob_seen_from = {p: -1 for p in range(self.n_srv)
+                                    if p != self.me}
+            self._hb_peer_seen = {p: -1 for p in range(self.n_srv)
+                                  if p != self.me}
+            self._fence_nacks = 0       # FENCE_NACKs sent
+            self._fence_nack_rx = 0     # FENCE_NACKs received
+            self._fence_last_ack = -1   # highest epoch whose CL_RSPs
+            #                             released (single-writer oracle)
+            self._fence_reassign_epoch = -1
+            self._fence_spans = {"suspect": 0.0, "heal": 0.0,
+                                 "fence": 0.0}
+        # partition/stall fault surface (native per-link blackholes +
+        # gray-slow stalls; armed by cfg.fault_partition /
+        # cfg.fault_peer_stall alone — they model the network, with or
+        # without the fencing layer watching it)
+        self._partitions = None
+        self._part_links: list[tuple[int, float]] = []
+        self._part_on: list[bool] = []
+        self._stall = None
+        self._stall_on = False
+        self._t_run0 = 0.0
+        if cfg.fault_partition:
+            self._partitions = cfg.fault_partition_spec()
+            # my TX-side links: each sender silences its own outbound at
+            # its own loop positions, so the first silenced epoch is
+            # group-aligned and identical on every receiver
+            starts: dict[int, float] = {}
+            for a, b, bidir, start in self._partitions:
+                if a == self.me:
+                    starts[b] = min(starts.get(b, start), start)
+                elif bidir and b == self.me:
+                    starts[a] = min(starts.get(a, start), start)
+            self._part_links = sorted(starts.items())
+            self._part_on = [False] * len(self._part_links)
+        if cfg.fault_peer_stall:
+            spec = cfg.fault_peer_stall_spec()
+            if spec is not None and spec[0] == self.me:
+                self._stall = spec
+
         # ---- overload tier: per-tenant admission control ahead of
         # epoch-batch formation (runtime/admission.py — off on a default
         # config: no controller exists and _route admits every decoded
@@ -780,23 +837,34 @@ class ServerNode:
         if not self.repl_ids:
             return
         t0 = time.monotonic()
-        while self._rejoin_pending and time.monotonic() - t0 < 30.0:
+        # cfg.failover_timeout_s, not a hidden 30 s wall: slow CI boxes
+        # raise the whole failover-wait family with one knob
+        while self._rejoin_pending \
+                and time.monotonic() - t0 < self.cfg.failover_timeout_s:
             self._drain(timeout_us=20_000)
         if self._rejoin_pending:
             raise RuntimeError(
                 f"server {self.me}: replicas {sorted(self._rejoin_pending)}"
-                " never answered the rejoin handshake")
+                " never answered the rejoin handshake within "
+                f"failover_timeout_s={self.cfg.failover_timeout_s:g}")
         with open(self.log_path, "rb") as f:
             buf = f.read()
         for r in self.repl_ids:
             acked = self.repl_acked[r]
             for e, lo, hi in iter_record_spans(buf):
                 if acked < e < self._resume_epoch:
-                    self.tp.send(r, "LOG_MSG", buf[lo:hi])
+                    self._fenced_send(r, "LOG_MSG", buf[lo:hi])
         self.tp.flush()
 
     # -- message routing (reference InputThread::server_recv_loop) ------
     def _route(self, src: int, rtype: str, payload: bytes) -> None:
+        if self._fd is not None and src < self.n_srv and src != self.me:
+            # ANY frame from a server peer is a heartbeat observation
+            # (the epoch exchange piggybacks); a suspected→fresh
+            # transition is a partition HEAL — catch the peer up
+            gap = self._fd.observe(src, time.monotonic())
+            if gap is not None and src not in self._reassigned:
+                self._heal_peer(src, gap)
         if rtype == "CL_QRY_BATCH":
             if (self._elastic and self._dedup_on
                     and len(self.smap.slots_of(self.me)) == 0):
@@ -828,6 +896,27 @@ class ServerNode:
                     return
             self.pending.append((src, blk))
         elif rtype == "EPOCH_BLOB":
+            if self._fencing:
+                # fence envelope: the sender's map_version precedes the
+                # blob.  Reject a RETIRED peer's stale incarnation with
+                # FENCE_NACK (a live survivor briefly one deterministic
+                # reassignment behind is NOT stale — pipeline skew);
+                # versions ahead of ours buffer as usual (we will apply
+                # the same cutover at the same boundary).
+                ver, off = self._FD.fence_peek(payload)
+                if ver < self.smap.version and src in self._reassigned:
+                    self._fence_nacks += 1
+                    self._fence_spans["fence"] += 1e-3
+                    self.tp.send(src, "FENCE_NACK",
+                                 self._FD.encode_fence_nack(
+                                     self.smap.version, ver,
+                                     self._epoch_cur))
+                    return
+                payload = payload[off:]
+                if src < self.n_srv:
+                    e0 = wire.peek_blob_epoch(payload)
+                    if e0 > self._blob_seen_from.get(src, -1):
+                        self._blob_seen_from[src] = e0
             if self._overlap:
                 # keep the raw payload: collect decodes it STRAIGHT into
                 # the stacked feed slice (decode_epoch_blob_into) instead
@@ -869,14 +958,22 @@ class ServerNode:
             # and (coordinator only) re-announce the measure/stop epochs
             # its restart lost
             e = wire.decode_shutdown(payload)
-            for ep, blobs in self.blob_buf.items():
-                if ep >= e:
-                    blobs.pop(src, None)
+            if not self._fencing:
+                # crash-recovery rejoin only: with fencing armed a
+                # server REJOIN is a partition HEAL from a live peer
+                # that never died (fenced nodes exit 18 and stay down)
+                # — its buffered blobs are valid and must survive
+                for ep, blobs in self.blob_buf.items():
+                    if ep >= e:
+                        blobs.pop(src, None)
             with self._sent_lock:
                 retained = list(self._sent_blobs)
             for ep, blob in retained:
                 if ep >= e:
-                    self.tp.send(src, "EPOCH_BLOB", blob)
+                    # fencing: re-wrapped at the CURRENT map version (a
+                    # retained blob predating a reassignment must not
+                    # read as a stale incarnation's frame)
+                    self._fenced_send(src, "EPOCH_BLOB", blob)
             # ANY surviving peer echoes the coordinator's announcements
             # (identical values everywhere, so duplicates are no-ops):
             # a restarted node — including a restarted coordinator —
@@ -900,6 +997,38 @@ class ServerNode:
             self._mig_rows.setdefault(v, {})[src] = payload
         elif rtype == "MAP_UPDATE":
             pass  # client-facing; a server learns maps via MIGRATE_BEGIN
+        elif rtype == "HEARTBEAT":
+            # liveness + ack-lease grant: the sender's map version and
+            # the highest of OUR epochs whose blob it has received
+            ver, seen, _ep = self._FD.decode_heartbeat(payload)
+            if src < self.n_srv:
+                if seen > self._hb_peer_seen.get(src, -1):
+                    self._hb_peer_seen[src] = seen
+                if ver < self.smap.version and src in self._reassigned:
+                    # a retired incarnation is still beating: fence it
+                    self._fence_nacks += 1
+                    self._fence_spans["fence"] += 1e-3
+                    self.tp.send(src, "FENCE_NACK",
+                                 self._FD.encode_fence_nack(
+                                     self.smap.version, ver,
+                                     self._epoch_cur))
+        elif rtype == "FENCE_NACK":
+            # a peer running a NEWER map incarnation rejected our frame:
+            # we were fenced out while partitioned — self-halt rather
+            # than serve split-brain writes.  (A nack echoing our own
+            # version is a stale crossing; ignore.)
+            their_ver, _stale, ep = self._FD.decode_fence_nack(payload)
+            self._fence_nack_rx += 1
+            if their_ver > self.smap.version and self._mig_pending is None:
+                self._self_fence("fence_nack", ep)
+        elif rtype == "HEAL":
+            # post-partition map catch-up: if the healed majority's map
+            # no longer includes us, we were fenced out; otherwise both
+            # sides already agree (the REJOIN resend covers the blobs)
+            ep, ver, owners = self._FD.decode_heal(payload)
+            if ver > self.smap.version and self._mig_pending is None \
+                    and self.me not in owners:
+                self._self_fence("healed_out", ep)
         elif rtype == "INIT_DONE":
             pass  # late barrier duplicate; the barrier itself already ran
 
@@ -991,6 +1120,133 @@ class ServerNode:
                 self._committed_recent.append(p)
         while len(self._committed_recent) > self._committed_cap:
             self._committed_set.discard(self._committed_recent.popleft())
+
+    # -- partition & gray-failure tolerance (fencing layer) --------------
+    def _fault_net_tick(self) -> None:
+        """Apply/lift this node's share of the armed partition/stall
+        faults by wall clock.  TX-side only: each sender blackholes its
+        own outbound at its own loop positions (group boundaries and
+        blob-wait polls), so the first silenced epoch is group-aligned
+        and identical on every receiver — which is what lets every
+        survivor derive the same reassignment with no negotiation."""
+        t = time.monotonic() - self._t_run0
+        if self._partitions is not None:
+            flap = self.cfg.fault_partition_flap_s
+            for i, (peer, start) in enumerate(self._part_links):
+                if t < start:
+                    want = False
+                elif flap > 0:
+                    want = int((t - start) // flap) % 2 == 0
+                else:
+                    want = True
+                if want != self._part_on[i]:
+                    self._part_on[i] = want
+                    self.tp.set_partition(
+                        peer, self.tp.PART_TX if want
+                        else self.tp.PART_NONE)
+        if self._stall is not None and not self._stall_on:
+            _node, ms, start = self._stall
+            if t >= start:
+                # gray-slow: EVERY outbound link stalls (a slow process
+                # is slow to everyone); sockets stay open, peer_alive
+                # stays true — only the suspicion score sees it
+                self._stall_on = True
+                for p in range(self.n_srv + self.n_cl + self.n_repl):
+                    if p != self.me:
+                        self.tp.set_peer_stall_us(p, int(ms * 1000))
+
+    def _fenced_send(self, dest: int, rtype: str, payload) -> None:
+        """Single-payload send that grows the 12-byte fence envelope
+        (sender's map version) when fencing is armed — THE one place
+        the wrap-or-not decision lives for EPOCH_BLOB/LOG_MSG bodies
+        (the zero-copy parts broadcast prepends ``fence_parts`` to its
+        parts list instead).  ``payload`` may be bytes or a C-contiguous
+        array (``sendv`` frames either)."""
+        if self._fencing:
+            self.tp.sendv(dest, rtype,
+                          [self._FD.fence_parts(self.smap.version),
+                           payload])
+        else:
+            self.tp.send(dest, rtype, payload)
+
+    def _maybe_heartbeat(self, now_s: float) -> None:
+        """Standalone HEARTBEAT on its cadence to every live server
+        peer.  The payload is per-link: our map version plus the
+        highest epoch whose blob we received from THAT peer (our
+        ack-lease grant to it)."""
+        if now_s < self._hb_next_s:
+            return
+        self._hb_next_s = now_s + self.cfg.fencing_heartbeat_ms / 1e3
+        for p in range(self.n_srv):
+            if p != self.me and p not in self._reassigned:
+                self.tp.send(p, "HEARTBEAT", self._FD.encode_heartbeat(
+                    self.smap.version, self._blob_seen_from.get(p, -1),
+                    self._epoch_cur))
+
+    def _heal_peer(self, p: int, gap_s: float) -> None:
+        """Suspected→fresh transition: partition heal.  Catch-up rides
+        the existing REJOIN path — the peer resends its retained blobs
+        from our first-missing epoch (and re-echoes measure/stop) — and
+        a HEAL frame carries our map so a behind peer learns it was (or
+        was not) fenced out.  Never a dual-map merge."""
+        self._fence_spans["heal"] += gap_s * 1e3
+        self.tp.send(p, "REJOIN", wire.encode_shutdown(
+            self._blob_seen_from.get(p, -1) + 1))
+        self.tp.send(p, "HEAL", self._FD.encode_heal(
+            self._epoch_cur, self.smap.version, self.smap.owners))
+        self.tp.flush()
+
+    def _fence_ack_ok(self, epoch: int) -> bool:
+        """The epoch-boundary ack lease: an epoch's CL_RSPs (and its
+        committed-id re-ack authority) may release only once a MAJORITY
+        of the live server set — self included — has confirmed
+        receiving that epoch's blob (heartbeat ``blob_seen``).  A
+        partitioned primary's acks for epochs the surviving side never
+        saw are thereby causally impossible, not merely unlikely."""
+        if not self._fencing:
+            return True
+        alive = [p for p in range(self.n_srv)
+                 if p not in self._reassigned]
+        have = 1 + sum(1 for p in alive if p != self.me
+                       and self._hb_peer_seen.get(p, -1) >= epoch)
+        return self._FD.majority_confirms(len(alive), have)
+
+    def _fence_fields(self, self_halt: int, reason: str = "",
+                      epoch: int = -1) -> dict:
+        d = {"phi_peak": (self._fd.phi_peak if self._fd else 0.0),
+             "suspect_cnt": (self._fd.suspect_cnt if self._fd else 0),
+             "fence_nack_cnt": self._fence_nacks,
+             "fence_nack_rx": self._fence_nack_rx,
+             "self_halt": self_halt,
+             "heal_cnt": (self._fd.heal_cnt if self._fd else 0),
+             "reassign_epoch": self._fence_reassign_epoch,
+             "last_acked_epoch": self._fence_last_ack}
+        if reason:
+            d["reason"] = reason
+        if epoch >= 0:
+            d["epoch"] = epoch
+        return d
+
+    def _self_fence(self, reason: str, epoch: int) -> None:
+        """Fenced out (newer map incarnation exists, or we are the
+        minority side of a partition): emit the [fencing] line and the
+        sidecar the harness audits, drain the log, and self-halt with
+        the exit-18 sentinel — the launcher retires it as a scenario
+        outcome; serving even one more write would be split-brain."""
+        import json
+
+        print(self._FD.fencing_line(
+            self.me, self._fence_fields(1, reason, epoch)), flush=True)
+        if self.logger is not None and epoch > 0:
+            self.logger.wait_flushed(epoch - 1, timeout=5.0)
+        with open(os.path.join(self.cfg.log_dir,
+                               f"node{self.me}.fenced.json"), "w") as f:
+            json.dump({"node": self.me, "reason": reason,
+                       "epoch": int(epoch),
+                       "map_version": int(self.smap.version),
+                       "last_acked_epoch": int(self._fence_last_ack)}, f)
+        self.tp.flush()
+        os._exit(self._FD.FENCED_EXIT)
 
     # -- admission (client_thread + new_txn_queue + abort_queue) ---------
     def _contribution(self, epoch: int
@@ -1180,13 +1436,18 @@ class ServerNode:
         if self._failover:
             blob = wire.encode_epoch_blob(e, block, birth_ts)
             with self._sent_lock:
+                # retained RAW: a REJOIN resend re-wraps with the then-
+                # current version (a retained pre-reassignment stamp
+                # must not read as a stale incarnation)
                 self._sent_blobs.append((e, blob))
             for p in range(self.n_srv):
                 if p != self.me:
-                    self.tp.send(p, "EPOCH_BLOB", blob)
+                    self._fenced_send(p, "EPOCH_BLOB", blob)
             return
         parts = wire.epoch_blob_parts(e, birth_ts, block.tags, block.keys,
                                       block.types, block.scalars)
+        if self._fencing:
+            parts = [self._FD.fence_parts(self.smap.version)] + parts
         self.tp.sendv_many([p for p in range(self.n_srv) if p != self.me],
                            "EPOCH_BLOB", parts)
 
@@ -1244,7 +1505,10 @@ class ServerNode:
                                        fs["scal"][i], fs["active"][i])
             self.logger.append(e, b"", fs["active"][i], framed=framed)
             for r in self.repl_ids:
-                self.tp.send(r, "LOG_MSG", framed)
+                # fence envelope rides the durability stream too: the
+                # replica strips it before appending, so its log stays
+                # a byte prefix of ours
+                self._fenced_send(r, "LOG_MSG", framed)
 
     def _prefetch_retire(self, group: dict):
         """Retire-worker body: wait out the verdict d2h copy, unpack the
@@ -1319,10 +1583,18 @@ class ServerNode:
             # nothing held (e.g. a geo server whose region admits no
             # clients) it would just burn the 10 s budget
             t0 = time.monotonic()
-            while self._durable_ack_epoch() < wait_epoch \
+            while (self._durable_ack_epoch() < wait_epoch
+                   or (self._fencing
+                       and not self._fence_ack_ok(wait_epoch))) \
                     and time.monotonic() - t0 < 10.0:
                 self.logger.wait_flushed(wait_epoch, timeout=0.05)
-                if self.n_repl:
+                if self._fencing:
+                    # the lease needs live heartbeat confirmations of
+                    # the final epochs' blobs — keep beating + draining
+                    # through the shutdown flush
+                    self._maybe_heartbeat(time.monotonic())
+                    self._drain(timeout_us=10_000)
+                elif self.n_repl:
                     self._drain(timeout_us=10_000)
         durable = self._durable_ack_epoch()
         if self._geo and self._quorum_hold_t:
@@ -1342,9 +1614,22 @@ class ServerNode:
                 self._geo_spans["quorum"] += max(lags) * 1e3
         if self._full_planes:
             while self._held_commit and self._held_commit[0][0] <= durable:
+                if self._fencing \
+                        and not self._fence_ack_ok(self._held_commit[0][0]):
+                    break   # re-ack authority waits for the same lease
                 _, ids = self._held_commit.popleft()
                 self._retire_dedup(ids)
         while self._held_rsp and self._held_rsp[0][1] <= durable:
+            if self._fencing:
+                # epoch-boundary ack lease: durable is not enough — a
+                # majority must have CONFIRMED this epoch's blob, or a
+                # partitioned primary could ack writes the surviving
+                # side never saw (the split-brain this layer closes)
+                e = self._held_rsp[0][1]
+                if not self._fence_ack_ok(e):
+                    break
+                if e > self._fence_last_ack:
+                    self._fence_last_ack = e
             c, _, tags = self._held_rsp.popleft()
             if self._dedup_on:
                 # the ack is now safe to (re-)issue: only here do the
@@ -1474,6 +1759,13 @@ class ServerNode:
         timeout = (self.cfg.fault_recovery_timeout_s if self._failover
                    else 60.0)
         while True:
+            if self._partitions is not None or self._stall is not None:
+                # a symmetric partition stalls BOTH sides right here, so
+                # wall-clock fault changes (flap lift/re-apply) must
+                # tick inside the wait, not only at loop tops
+                self._fault_net_tick()
+            if self._fencing:
+                self._maybe_heartbeat(time.monotonic())
             have = self.blob_buf.get(epoch, {})
             missing = [p for p in self._exp_peers(epoch) if p not in have]
             if not missing:
@@ -1495,7 +1787,54 @@ class ServerNode:
                 self._drain(timeout_us=50_000)
                 have = self.blob_buf.get(epoch, {})
                 dead = [p for p in dead if p not in have]
-            if dead and self._elastic and self._failover:
+            if self._fencing and self._failover:
+                # partition & gray-failure handling: socket death stays
+                # the fast path, suspicion (phi threshold + wall-clock
+                # silence floor) catches peers whose sockets never
+                # closed.  Only the side holding a MAJORITY of the live
+                # set may retire peers (ties resolve to the side with
+                # the lowest live id); the minority self-fences instead
+                # of installing a second map — split-brain-free by
+                # construction.
+                now = time.monotonic()
+                susp = sorted(set(dead)
+                              | {p for p in missing
+                                 if self._fd.fence_ready(p, now)})
+                # cohort settling: suspicions mature one peer at a time
+                # (per-peer last-frame clocks skew by up to a heartbeat
+                # interval), and acting on the first while a second is
+                # mid-window would mis-read a 1-vs-2 partition as 2-vs-1
+                # — a minority node would reassign a majority peer
+                # before discovering it is the minority.  Hold until
+                # every missing peer is either demonstrably fresh
+                # (below the half-threshold warning) or fence-ready;
+                # silence only ever promotes, so the hold is bounded by
+                # the suspect floor.
+                pending = [p for p in missing if p not in susp
+                           and self._fd.warming(p, now)]
+                if susp and not pending:
+                    alive = [p for p in range(self.n_srv)
+                             if p not in self._reassigned]
+                    mine = [p for p in alive if p not in susp]
+                    if not self._FD.majority_side(mine, susp):
+                        self._self_fence("minority", epoch)
+                    if self._fence_reassign_epoch < 0:
+                        self._fence_reassign_epoch = epoch
+                    for p in susp:
+                        self._fence_spans["suspect"] += \
+                            self._fd.elapsed(p, now) * 1e3
+                        # targeted fence: reachable-but-partitioned
+                        # peers (one-way links, gray-slow) halt on this
+                        # instead of waiting to observe the new map
+                        self._fence_nacks += 1
+                        self.tp.send(p, "FENCE_NACK",
+                                     self._FD.encode_fence_nack(
+                                         self.smap.version + 1,
+                                         self.smap.version, epoch))
+                        self._elastic_reassign(p, epoch)
+                    self.tp.flush()
+                    continue
+            elif dead and self._elastic and self._failover:
                 # failover-with-reassignment: the kill path flushes its
                 # transport at the boundary, so every survivor stalls at
                 # the SAME first-missing epoch and derives the same new
@@ -1617,10 +1956,11 @@ class ServerNode:
             if donor in buf:
                 return buf.pop(donor)
             self._drain(timeout_us=10_000)
-            if time.monotonic() - t0 > 60.0:
+            if time.monotonic() - t0 > self.cfg.failover_timeout_s:
                 raise TimeoutError(
                     f"server {self.me}: MIGRATE_ROWS v{version} from "
-                    f"donor {donor} never arrived")
+                    f"donor {donor} never arrived within "
+                    f"failover_timeout_s={self.cfg.failover_timeout_s:g}")
 
     def _scatter_rows(self, kj, get_col) -> None:
         """Scatter per-column values into the local full-residency
@@ -1710,7 +2050,8 @@ class ServerNode:
         for g in getattr(self, "_inflight", ()):
             for f in g.get("wire_futs", ()):
                 f.result()
-        self.logger.wait_flushed(stop_epoch - 1, timeout=30.0)
+        self.logger.wait_flushed(stop_epoch - 1,
+                                 timeout=self.cfg.failover_timeout_s)
         step = make_dist_step(self.cfg, self.wl, self.be)
         db0 = self.wl.load()
         owners = np.full(self.smap.n_slots, -1, np.int32)
@@ -1965,6 +2306,13 @@ class ServerNode:
             self._announce_rejoin()
         else:
             self.barrier()
+        if self._fencing:
+            # the detector baselines NOW, not at __init__: jit compile
+            # + barrier time must not read as peer silence
+            self._fd = self._FD.FailureDetector(
+                cfg, [p for p in range(self.n_srv) if p != self.me],
+                time.monotonic())
+        self._t_run0 = time.monotonic()
         t_start = time.monotonic()
         prog_next = t_start + cfg.prog_timer_secs
         warm_edge = t_start + cfg.warmup_secs
@@ -2001,6 +2349,11 @@ class ServerNode:
                     # is clean at this group boundary
                     self.tp.flush()
                 os._exit(17)
+            if self._partitions is not None or self._stall is not None:
+                self._fault_net_tick()
+            if self._fencing:
+                self._epoch_cur = epoch0
+                self._maybe_heartbeat(time.monotonic())
             self._drain()
             now = time.monotonic()
             # epoch-aligned measurement window: server 0 announces a
@@ -2040,11 +2393,13 @@ class ServerNode:
                 blob = wire.encode_epoch_blob(e, block, birth_ts)
                 if self._failover:
                     # retained for verbatim resend to a rejoining peer
+                    # (raw: a fencing REJOIN resend re-wraps with the
+                    # then-current map version)
                     with self._sent_lock:
                         self._sent_blobs.append((e, blob))
                 for p in range(self.n_srv):
                     if p != self.me:
-                        self.tp.send(p, "EPOCH_BLOB", blob)
+                        self._fenced_send(p, "EPOCH_BLOB", blob)
 
             fs = None
             wire_futs: list = []
@@ -2173,7 +2528,7 @@ class ServerNode:
                         self.logger.append(e, rec, active_np[i],
                                            framed=framed)
                         for r in self.repl_ids:
-                            self.tp.send(r, "LOG_MSG", framed)
+                            self._fenced_send(r, "LOG_MSG", framed)
             # ---- dispatch (async for merged mode; the masks are fetched
             # at retirement, K groups later) ----------------------------
             t_step = time.monotonic()
@@ -2286,6 +2641,16 @@ class ServerNode:
                     # main track like adm_wait
                     tl.spans.append(("repair", self._rep_span))
                     self._rep_span = 0.0
+                if self._fencing:
+                    # fencing spans (suspicion windows, heal gaps, fence
+                    # rejections): latency ledgers like the geo spans —
+                    # the chrome-trace export lays them on a separate
+                    # per-node "fencing" track (harness/timeline.py)
+                    for name in ("suspect", "heal", "fence"):
+                        ms = self._fence_spans[name]
+                        if ms:
+                            self._fence_spans[name] = 0.0
+                            tl.spans.append((name, ms / 1e3))
                 if self._geo:
                     # replication spans (quorum wait, failover promote):
                     # latency ledgers, not thread-time slices — the
@@ -2410,6 +2775,33 @@ class ServerNode:
             self.adm.summary_into(st)
             for line in self.adm.admission_lines(self.me):
                 print(line, flush=True)
+        if self._fencing:
+            # fencing counters ([summary]) + the [fencing] line (parsed
+            # by harness.parse.parse_fencing) + the sidecar the chaos
+            # harness audits (digest-vs-independent-replay under the
+            # FINAL map, single-writer last-acked-epoch bound)
+            import json
+
+            from deneva_tpu.runtime.logger import state_digest
+            print(self._FD.fencing_line(self.me, self._fence_fields(0)),
+                  flush=True)
+            st.set("fence_nack_cnt", float(self._fence_nacks))
+            st.set("fence_nack_rx_cnt", float(self._fence_nack_rx))
+            st.set("suspect_cnt", float(self._fd.suspect_cnt))
+            st.set("heal_cnt", float(self._fd.heal_cnt))
+            st.set("phi_peak", self._fd.phi_peak)
+            st.set("fence_reassign_epoch",
+                   float(self._fence_reassign_epoch))
+            with open(os.path.join(cfg.log_dir,
+                                   f"node{self.me}.fencing.json"),
+                      "w") as f:
+                json.dump({
+                    "node": self.me, "epochs_run": int(epochs_run),
+                    "map_version": int(self.smap.version),
+                    "owners": [int(x) for x in self.smap.owners],
+                    "reassign_epoch": int(self._fence_reassign_epoch),
+                    "state_digest": state_digest(self.db),
+                    "last_acked_epoch": int(self._fence_last_ack)}, f)
         if self._elastic:
             # membership counters ([summary] satellite): how much the
             # control plane moved and what the cutovers cost
@@ -2422,7 +2814,8 @@ class ServerNode:
             st.set("cutover_stall_ms", self._cutover_stall_ms)
             st.set("redirect_nack_cnt", float(self._redirects))
         for k, v in self.tp.stats().items():
-            if not chaos and k in ("msg_dropped", "msg_dup", "reconnects"):
+            if not chaos and k in ("msg_dropped", "msg_dup", "reconnects",
+                                   "msg_blackholed"):
                 continue   # keep the default-config summary line as-is
             st.set(f"net_{k}", float(v))
         return st

@@ -68,7 +68,15 @@ enum dt_stat {
   DT_STAT_MSG_DROPPED = 7,   /* fault injection: frames dropped at send */
   DT_STAT_MSG_DUP = 8,       /* fault injection: frames duplicated */
   DT_STAT_RECONNECTS = 9,    /* links re-established after a peer restart */
-  DT_STAT_COUNT = 10
+  DT_STAT_MSG_BLACKHOLED = 10, /* partition injection: frames blackholed */
+  DT_STAT_COUNT = 11
+};
+
+/* Per-link partition blackhole directions (dt_set_partition). */
+enum dt_part_mode {
+  DT_PART_NONE = 0,
+  DT_PART_TX = 1,   /* frames WE send to the peer vanish */
+  DT_PART_RX = 2,   /* frames the peer sends US vanish on arrival */
 };
 
 /* endpoints: n_nodes lines "node_id proto addr", e.g.
@@ -124,6 +132,27 @@ void dt_set_delay_us(dt_transport *t, uint64_t delay_us);
  * 0, -1 on a bad peer id. */
 int dt_set_peer_delay_us(dt_transport *t, uint32_t peer,
                          uint64_t delay_us);
+
+/* Per-link partition blackhole (chaos harness, partition scenarios):
+ * mode is a dt_part_mode bitmask.  DT_PART_TX discards frames enqueued
+ * toward the peer; DT_PART_RX discards frames arriving from it (both
+ * counted as DT_STAT_MSG_BLACKHOLED).  Unlike dt_set_fault this hits
+ * EVERY rtype — a partition takes the whole link — but the sockets
+ * stay open, so dt_peer_alive keeps reporting 1: exactly the gray
+ * failure the transport-level flag cannot see (the failure detector
+ * in runtime/faildet.py is what notices).  Loopback frames are exempt.
+ * May be called before or after dt_start; 0 restores the link.
+ * Returns 0, -1 on a bad peer id. */
+int dt_set_partition(dt_transport *t, uint32_t peer, uint32_t mode);
+
+/* Gray-slow peer (chaos harness): hold frames to `peer` for an extra
+ * stall_us before they hit the wire, on top of dt_set_delay_us /
+ * dt_set_peer_delay_us.  A separate knob from the geo WAN profile so a
+ * scenario can model "this process went slow" without disturbing the
+ * configured topology delays.  0 (default) disables.  Returns 0, -1 on
+ * a bad peer id. */
+int dt_set_peer_stall_us(dt_transport *t, uint32_t peer,
+                         uint64_t stall_us);
 
 /* Seeded fault injection (chaos harness; the reference has none).
  * Applied at enqueue time to frames whose rtype bit is set in rtype_mask

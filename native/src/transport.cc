@@ -144,6 +144,14 @@ struct dt_transport {
   // per-destination extra delay (geo WAN profiles): added on top of the
   // global delay_us; sized at dt_create, all-zero by default
   std::vector<std::atomic<uint64_t>> peer_delay_us;
+  // gray-slow stall (dt_set_peer_stall_us): a separate additive term so
+  // fault scenarios compose with configured WAN profiles
+  std::vector<std::atomic<uint64_t>> peer_stall_us;
+  // per-link partition blackhole (dt_set_partition): dt_part_mode bits.
+  // TX drops at enqueue, RX drops at delivery — the sockets stay open,
+  // so peer_alive cannot see a partition (by design; that blindness is
+  // what the fencing layer's suspicion score exists for).
+  std::vector<std::atomic<uint32_t>> part_mode;
   // fault injection (dt_set_fault): all-zero = disabled (default)
   std::atomic<uint32_t> fault_drop_ppm{0};
   std::atomic<uint32_t> fault_dup_ppm{0};
@@ -517,6 +525,15 @@ struct dt_transport {
   }
 
   void deliver(const FrameHdr &h, const uint8_t *pay) {
+    // RX side of a partition blackhole: frames from the peer vanish on
+    // arrival (every rtype — a partition takes the whole link).
+    // Loopback delivery never reaches here with src == node_id faulted
+    // (self links cannot be partitioned), but guard anyway.
+    if (h.src < n_nodes && h.src != node_id &&
+        (part_mode[h.src].load(std::memory_order_relaxed) & DT_PART_RX)) {
+      bump(DT_STAT_MSG_BLACKHOLED);
+      return;
+    }
     bump(DT_STAT_MSG_RCVD);
     if (h.rtype == DT_PING) {
       // answer at transport level: echo payload back as PONG
@@ -566,6 +583,12 @@ struct dt_transport {
       bump(DT_STAT_MSG_SENT);
       return 0;
     }
+    // TX side of a partition blackhole: the frame is discarded before
+    // it ever reaches a sender shard (the peer sees pure silence)
+    if (part_mode[dest].load(std::memory_order_relaxed) & DT_PART_TX) {
+      bump(DT_STAT_MSG_BLACKHOLED);
+      return 0;
+    }
     uint64_t jitter = 0;
     bool duplicate = false;
     uint32_t mask = fault_mask.load(std::memory_order_relaxed);
@@ -590,6 +613,7 @@ struct dt_transport {
     f.dest = dest;
     uint64_t d = delay_us.load(std::memory_order_relaxed) +
                  peer_delay_us[dest].load(std::memory_order_relaxed) +
+                 peer_stall_us[dest].load(std::memory_order_relaxed) +
                  jitter;
     f.ready_us = d ? now_us() + d : 0;
     f.bytes.resize(sizeof(h) + len);
@@ -628,6 +652,8 @@ dt_transport *dt_create(uint32_t node_id, const char *endpoints,
   for (auto &slot : t->peer_fd) slot.store(-1, std::memory_order_relaxed);
   t->peer_dead = std::vector<std::atomic<bool>>(n_nodes);
   t->peer_delay_us = std::vector<std::atomic<uint64_t>>(n_nodes);
+  t->peer_stall_us = std::vector<std::atomic<uint64_t>>(n_nodes);
+  t->part_mode = std::vector<std::atomic<uint32_t>>(n_nodes);
 
   std::string text(endpoints);
   size_t pos = 0;
@@ -782,6 +808,19 @@ int dt_set_peer_delay_us(dt_transport *t, uint32_t peer,
                          uint64_t delay_us) {
   if (!t || peer >= t->n_nodes) return -1;
   t->peer_delay_us[peer].store(delay_us, std::memory_order_relaxed);
+  return 0;
+}
+
+int dt_set_partition(dt_transport *t, uint32_t peer, uint32_t mode) {
+  if (!t || peer >= t->n_nodes) return -1;
+  t->part_mode[peer].store(mode, std::memory_order_relaxed);
+  return 0;
+}
+
+int dt_set_peer_stall_us(dt_transport *t, uint32_t peer,
+                         uint64_t stall_us) {
+  if (!t || peer >= t->n_nodes) return -1;
+  t->peer_stall_us[peer].store(stall_us, std::memory_order_relaxed);
   return 0;
 }
 

@@ -1,0 +1,12 @@
+"""Fixture config: just the fencing flag, default OFF (the registry
+drift check cross-parses this module against the REAL fencing
+GateSpec)."""
+
+
+class Config:
+    fencing: bool = False
+    fencing_phi: float = 8.0
+    fencing_heartbeat_ms: float = 100.0
+    fencing_suspect_s: float = 2.0
+    elastic: bool = False
+    node_cnt: int = 1

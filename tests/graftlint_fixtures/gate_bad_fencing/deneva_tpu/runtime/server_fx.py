@@ -1,0 +1,36 @@
+"""Seeded graftlint violations: the REAL ``fencing`` GateSpec
+(runtime/gates.py) checked against fixture call sites — an unguarded
+call into the faildet home module must fail the lint, the guarded
+idioms the runtime actually uses (``cfg.fencing`` at construction, the
+node's cached ``self._fencing``, the detector object's
+``self._fd is not None``) must stay silent."""
+
+from deneva_tpu.runtime.faildet import (FailureDetector, fence_parts,
+                                        fencing_line)
+
+
+class ServerFx:
+    def __init__(self, cfg):
+        self._fencing = cfg.fencing
+        self._fd = None
+        if cfg.fencing:
+            # the runtime idiom: the flag test dominates construction
+            self._fd = FailureDetector(cfg, [1, 2], 0.0)
+
+    def ok_route(self, src, now_s):
+        # the detector object doubles as its own guard
+        if self._fd is not None:
+            self._fd.observe(src, now_s)
+
+    def ok_bcast(self, version):
+        # the cached boolean stamped in __init__
+        if self._fencing:
+            return fence_parts(version)
+        return None
+
+    def bad_bcast(self, version):
+        # no dominating fencing-flag test on any path to the call
+        return fence_parts(version)       # EXPECT[gate-unguarded-use]
+
+    def bad_line(self):
+        return fencing_line(0, {})        # EXPECT[gate-unguarded-use]

@@ -357,6 +357,81 @@ def test_parse_repair_forward_backward_compat(tmp_path):
     assert parse_file(str(old_log))["tput"] == 5
 
 
+def test_parse_fencing_forward_backward_compat(tmp_path):
+    """[fencing] lines (partition-tolerance satellite): per-node
+    suspicion/fence/heal accounting, including a fenced node's
+    self_halt=1 final line; old logs yield [], the new lines perturb
+    no other parser, and the [summary] fencing fields parse through
+    the standard summary path."""
+    from deneva_tpu.harness.parse import (parse_admission, parse_fencing,
+                                          parse_file, parse_membership,
+                                          parse_repair, parse_replication)
+    from deneva_tpu.harness.timeline import parse_timeline
+
+    new_log = tmp_path / "fencing.out"
+    new_log.write_text(
+        "# cfg node_cnt=3\n"
+        "[fencing] node=2 phi_peak=54.26 suspect_cnt=2 fence_nack_cnt=1 "
+        "fence_nack_rx=0 self_halt=1 heal_cnt=0 reassign_epoch=752 "
+        "last_acked_epoch=732 reason=minority epoch=752\n"
+        "[fencing] node=0 phi_peak=8.70 suspect_cnt=1 fence_nack_cnt=1 "
+        "fence_nack_rx=0 self_halt=0 heal_cnt=0 reassign_epoch=752 "
+        "last_acked_epoch=767\n"
+        "[timeline] node=0 epoch=760 loop=1.0ms suspect=2100.0ms\n"
+        "[summary] total_runtime=10,tput=6000,txn_cnt=60000,"
+        "fence_nack_cnt=1,suspect_cnt=1,heal_cnt=0,phi_peak=8.7,"
+        "fence_reassign_epoch=752\n")
+    rows = parse_fencing(new_log.read_text().splitlines())
+    assert len(rows) == 2
+    halted = rows[0]
+    assert halted["node"] == 2 and halted["self_halt"] == 1
+    assert halted["reason"] == "minority" and halted["phi_peak"] == 54.26
+    assert halted["last_acked_epoch"] == 732
+    assert rows[1]["self_halt"] == 0 and rows[1]["suspect_cnt"] == 1
+    row = parse_file(str(new_log))
+    assert row["fence_nack_cnt"] == 1 and row["phi_peak"] == 8.7
+    assert row["fence_reassign_epoch"] == 752
+    # other parsers ignore the new lines entirely
+    text = new_log.read_text().splitlines()
+    assert parse_membership(text) == []
+    assert parse_replication(text) == []
+    assert parse_admission(text) == []
+    assert parse_repair(text) == []
+    assert len(parse_timeline(text)) == 1
+    # old log: no fencing lines -> [] and unchanged parsing
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_fencing(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5
+
+
+def test_timeline_chrome_trace_fencing_track(tmp_path):
+    """Fencing spans (suspicion windows, heal gaps, fence rejections)
+    export on their own per-node "fencing" thread track (tid 3), beside
+    — never inside — the phase/replication/admission clocks."""
+    from deneva_tpu.harness.timeline import chrome_trace, parse_timeline
+
+    lines = [
+        "[timeline] node=0 epoch=8 loop=1.0ms suspect=2100.0ms\n",
+        "[timeline] node=0 epoch=16 loop=1.0ms heal=1200.0ms "
+        "adm_wait=5.0ms\n",
+    ]
+    trace = chrome_trace(parse_timeline(lines))
+    ev = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    phase = [e for e in ev if e["tid"] == 0]
+    fen = [e for e in ev if e["tid"] == 3]
+    # phase clock untouched by the fencing (and admission) spans
+    assert [e["name"] for e in phase] == ["loop", "loop"]
+    assert phase[1]["ts"] == 1000.0
+    # fencing track has its own running clock and category
+    assert [e["name"] for e in fen] == ["suspect", "heal"]
+    assert fen[0]["ts"] == 0 and fen[1]["ts"] == 2100000.0
+    assert all(e["cat"] == "fencing" for e in fen)
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta if m["tid"] == 3} \
+        == {"fencing"}
+
+
 def test_timeline_chrome_trace_admission_track(tmp_path):
     """Admission spans (per-group max queue delay) export on their own
     per-node "admission" thread track (tid 2), beside — never inside —

@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 
 from deneva_tpu.runtime import admission as A
+from deneva_tpu.runtime import faildet as FD
 from deneva_tpu.runtime import membership as M
 from deneva_tpu.runtime import replication as R
 from deneva_tpu.runtime import logger, native, wire
@@ -39,7 +40,8 @@ def test_fault_mask_classification_is_explicit_and_matches():
 def test_declared_codecs_exist():
     for spec in WIRE_MODEL.values():
         for fn in (*spec.codec_encode, *spec.codec_decode):
-            assert any(hasattr(m, fn) for m in (wire, M, logger, R, A)), \
+            assert any(hasattr(m, fn)
+                       for m in (wire, M, logger, R, A, FD)), \
                 f"{spec.name}: declared codec {fn} not found"
 
 
@@ -210,6 +212,33 @@ def _rt_admit_nack():
     assert len(t0) == 0 and len(r0) == 0
 
 
+def _rt_heartbeat():
+    ver, seen, ep = FD.decode_heartbeat(FD.encode_heartbeat(3, 127, 640))
+    assert (ver, seen, ep) == (3, 127, 640)
+    # zero-copy parts path must be byte-identical to the codec
+    parts = FD.heartbeat_parts(3, 127, 640)
+    assert b"".join(bytes(p) for p in parts) \
+        == FD.encode_heartbeat(3, 127, 640)
+
+
+def _rt_fence_nack():
+    mine, stale, ep = FD.decode_fence_nack(FD.encode_fence_nack(2, 0, 77))
+    assert (mine, stale, ep) == (2, 0, 77)
+    parts = FD.fence_nack_parts(2, 0, 77)
+    assert b"".join(bytes(p) for p in parts) \
+        == FD.encode_fence_nack(2, 0, 77)
+
+
+def _rt_heal():
+    owners = np.arange(12, dtype=np.int32) % 3
+    buf = FD.encode_heal(88, 5, owners)
+    ep, ver, owners2 = FD.decode_heal(buf)
+    assert (ep, ver) == (88, 5)
+    np.testing.assert_array_equal(owners, owners2)
+    parts = FD.heal_parts(88, 5, owners)
+    assert b"".join(bytes(p) for p in parts) == buf
+
+
 def _rt_payload_free():
     return None     # no payload on the wire: nothing to round-trip
 
@@ -236,6 +265,9 @@ ROUNDTRIP = {
     "REGION_READ": _rt_region_read,
     "REGION_READ_RSP": _rt_region_read_rsp,
     "ADMIT_NACK": _rt_admit_nack,
+    "HEARTBEAT": _rt_heartbeat,
+    "FENCE_NACK": _rt_fence_nack,
+    "HEAL": _rt_heal,
 }
 
 

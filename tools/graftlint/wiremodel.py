@@ -36,6 +36,7 @@ CODEC_MODULES = (
     "deneva_tpu/runtime/logger.py",
     "deneva_tpu/runtime/replication.py",
     "deneva_tpu/runtime/admission.py",
+    "deneva_tpu/runtime/faildet.py",
 )
 
 # handler qualname -> (module, function name) to scan for route branches
@@ -177,4 +178,27 @@ WIRE_MODEL: dict[str, RtypeSpec] = {s.name: s for s in (
        note="admission NACK (tags + retry-after hints): outside the "
             "mask like rtypes 15-20 — a lost NACK self-heals through "
             "the client resend sweep re-offering the unacked query"),
+    _s("HEARTBEAT", False, gate="fencing",
+       enc=("encode_heartbeat", "heartbeat_parts"),
+       dec=("decode_heartbeat",),
+       routes=("ServerNode._route",),
+       note="per-link liveness + ack-lease grant (map version + the "
+            "highest epoch blob seen from the peer): re-sent on its "
+            "cadence, so a lost beat is just the next one — its fault "
+            "mode IS the partition the detector exists to see"),
+    _s("FENCE_NACK", False, gate="fencing",
+       enc=("encode_fence_nack", "fence_nack_parts"),
+       dec=("decode_fence_nack",),
+       routes=("ServerNode._route",),
+       note="stale-incarnation rejection (the receiver self-halts with "
+            "exit 18): re-triggered by the stale sender's next frame, "
+            "and the minority quorum rule fences even when every nack "
+            "is lost — never fault-eligible control plane"),
+    _s("HEAL", False, gate="fencing",
+       enc=("encode_heal", "heal_parts"),
+       dec=("decode_heal",),
+       routes=("ServerNode._route",),
+       note="partition-heal map catch-up on a suspected->fresh "
+            "transition (rides beside the REJOIN blob resend): control "
+            "plane; a lost HEAL re-arms on the next heal transition"),
 )}

@@ -18,6 +18,14 @@
 #                                         diurnal (bounded admission queue,
 #                                         shed + recovery, tenant fairness,
 #                                         exactly-once under NACK+resend)
+#   tools/smoke.sh partition              partition-tolerance gate:
+#                                         symmetric split / asymmetric
+#                                         split / gray-slow node /
+#                                         flapping link (fencing=true:
+#                                         quorum reassignment, minority
+#                                         self-fence exit 18, single-
+#                                         writer-per-slot + digest-vs-
+#                                         replay invariants)
 #   tools/smoke.sh repair                 transaction-repair gate:
 #                                         repair-contention (zipf-0.9
 #                                         write-heavy OCC with repair on +
@@ -80,6 +88,13 @@ case "$SCEN" in
     T="${SMOKE_TIMEOUT_SECS:-${OVERLOAD_TIMEOUT_SECS:-900}}"
     run "$T" python -m deneva_tpu.harness.chaos overload --quick
     ;;
+  partition)
+    # full done-windows even under --quick (the PR 4 clamped-window
+    # lesson): the fault fires ~3 s in, suspicion needs its silence
+    # floor, and the takeover replay-jit stall runs 4-5 s on the CI box
+    T="${SMOKE_TIMEOUT_SECS:-${PARTITION_TIMEOUT_SECS:-900}}"
+    run "$T" python -m deneva_tpu.harness.chaos partition --quick
+    ;;
   repair)
     T="${SMOKE_TIMEOUT_SECS:-${REPAIR_TIMEOUT_SECS:-600}}"
     run "$T" python -m deneva_tpu.harness.chaos repair-contention --quick
@@ -106,7 +121,7 @@ case "$SCEN" in
     fi
     ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|repair|lint> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|lint> [args...]" >&2
     exit 2
     ;;
 esac
