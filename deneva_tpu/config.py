@@ -619,6 +619,43 @@ class Config:
     #                                retry-after hint
     nack_backoff_max_us: float = 2_000_000.0  # backoff growth cap
 
+    # ---- transaction flight recorder (cross-node txn lifecycle tracing
+    # + structured telemetry stream; runtime/telemetry.py).  All defaults
+    # OFF: with telemetry=False no recorder is ever constructed, no
+    # sidecar file is written, no [telemetry] line prints, and every
+    # wire byte / log byte / verdict is bit-identical to the
+    # pre-telemetry runtime (the same contract as chaos/elastic/geo/
+    # overload/repair/fencing). ----
+    telemetry: bool = False        # arm the flight recorder: every node
+    #                                (client, server, replica) records
+    #                                per-hop lifecycle events for the
+    #                                DETERMINISTICALLY SAMPLED txn subset
+    #                                (lane % telemetry_sample == 0 on the
+    #                                tag's ring-lane bits, so client and
+    #                                every server pick the SAME txns with
+    #                                zero coordination) into a
+    #                                preallocated numpy record ring,
+    #                                flushed as telemetry_*.bin sidecars;
+    #                                servers additionally stream
+    #                                per-epoch counters to
+    #                                metrics_node*.jsonl.  Join + render
+    #                                with harness/txntrace.py.
+    telemetry_sample: int = 1024   # sampling modulus (depth knob, live
+    #                                default like repair_rounds): 1 =
+    #                                record every txn (tests/debug);
+    #                                1024 = the default production rate
+    #                                the <= 2% overhead gate pins
+    #                                (tools/regression_gate.py,
+    #                                results/telemetry)
+    telemetry_ring: int = 1 << 16  # record-ring capacity per node;
+    #                                events past a full ring DROP (and
+    #                                count) rather than stall the hot
+    #                                loop — the ring auto-flushes at
+    #                                half full from the epoch loop
+    telemetry_dir: str = ""        # sidecar directory; "" = log_dir
+    #                                (the launcher namespaces it per run
+    #                                exactly like the command logs)
+
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
     checkpoint_path: str = ""      # "" = checkpointing off
@@ -1031,6 +1068,14 @@ class Config:
             _check(self.tenant_quota == 0.0
                    and self.admission_slo_ms == 0.0,
                    "tenant_quota/admission_slo_ms need --admission=true")
+        # ---- telemetry gating (same discipline as elastic/geo/overload/
+        # repair/fencing: defaults take the pre-telemetry paths exactly;
+        # sample/ring/dir are depth knobs with live defaults) ----
+        _check(self.telemetry_sample >= 1,
+               "telemetry_sample must be >= 1 (1 records every txn)")
+        _check(self.telemetry_ring >= 1024,
+               "telemetry_ring must be >= 1024 (one client batch of "
+               "events must fit between flush points)")
         # ---- transaction repair gating (same discipline as elastic/geo/
         # overload: defaults take the pre-repair paths exactly) ----
         _check(self.repair_rounds >= 0 and self.repair_rounds <= 8,

@@ -190,6 +190,21 @@ SCENARIOS: dict[str, dict] = {
         synth_table_size=1024, fault_kill="1:64", logging=True,
         replica_cnt=1, done_secs=4.0, log_dir="/dev/shm/deneva_logs",
         fault_recovery_timeout_s=300.0),
+    # transaction flight recorder under crash/recovery (runtime/
+    # telemetry.py + harness/txntrace.py): the kill-one-server shape
+    # with telemetry armed at a dense sampling rate.  The invariants
+    # this buys: the TRACE-COMPLETENESS oracle — every sampled txn that
+    # earned a commit verdict has a gap-free send <= admit <= batch <=
+    # verdict [<= release] <= ack chain with zero ordering inversions,
+    # at least one chain carries the full quorum hold->release hop, and
+    # the merger renders the whole run as one flow-linked Chrome trace
+    # — all across a crash (the killed node flushes its ring at the
+    # boundary, the recovered incarnation appends; events intact to the
+    # boundary survive exactly like the command log).
+    "trace-kill": dict(
+        fault_kill="1:64", logging=True, replica_cnt=1, done_secs=4.0,
+        fault_recovery_timeout_s=300.0, telemetry=True,
+        telemetry_sample=8, log_dir="/dev/shm/deneva_logs"),
     # overload robustness tier (runtime/loadgen.py + runtime/
     # admission.py): open-loop arrival processes against per-tenant
     # admission control.  Windows stay FULL under --quick like the
@@ -358,7 +373,8 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
         _require(c["txn_cnt"] <= c["sent_cnt"],
                  f"{name}: more unique acks ({c['txn_cnt']}) than unique "
                  f"sends ({c['sent_cnt']}) — a tag was acked twice")
-    if name not in ("kill-one-server", "repair-contention"):
+    if name not in ("kill-one-server", "repair-contention",
+                    "trace-kill"):
         # deterministic replicated validation must survive the faults
         # (and any membership cutover): identical [summary] commit
         # counts on every reporting server — except where a server was
@@ -377,6 +393,11 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
         _require(dup_seen > 0, "dup-storm: no duplicate was ever seen")
     if name == "kill-one-server":
         _check_recovery(cfg, out, run_id, report)
+    if name == "trace-kill":
+        # the full crash/recovery oracle first (same machinery as
+        # kill-one-server), then the trace-completeness audit on top
+        _check_recovery(cfg, out, run_id, report)
+        _check_trace(cfg, srv, cls, run_id, report)
     if name == "repair-contention":
         # repair must actually have fired (a scenario that passes with
         # repair inert proves nothing) and every salvaged txn is a
@@ -780,6 +801,62 @@ def _check_partition(name: str, cfg: Config, out: dict, run_id: str,
                  f"replay under its final map ({digest[:16]} != "
                  f"{side['state_digest'][:16]})")
     report["digest_match"] = True
+
+
+def _check_trace(cfg: Config, srv: list[dict], cls: list[dict],
+                 run_id: str, report: dict) -> None:
+    """Trace-completeness oracle (the tools/smoke.sh ``trace`` gate):
+
+    * the recorder was LIVE on servers and clients (anti-inert:
+      tel_sampled_cnt > 0 in every reporting summary) and never dropped
+      an event (the ring auto-flush keeps headroom);
+    * every sampled txn that earned a commit verdict has a GAP-FREE
+      send <= admit <= batch <= verdict [<= release] <= ack chain —
+      zero completeness violations across the crash;
+    * at least one chain carries the full quorum hold->release hop
+      (the logging path's group-commit gate is visible per txn);
+    * the merger renders the run as one flow-linked Chrome trace whose
+      arrows actually cross node tracks (client pid != server pid).
+    """
+    from deneva_tpu.harness import txntrace
+
+    for s in srv + cls:
+        _require(s.get("tel_sampled_cnt", 0.0) > 0,
+                 "trace-kill: a node's summary shows zero sampled "
+                 "events (is telemetry live?)")
+        _require(s.get("tel_dropped_cnt", 0.0) == 0,
+                 "trace-kill: the recorder dropped events (ring too "
+                 "small for the flush cadence)")
+    tdir = os.path.join(cfg.log_dir, run_id)
+    recs, roles = txntrace.load_dir(tdir)
+    _require(len(recs) > 0,
+             f"trace-kill: no telemetry records under {tdir}")
+    chains = [txntrace.build_chain(ev)
+              for ev in txntrace.index_txns(recs).values()]
+    committed, full, viol = txntrace.completeness(chains)
+    report["trace_txns"] = len(chains)
+    report["trace_committed"] = committed
+    report["trace_full_chains"] = full
+    _require(committed > 0,
+             "trace-kill: no sampled txn ever committed in-trace")
+    _require(not viol,
+             "trace-kill: span-chain gaps/inversions: "
+             + "; ".join(viol[:5]))
+    _require(full > 0,
+             "trace-kill: no chain carries the quorum hold->release "
+             "hop (logging is on — held acks must trace)")
+    # per-epoch metrics stream: every reporting server wrote lines
+    for s in range(cfg.node_cnt):
+        mpath = os.path.join(tdir, f"metrics_node{s}.jsonl")
+        _require(os.path.exists(mpath) and os.path.getsize(mpath) > 0,
+                 f"trace-kill: metrics stream missing/empty at {mpath}")
+    trace = txntrace.chrome_trace(recs, roles)
+    flows = [e for e in trace["traceEvents"] if e["ph"] in ("s", "f")]
+    _require(len(flows) >= 2,
+             "trace-kill: flow arrows missing from the Chrome export")
+    _require(any(e["pid"] >= cfg.node_cnt for e in flows),
+             "trace-kill: flow arrows never touch a client track")
+    report["trace_flow_events"] = len(flows)
 
 
 def _check_recovery(cfg: Config, out: dict, run_id: str,

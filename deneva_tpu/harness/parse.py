@@ -113,19 +113,14 @@ def results_table(out_dir: str, x: str, y: str = "tput",
     return table
 
 
-_MEMBER = re.compile(r"\[membership\] (.*)")
-
-
-def parse_membership(lines) -> list[dict[str, Any]]:
-    """Per-cutover ``[membership]`` lines (runtime/membership.py) ->
-    [{node, version, epoch, reason, subject, slots_moved, owned,
-    rows_in, rows_out, stall_ms}].  Logs predating the membership
-    subsystem simply yield [] — and every other parser here ignores
-    ``[membership]`` lines, so old tooling keeps working on new logs
-    (forward/backward compat, tested in tests/test_harness.py)."""
+def _parse_tagged(lines, pattern: re.Pattern) -> list[dict[str, Any]]:
+    """One tagged-line family -> [{k: v}] (the shared body of every
+    ``parse_<family>`` below: regex match, split on spaces, k=v with
+    auto-typed values).  Each family keeps its own thin wrapper so the
+    per-family contract stays documented in one obvious place."""
     out = []
     for line in lines:
-        m = _MEMBER.search(line)
+        m = pattern.search(line)
         if not m:
             continue
         d: dict[str, Any] = {}
@@ -136,6 +131,19 @@ def parse_membership(lines) -> list[dict[str, Any]]:
             d[k] = _auto(v)
         out.append(d)
     return out
+
+
+_MEMBER = re.compile(r"\[membership\] (.*)")
+
+
+def parse_membership(lines) -> list[dict[str, Any]]:
+    """Per-cutover ``[membership]`` lines (runtime/membership.py) ->
+    [{node, version, epoch, reason, subject, slots_moved, owned,
+    rows_in, rows_out, stall_ms}].  Logs predating the membership
+    subsystem simply yield [] — and every other parser here ignores
+    ``[membership]`` lines, so old tooling keeps working on new logs
+    (forward/backward compat, tested in tests/test_harness.py)."""
+    return _parse_tagged(lines, _MEMBER)
 
 
 _REPL = re.compile(r"\[replication\] (.*)")
@@ -150,19 +158,7 @@ def parse_replication(lines) -> list[dict[str, Any]]:
     other parser ignores ``[replication]`` lines — the same
     forward/backward-compat contract as ``parse_membership`` (tested in
     tests/test_harness.py)."""
-    out = []
-    for line in lines:
-        m = _REPL.search(line)
-        if not m:
-            continue
-        d: dict[str, Any] = {}
-        for kv in m.group(1).split():
-            if "=" not in kv:
-                continue
-            k, v = kv.split("=", 1)
-            d[k] = _auto(v)
-        out.append(d)
-    return out
+    return _parse_tagged(lines, _REPL)
 
 
 _ADMIT = re.compile(r"\[admission\] (.*)")
@@ -177,19 +173,7 @@ def parse_admission(lines) -> list[dict[str, Any]]:
     here ignores ``[admission]`` lines — the same forward/backward-
     compat contract as ``parse_membership``/``parse_replication``
     (tested in tests/test_harness.py)."""
-    out = []
-    for line in lines:
-        m = _ADMIT.search(line)
-        if not m:
-            continue
-        d: dict[str, Any] = {}
-        for kv in m.group(1).split():
-            if "=" not in kv:
-                continue
-            k, v = kv.split("=", 1)
-            d[k] = _auto(v)
-        out.append(d)
-    return out
+    return _parse_tagged(lines, _ADMIT)
 
 
 _REPAIR = re.compile(r"\[repair\] (.*)")
@@ -206,19 +190,7 @@ def parse_repair(lines) -> list[dict[str, Any]]:
     here ignores ``[repair]`` lines — the same forward/backward-compat
     contract as ``parse_membership``/``parse_replication``/
     ``parse_admission`` (tested in tests/test_harness.py)."""
-    out = []
-    for line in lines:
-        m = _REPAIR.search(line)
-        if not m:
-            continue
-        d: dict[str, Any] = {}
-        for kv in m.group(1).split():
-            if "=" not in kv:
-                continue
-            k, v = kv.split("=", 1)
-            d[k] = _auto(v)
-        out.append(d)
-    return out
+    return _parse_tagged(lines, _REPAIR)
 
 
 _FENCING = re.compile(r"\[fencing\] (.*)")
@@ -235,19 +207,25 @@ def parse_fencing(lines) -> list[dict[str, Any]]:
     forward/backward-compat contract as ``parse_membership``/
     ``parse_replication``/``parse_admission``/``parse_repair`` (tested
     in tests/test_harness.py)."""
-    out = []
-    for line in lines:
-        m = _FENCING.search(line)
-        if not m:
-            continue
-        d: dict[str, Any] = {}
-        for kv in m.group(1).split():
-            if "=" not in kv:
-                continue
-            k, v = kv.split("=", 1)
-            d[k] = _auto(v)
-        out.append(d)
-    return out
+    return _parse_tagged(lines, _FENCING)
+
+
+_TELEMETRY = re.compile(r"\[telemetry\] (.*)")
+
+
+def parse_telemetry(lines) -> list[dict[str, Any]]:
+    """Per-node ``[telemetry]`` lines (runtime/telemetry.py via every
+    node kind's summary path) -> [{node, sampled_cnt, dropped_cnt,
+    ring_highwater, flush_ms, sample}].  The flight recorder's health
+    ledger: sampled_cnt proves the instrument was live (the regression
+    gate's anti-inert check reads the [summary] twin of this field),
+    dropped_cnt/ring_highwater size the ring, flush_ms bounds the
+    sidecar-write cost.  Logs predating the telemetry tier yield [] —
+    and every other parser here ignores ``[telemetry]`` lines — the
+    same forward/backward-compat contract as ``parse_membership``/
+    ``parse_replication``/``parse_admission``/``parse_repair``/
+    ``parse_fencing`` (tested in tests/test_harness.py)."""
+    return _parse_tagged(lines, _TELEMETRY)
 
 
 def cfg_header(cfg: Config) -> str:

@@ -24,35 +24,62 @@ from __future__ import annotations
 import json
 import re
 import sys
+from dataclasses import dataclass
 
 import numpy as np
 
 _LINE = re.compile(r"\[timeline\] node=(\d+) epoch=(\d+) (.*)")
 _SPAN = re.compile(r"(\w+)=([0-9.]+)ms")
 
+
+# ---- the track registry ------------------------------------------------
+# Every Chrome-trace thread track this repo exports is DECLARED here —
+# one registry shared by this module's per-epoch phase export and the
+# flight-recorder txn export (harness/txntrace.py), replacing the magic
+# tid literals the replication/admission/fencing PRs scattered through
+# chrome_trace.  A tagged-line span family that is not registered has no
+# track to land on (tested in tests/test_harness.py), so a new
+# subsystem's spans cannot silently collide with an existing tid.
+@dataclass(frozen=True)
+class Track:
+    tid: int
+    name: str
+    # span names that land on this track; the phase track (tid 0) is
+    # the catch-all for every unregistered span name
+    spans: frozenset = frozenset()
+
+
 # replication spans (geo tier): latency LEDGERS, not thread-time slices
 # of the epoch loop — quorum wait (held-ack release lag), failover
 # promote (reassignment takeover stall), follower-read serve and group
-# apply time on a replica.  The Chrome-trace export lays them on a
-# separate per-node "replication" thread track so they never distort
-# the phase track's running clock.
-REPLICATION_SPANS = frozenset(("quorum", "promote", "follower_read",
-                               "apply"))
+# apply time on a replica.  Laid on a separate per-node track so they
+# never distort the phase track's running clock.  The admission span
+# (the per-group max admission-queue delay) and the fencing spans
+# (suspicion windows, heal gaps, fence rejections) get the same
+# latency-ledger treatment on their own tracks.
+PHASE_TRACK = Track(0, "phase")
+REPLICATION_TRACK = Track(1, "replication",
+                          frozenset(("quorum", "promote",
+                                     "follower_read", "apply")))
+ADMISSION_TRACK = Track(2, "admission", frozenset(("adm_wait",)))
+FENCING_TRACK = Track(3, "fencing",
+                      frozenset(("suspect", "heal", "fence")))
+# flight-recorder per-txn lifecycle spans (harness/txntrace.py) ride
+# their own track beside the phase clocks — wall-timestamped spans, not
+# running-clock ledgers, so they never share a tid with the above
+TXN_TRACK = Track(4, "txn")
 
-# admission spans (overload tier): the per-group max admission-queue
-# delay ("adm_wait") is a latency ledger like the replication spans —
-# the Chrome-trace export lays it on its own per-node "admission"
-# thread track (tid 2) so a backpressure episode shows up as a
-# widening band beside the phase track, never inside it.
-ADMISSION_SPANS = frozenset(("adm_wait",))
+TRACKS: tuple[Track, ...] = (PHASE_TRACK, REPLICATION_TRACK,
+                             ADMISSION_TRACK, FENCING_TRACK, TXN_TRACK)
 
-# fencing spans (partition-tolerance tier): suspicion windows ("suspect"
-# — the silence a peer accrued before being retired), heal gaps ("heal"
-# — the outage a flapping link recovered from) and fence rejections
-# ("fence").  Same latency-ledger treatment on a fourth track (tid 3,
-# "fencing"), so a partition episode reads as a band beside the phase
-# track instead of distorting it.
-FENCING_SPANS = frozenset(("suspect", "heal", "fence"))
+# span name -> owning track for the [timeline] ledger families
+SPAN_TRACK: dict[str, Track] = {name: t for t in TRACKS
+                                for name in t.spans}
+
+# backward-compat aliases (pre-registry names)
+REPLICATION_SPANS = REPLICATION_TRACK.spans
+ADMISSION_SPANS = ADMISSION_TRACK.spans
+FENCING_SPANS = FENCING_TRACK.spans
 
 
 def parse_timeline(lines) -> list[dict]:
@@ -98,70 +125,35 @@ def chrome_trace(rows: list[dict]) -> dict:
     at t=0), which is what the lockstep epoch exchange makes meaningful.
     """
     events: list[dict] = []
-    clock: dict[int, float] = {}          # node -> phase track time (us)
-    rclock: dict[int, float] = {}         # node -> replication track time
-    aclock: dict[int, float] = {}         # node -> admission track time
-    fclock: dict[int, float] = {}         # node -> fencing track time
+    # (node, tid) -> that track's running clock.  Ledger spans ride
+    # their registered track with an independent clock: they are
+    # latency ledgers, drawn beside the phases, never inside them.  A
+    # node's track is named as soon as it EMITS an event there, even if
+    # all its spans are 0.0 ms (idle-follower visibility).
+    clocks: dict[tuple[int, int], float] = {}
+    nodes: set[int] = set()
     for r in rows:
-        t = clock.get(r["node"], 0.0)
-        rt = rclock.get(r["node"], 0.0)
-        at = aclock.get(r["node"], 0.0)
-        ft = fclock.get(r["node"], 0.0)
+        nodes.add(r["node"])
+        clocks.setdefault((r["node"], PHASE_TRACK.tid), 0.0)
         for name, ms in r["phases"].items():
             dur = ms * 1000.0
-            if name in REPLICATION_SPANS:
-                # replication spans ride their own thread track (tid 1)
-                # with an independent running clock: they are latency
-                # ledgers, drawn beside the phases, never inside them
-                events.append({"name": name, "ph": "X", "pid": r["node"],
-                               "tid": 1, "ts": round(rt, 3),
-                               "dur": round(dur, 3), "cat": "replication",
-                               "args": {"epoch": r["epoch"]}})
-                rt += dur
-                # the track is named for every node that EMITTED a
-                # tid-1 event, even if all its spans are 0.0 ms
-                rclock.setdefault(r["node"], 0.0)
-                continue
-            if name in ADMISSION_SPANS:
-                # admission spans: same latency-ledger treatment on a
-                # third track (tid 2, "admission")
-                events.append({"name": name, "ph": "X", "pid": r["node"],
-                               "tid": 2, "ts": round(at, 3),
-                               "dur": round(dur, 3), "cat": "admission",
-                               "args": {"epoch": r["epoch"]}})
-                at += dur
-                aclock.setdefault(r["node"], 0.0)
-                continue
-            if name in FENCING_SPANS:
-                # fencing spans: same latency-ledger treatment on a
-                # fourth track (tid 3, "fencing")
-                events.append({"name": name, "ph": "X", "pid": r["node"],
-                               "tid": 3, "ts": round(ft, 3),
-                               "dur": round(dur, 3), "cat": "fencing",
-                               "args": {"epoch": r["epoch"]}})
-                ft += dur
-                fclock.setdefault(r["node"], 0.0)
-                continue
-            events.append({"name": name, "ph": "X", "pid": r["node"],
-                           "tid": 0, "ts": round(t, 3),
-                           "dur": round(dur, 3),
-                           "args": {"epoch": r["epoch"]}})
-            t += dur
-        clock[r["node"]] = t
-        if r["node"] in rclock:
-            rclock[r["node"]] = rt
-        if r["node"] in aclock:
-            aclock[r["node"]] = at
-        if r["node"] in fclock:
-            fclock[r["node"]] = ft
+            track = SPAN_TRACK.get(name, PHASE_TRACK)
+            key = (r["node"], track.tid)
+            t = clocks.setdefault(key, 0.0)
+            ev = {"name": name, "ph": "X", "pid": r["node"],
+                  "tid": track.tid, "ts": round(t, 3),
+                  "dur": round(dur, 3), "args": {"epoch": r["epoch"]}}
+            if track.tid != PHASE_TRACK.tid:
+                ev["cat"] = track.name
+            events.append(ev)
+            clocks[key] = t + dur
     meta = [{"name": "process_name", "ph": "M", "pid": n, "tid": 0,
-             "args": {"name": f"node {n}"}} for n in sorted(clock)]
-    meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": 1,
-              "args": {"name": "replication"}} for n in sorted(rclock)]
-    meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": 2,
-              "args": {"name": "admission"}} for n in sorted(aclock)]
-    meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": 3,
-              "args": {"name": "fencing"}} for n in sorted(fclock)]
+             "args": {"name": f"node {n}"}} for n in sorted(nodes)]
+    meta += [{"name": "thread_name", "ph": "M", "pid": n, "tid": tid,
+              "args": {"name": track.name}}
+             for track in TRACKS[1:]
+             for n, tid in sorted(k for k in clocks
+                                  if k[1] == track.tid)]
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 

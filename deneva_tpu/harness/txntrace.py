@@ -1,0 +1,366 @@
+"""Flight-recorder trace merger: per-txn span trees across nodes.
+
+Joins the per-node ``telemetry_*.bin`` sidecars the flight recorder
+(runtime/telemetry.py) writes into per-transaction lifecycle chains —
+client send → server admission → epoch-batch assignment → CC verdict →
+quorum hold/release → client ack, with resend/backoff annotations and
+the replicas' epoch-apply events joined by epoch — and renders them
+three ways:
+
+* **waterfall tables** — per-stage latency attribution (p50/p95/p99 over
+  the sampled population), split by verdict class (committed / retried /
+  salvaged / shed) or by tenant.  This is the latency decomposition the
+  source paper's evaluation is built on, per-txn instead of per-epoch.
+* **Chrome trace** (chrome://tracing / Perfetto) — every sampled txn's
+  stage spans laid on per-node "txn" tracks (the track registry in
+  harness/timeline.py — txn spans are wall-timestamped, so cross-node
+  alignment is exact on the shared-clock single-box rig), with FLOW
+  arrows linking the hops across node tracks.
+* **completeness audit** — the chaos harness's trace oracle: every
+  sampled txn that earned a commit verdict must have a gap-free
+  send ≤ admit ≤ batch ≤ verdict [≤ release] ≤ ack chain; any ordering
+  inversion or missing hop is a violation (tools/smoke.sh trace).
+
+All stage selection is relative to the COMMITTING verdict (the last
+commit/salvage event): a txn retried across epochs keeps its first-send
+time (total latency measures the user-visible wait) while per-stage
+attribution describes the pass that actually committed.
+
+CLI:  python -m deneva_tpu.harness.txntrace <sidecar-dir>
+          [--by verdict|tenant] [--tsv] [--trace out.json]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+
+import numpy as np
+
+from deneva_tpu.harness.timeline import TXN_TRACK
+from deneva_tpu.runtime.telemetry import (REC_DTYPE, ST_ACK, ST_ADMIT,
+                                          ST_APPLY, ST_BACKOFF, ST_BATCH,
+                                          ST_HOLD, ST_RELEASE, ST_RESEND,
+                                          ST_SEND, ST_VERDICT, V_COMMIT,
+                                          V_SALVAGE, read_telemetry)
+from deneva_tpu.stats import weighted_nearest_rank
+
+# waterfall stages (fixed set so tables line up across runs): when a
+# txn never held for quorum (logging off, or a crash re-ack) the hold
+# width is zero and release coincides with the verdict
+STAGES = ("send-admit", "admit-batch", "batch-verdict",
+          "verdict-release", "release-ack", "total")
+
+VERDICT_CLASSES = ("committed", "retried", "salvaged", "shed")
+
+
+def load_dir(d: str) -> tuple[np.ndarray, dict[int, str]]:
+    """All sidecars of one run directory -> (time-sorted records,
+    {node: role}).  Missing/empty files just contribute nothing."""
+    parts, roles = [], {}
+    for path in sorted(glob.glob(os.path.join(d, "telemetry_*.bin"))):
+        meta, recs = read_telemetry(path)
+        if len(recs):
+            parts.append(recs)
+        if meta["node"] >= 0:
+            roles[meta["node"]] = meta["role"]
+    if not parts:
+        return np.zeros(0, REC_DTYPE), roles
+    recs = np.concatenate(parts)
+    return recs[np.argsort(recs["t_us"], kind="stable")], roles
+
+
+def index_txns(recs: np.ndarray) -> dict[int, np.ndarray]:
+    """{packed tag: its records} (tag -1 epoch events excluded)."""
+    recs = recs[recs["tag"] >= 0]
+    order = np.argsort(recs["tag"], kind="stable")
+    recs = recs[order]
+    tags, starts = np.unique(recs["tag"], return_index=True)
+    out = {}
+    for i, tag in enumerate(tags):
+        hi = starts[i + 1] if i + 1 < len(starts) else len(recs)
+        ev = recs[starts[i]:hi]
+        out[int(tag)] = ev[np.argsort(ev["t_us"], kind="stable")]
+    return out
+
+
+def apply_times(recs: np.ndarray) -> dict[int, list[tuple[int, int]]]:
+    """Replica epoch-apply events: {epoch: [(node, t_us), ...]}."""
+    ev = recs[(recs["tag"] == -1) & (recs["stage"] == ST_APPLY)]
+    out: dict[int, list[tuple[int, int]]] = {}
+    for r in ev:
+        out.setdefault(int(r["epoch"]), []).append(
+            (int(r["node"]), int(r["t_us"])))
+    return out
+
+
+def _last_at_or_before(ev, stage: int, t: int):
+    m = (ev["stage"] == stage) & (ev["t_us"] <= t)
+    return ev[m][-1] if m.any() else None
+
+
+def _first_at_or_after(ev, stage: int, t: int):
+    m = (ev["stage"] == stage) & (ev["t_us"] >= t)
+    return ev[m][0] if m.any() else None
+
+
+def build_chain(ev: np.ndarray) -> dict:
+    """One txn's milestone chain (times in us; None = hop missing).
+
+    Stage selection is anchored on the COMMITTING verdict — the last
+    commit/salvage ST_VERDICT event; a txn with no commit verdict gets
+    ``verdict=None`` (in flight / lost at shutdown) and is excluded
+    from the waterfall and the completeness audit."""
+    st = ev["stage"]
+    ch: dict = {"tag": int(ev["tag"][0]),
+                "tenant": int((ev["tag"][0] >> 24) & 0xFF),
+                "resend_cnt": int((st == ST_RESEND).sum()),
+                "backoff_cnt": int((st == ST_BACKOFF).sum())}
+    sends = ev[st == ST_SEND]
+    ch["send"] = int(sends["t_us"][0]) if len(sends) else None
+    commits = ev[(st == ST_VERDICT)
+                 & ((ev["verdict"] == V_COMMIT)
+                    | (ev["verdict"] == V_SALVAGE))]
+    if not len(commits):
+        ch.update(verdict=None, admit=None, batch=None, hold=None,
+                  release=None, ack=None, epoch=-1, server=-1,
+                  klass=None, salvaged=False)
+        return ch
+    cv = commits[-1]
+    tv = int(cv["t_us"])
+    ch["verdict"] = tv
+    ch["epoch"] = int(cv["epoch"])
+    ch["server"] = int(cv["node"])
+    ch["salvaged"] = bool(cv["verdict"] == V_SALVAGE)
+    adm = _last_at_or_before(ev, ST_ADMIT, tv)
+    ch["admit"] = int(adm["t_us"]) if adm is not None else None
+    bat = _last_at_or_before(ev, ST_BATCH, tv)
+    ch["batch"] = int(bat["t_us"]) if bat is not None else None
+    hold = _first_at_or_after(ev, ST_HOLD, tv)
+    ch["hold"] = int(hold["t_us"]) if hold is not None else None
+    rel = _first_at_or_after(ev, ST_RELEASE, tv)
+    ch["release"] = int(rel["t_us"]) if rel is not None else None
+    acks = ev[st == ST_ACK]
+    ch["ack"] = int(acks["t_us"][0]) if len(acks) else None
+    ch["client"] = int(acks["node"][0]) if len(acks) \
+        else (int(sends["node"][0]) if len(sends) else -1)
+    retried = bool(((st == ST_VERDICT)
+                    & (ev["verdict"] != V_COMMIT)
+                    & (ev["verdict"] != V_SALVAGE)).any())
+    # class priority: a salvage is the repair engine's win, a shed txn's
+    # tail is the admission story, a retry the contention story
+    ch["klass"] = ("salvaged" if ch["salvaged"]
+                   else "shed" if ch["backoff_cnt"]
+                   else "retried" if retried else "committed")
+    return ch
+
+
+def stage_spans(ch: dict) -> dict[str, float] | None:
+    """Per-stage widths in ms for one committed chain (None when a core
+    hop is missing — completeness() reports those)."""
+    if ch["verdict"] is None or None in (ch["send"], ch["admit"],
+                                         ch["batch"], ch["ack"]):
+        return None
+    rel = ch["release"] if ch["release"] is not None else ch["verdict"]
+    return {"send-admit": (ch["admit"] - ch["send"]) / 1e3,
+            "admit-batch": (ch["batch"] - ch["admit"]) / 1e3,
+            "batch-verdict": (ch["verdict"] - ch["batch"]) / 1e3,
+            "verdict-release": (rel - ch["verdict"]) / 1e3,
+            "release-ack": (ch["ack"] - rel) / 1e3,
+            "total": (ch["ack"] - ch["send"]) / 1e3}
+
+
+def completeness(chains: list[dict]) -> tuple[int, int, list[str]]:
+    """The trace oracle: (committed, full_chains, violations).
+
+    Every chain with a commit verdict must have send/admit/batch/ack
+    hops and monotone ordering (a missing hop is a recorder gap; an
+    inversion would mean e.g. an ack released before its verdict).
+    ``full_chains`` additionally counts chains carrying the quorum
+    hold→release hop — the end-to-end shape the chaos trace gate
+    requires at least one of."""
+    committed = full = 0
+    viol: list[str] = []
+    for ch in chains:
+        if ch["verdict"] is None:
+            continue
+        committed += 1
+        missing = [m for m in ("send", "admit", "batch", "ack")
+                   if ch[m] is None]
+        if missing:
+            viol.append(f"tag {ch['tag']}: committed but missing "
+                        f"{'/'.join(missing)} hop(s)")
+            continue
+        order = [("send", ch["send"]), ("admit", ch["admit"]),
+                 ("batch", ch["batch"]), ("verdict", ch["verdict"])]
+        if ch["release"] is not None:
+            order.append(("release", ch["release"]))
+        order.append(("ack", ch["ack"]))
+        bad = [f"{a}>{b}" for (a, ta), (b, tb)
+               in zip(order, order[1:]) if ta > tb]
+        if bad:
+            viol.append(f"tag {ch['tag']}: ordering inversion "
+                        f"{','.join(bad)}")
+            continue
+        if ch["hold"] is not None and ch["release"] is not None:
+            full += 1
+    return committed, full, viol
+
+
+# ---- renderers ---------------------------------------------------------
+
+def waterfall(chains: list[dict], by: str = "verdict"
+              ) -> list[list[str]]:
+    """Aligned rows: split, stage, n, p50/p95/p99/mean ms.  ``by`` is
+    "verdict" (committed/retried/salvaged/shed), "tenant", or "none"
+    (one aggregate split)."""
+    groups: dict[str, dict[str, list[float]]] = {}
+    for ch in chains:
+        sp = stage_spans(ch)
+        if sp is None:
+            continue
+        key = ("all" if by == "none"
+               else f"tenant{ch['tenant']}" if by == "tenant"
+               else ch["klass"])
+        g = groups.setdefault(key, {s: [] for s in STAGES})
+        for s, ms in sp.items():
+            g[s].append(ms)
+    table = [[by, "stage", "txns", "p50_ms", "p95_ms", "p99_ms",
+              "mean_ms"]]
+    for key in sorted(groups):
+        for s in STAGES:
+            vals = np.asarray(groups[key][s])
+            if not len(vals):
+                continue
+            table.append([
+                key, s, str(len(vals)),
+                f"{weighted_nearest_rank(vals, None, 50):.3f}",
+                f"{weighted_nearest_rank(vals, None, 95):.3f}",
+                f"{weighted_nearest_rank(vals, None, 99):.3f}",
+                f"{vals.mean():.3f}"])
+    return table
+
+
+def render(table: list[list[str]], tsv: bool = False) -> str:
+    if len(table) <= 1:
+        return "(no complete sampled txn chains — telemetry off, or " \
+               "no sampled txn committed?)"
+    if tsv:
+        return "\n".join("\t".join(r) for r in table)
+    widths = [max(len(r[i]) for r in table) for i in range(len(table[0]))]
+    return "\n".join("  ".join(c.ljust(w) if i < 2 else c.rjust(w)
+                               for i, (c, w) in enumerate(zip(r, widths)))
+                     for r in table)
+
+
+def chrome_trace(recs: np.ndarray, roles: dict[int, str] | None = None
+                 ) -> dict:
+    """Flow-linked Chrome trace: per-node "txn" tracks (the registry's
+    TXN_TRACK beside the [timeline] phase tracks) carrying each sampled
+    txn's stage spans at WALL timestamps, flow arrows (s/t/f events)
+    crossing from the client's send through the server hops back to the
+    ack, and instant markers for replica epoch-applies."""
+    roles = roles or {}
+    events: list[dict] = []
+    if not len(recs):
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    t0 = int(recs["t_us"].min())
+    nodes = sorted({int(n) for n in recs["node"]})
+    for n in nodes:
+        events.append({"name": "process_name", "ph": "M", "pid": n,
+                       "tid": 0,
+                       "args": {"name": f"{roles.get(n, 'node')} {n}"}})
+        events.append({"name": "thread_name", "ph": "M", "pid": n,
+                       "tid": TXN_TRACK.tid,
+                       "args": {"name": TXN_TRACK.name}})
+    tid = TXN_TRACK.tid
+    for tag, ev in index_txns(recs).items():
+        ch = build_chain(ev)
+        if ch["verdict"] is None or ch["send"] is None:
+            continue
+        sp = stage_spans(ch)
+        if sp is None:
+            continue
+        rel = ch["release"] if ch["release"] is not None \
+            else ch["verdict"]
+        args = {"tag": tag, "epoch": ch["epoch"], "class": ch["klass"],
+                "resends": ch["resend_cnt"]}
+        # stage spans land on the node that OWNS the stage's end
+        placed = (
+            ("send-admit", ch["send"], ch["admit"], ch["server"]),
+            ("admit-batch", ch["admit"], ch["batch"], ch["server"]),
+            ("batch-verdict", ch["batch"], ch["verdict"], ch["server"]),
+            ("verdict-release", ch["verdict"], rel, ch["server"]),
+            ("release-ack", rel, ch["ack"], ch["client"]),
+        )
+        for name, a, b, pid in placed:
+            events.append({"name": name, "ph": "X", "pid": pid,
+                           "tid": tid, "ts": round((a - t0), 3),
+                           "dur": round(b - a, 3), "cat": "txn",
+                           "args": args})
+        # flow arrows across the node tracks: one chain per txn
+        fid = str(tag)
+        flow = [("s", ch["send"], ch["client"]),
+                ("t", ch["admit"], ch["server"]),
+                ("t", ch["verdict"], ch["server"]),
+                ("f", ch["ack"], ch["client"])]
+        for ph, t, pid in flow:
+            e = {"name": "txn", "ph": ph, "id": fid, "pid": pid,
+                 "tid": tid, "ts": round(t - t0, 3), "cat": "txnflow"}
+            if ph == "f":
+                e["bp"] = "e"
+            events.append(e)
+    for epoch, evs in apply_times(recs).items():
+        for node, t in evs:
+            events.append({"name": "apply", "ph": "i", "pid": node,
+                           "tid": tid, "ts": round(t - t0, 3), "s": "t",
+                           "cat": "txn", "args": {"epoch": epoch}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv: list[str]) -> int:
+    if not argv or argv[0].startswith("-"):
+        print("usage: python -m deneva_tpu.harness.txntrace "
+              "<sidecar-dir> [--by verdict|tenant|none] [--tsv] "
+              "[--trace out.json]", file=sys.stderr)
+        return 2
+    by = "verdict"
+    if "--by" in argv:
+        i = argv.index("--by")
+        if i + 1 >= len(argv) or argv[i + 1] not in ("verdict", "tenant",
+                                                     "none"):
+            print("--by needs verdict|tenant|none", file=sys.stderr)
+            return 2
+        by = argv[i + 1]
+    trace_out = None
+    if "--trace" in argv:
+        i = argv.index("--trace")
+        if i + 1 >= len(argv):
+            print("--trace needs an output path", file=sys.stderr)
+            return 2
+        trace_out = argv[i + 1]
+    recs, roles = load_dir(argv[0])
+    if not len(recs):
+        print(f"(no telemetry_*.bin records under {argv[0]} — run with "
+              "--telemetry=true)")
+        return 1
+    chains = [build_chain(ev) for ev in index_txns(recs).values()]
+    if trace_out is not None:
+        with open(trace_out, "w") as f:
+            json.dump(chrome_trace(recs, roles), f)
+        print(f"wrote {len(chains)} sampled txns "
+              f"({len(recs)} events) to {trace_out}")
+        return 0
+    committed, full, viol = completeness(chains)
+    print(render(waterfall(chains, by), tsv="--tsv" in argv))
+    print(f"\n{len(chains)} sampled txns, {committed} committed, "
+          f"{full} full quorum chains, {len(viol)} chain violations")
+    for v in viol[:20]:
+        print(f"  VIOLATION: {v}")
+    return 1 if viol else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

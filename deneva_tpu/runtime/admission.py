@@ -35,7 +35,7 @@ import numpy as np
 
 from deneva_tpu.config import Config
 from deneva_tpu.runtime.loadgen import tenant_of_tags
-from deneva_tpu.stats import StatsArr
+from deneva_tpu.stats import StatsArr, weighted_nearest_rank
 
 # ---- ADMIT_NACK codec --------------------------------------------------
 # tags (int64[n]) + per-tag retry-after hints (uint32[n], microseconds).
@@ -215,10 +215,7 @@ class AdmissionController:
                            np.float64)
             self.delay_ms.extend(d / 1e3, w)
             if self.slo_us > 0:
-                order = np.argsort(d, kind="stable")
-                cum = np.cumsum(w[order])
-                idx = int(np.searchsorted(cum, 0.99 * cum[-1]))
-                p99 = float(d[order][min(idx, len(d) - 1)])
+                p99 = weighted_nearest_rank(d, w, 99.0)
                 self.slo_breached = p99 > self.slo_us
                 if self.slo_breached:
                     self.breach_groups += 1

@@ -25,6 +25,8 @@ import numpy as np
 from deneva_tpu.config import Config
 from deneva_tpu.runtime import wire
 from deneva_tpu.runtime.native import NativeTransport
+from deneva_tpu.runtime.telemetry import (ST_ACK, ST_BACKOFF, ST_RESEND,
+                                          ST_SEND, V_SHED, telemetry_line)
 from deneva_tpu.stats import Stats
 
 TAG_RING = 1 << 22            # outstanding-tag ring per client: must
@@ -158,6 +160,16 @@ class ClientNode:
             self._fr_ver_viol = 0      # row version stamp > boundary
             if cfg.geo_wan_us:
                 georepl.apply_wan_profile(self.tp, cfg, self.me)
+        # ---- transaction flight recorder (runtime/telemetry.py — off
+        # on a default config: no recorder, no sidecar, no [telemetry]
+        # line; the send path is untouched byte for byte).  The client
+        # records the SAME deterministically sampled txns every server
+        # picks (lane % telemetry_sample), keyed by the packed
+        # ``me << 40 | tag`` id the servers stamp at admission. ----
+        self.tel = None
+        if cfg.telemetry:
+            from deneva_tpu.runtime.telemetry import FlightRecorder
+            self.tel = FlightRecorder(cfg, self.me, "client")
         # elastic + fault mode: remember which server each tag's inflight
         # credit is CHARGED to.  After a retarget, the first ack may come
         # from a different server than the charge (the drained-but-alive
@@ -303,6 +315,11 @@ class ClientNode:
                 for t in np.unique(tn):
                     m = tn == t
                     self.stats.arr(f"tenant{t}_latency").extend(vals[m])
+            if self.tel is not None:
+                # first-ack lifecycle hop (post-freshness: dup acks
+                # never record)
+                self.tel.record((np.int64(self.me) << 40) | tags,
+                                ST_ACK, t_us=now)
             self.stats.incr("txn_cnt", len(tags))
         elif rtype == "ADMIT_NACK":
             from deneva_tpu.runtime.admission import decode_admit_nack
@@ -325,6 +342,14 @@ class ClientNode:
                 )[: self.n_srv]
             else:
                 self.inflight[src] -= len(tags)
+            if self.tel is not None:
+                # shed lifecycle hop (aux = the server's retry-after
+                # hint; the waterfall's "shed" verdict class keys on it)
+                self.tel.record(
+                    (np.int64(self.me) << 40) | tags, ST_BACKOFF,
+                    verdict=V_SHED,
+                    aux=retry.clip(max=0x7FFFFFFF).astype(np.int32),
+                    t_us=now_us)
             # re-entry rides the backoff ledger (exponential + jitter,
             # floored at the server's per-tag retry-after hints)
             self._ledger.nack(src, tags, retry, now_us)
@@ -413,6 +438,11 @@ class ClientNode:
             self.tp.sendv(srv, "CL_QRY_BATCH",
                           wire.qry_block_parts(sub.tags, sub.keys,
                                                sub.types, sub.scalars))
+            if self.tel is not None:
+                # loss-repair resend hop (latency still measures from
+                # the FIRST send; this marks the tail's cause)
+                self.tel.record((np.int64(self.me) << 40) | sub.tags,
+                                ST_RESEND, t_us=now)
             self._resend_cnt += len(sub)
             self._resend_q.append((now, srv, sub))
 
@@ -473,6 +503,10 @@ class ClientNode:
                     self._resend_q.append((now_us, srv, wire.QueryBlock(
                         blk.keys[:n], blk.types[:n], blk.scalars[:n],
                         part)))
+                if self.tel is not None:
+                    # backoff re-entry hop: the shed tag re-offers
+                    self.tel.record((np.int64(self.me) << 40) | part,
+                                    ST_RESEND, t_us=now_us)
                 self._nack_resend_cnt += n
 
     # -- geo tier: nearest-primary writes + follower snapshot reads -----
@@ -619,6 +653,13 @@ class ClientNode:
                               wire.qry_block_parts(wtags, blk.keys[:n],
                                                    blk.types[:n],
                                                    blk.scalars[:n]))
+                if self.tel is not None:
+                    # first-send lifecycle hop: the sampled subset here
+                    # is exactly what every server will sample (same
+                    # lane predicate), keyed by the packed id admission
+                    # stamps
+                    self.tel.record((np.int64(self.me) << 40) | wtags,
+                                    ST_SEND, t_us=now)
                 if self._unacked is not None:
                     self._unacked[tags] = True
                     if self._nacked is not None:
@@ -650,6 +691,11 @@ class ClientNode:
                 if now_us >= self._bo_next_us:
                     self._backoff_sweep(now_us)
                     self._bo_next_us = now_us + self._bo_sweep_us
+            if self.tel is not None and self.tel.should_flush:
+                # half-full ring flush (the server does this at group
+                # boundaries): a saturated multi-second run otherwise
+                # fills the ring and silently drops the tail's acks
+                self.tel.flush()
             self._drain(lat, timeout_us=0 if progressed else 2_000)
         # drain trailing responses so server-side commits are counted
         t_end = time.monotonic() + 0.3
@@ -687,6 +733,12 @@ class ClientNode:
                 a = st.arrays.get(f"tenant{t}_latency")
                 st.set(f"tenant{t}_acked_cnt",
                        float(len(a)) if a is not None else 0.0)
+        if self.tel is not None:
+            # flight-recorder flush + counters + the [telemetry] line
+            # (same emission contract as the servers')
+            self.tel.flush()
+            self.tel.summary_into(st)
+            print(telemetry_line(self.me, self.tel.fields()), flush=True)
         if self._elastic:
             st.set("map_version", float(self._map_version))
             st.set("redirect_resend_cnt", float(self._redirect_resends))

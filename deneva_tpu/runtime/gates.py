@@ -149,6 +149,20 @@ GATES: dict[str, GateSpec] = {s.name: s for s in (
         use_calls=("set_fault", "set_partition", "set_peer_stall_us"),
     ),
     GateSpec(
+        "telemetry",
+        # transaction flight recorder (runtime/telemetry.py):
+        # deterministic tag-sampled lifecycle events + the per-epoch
+        # metrics stream.  telemetry_sample/telemetry_ring/telemetry_dir
+        # are depth knobs with live defaults (like repair_rounds) —
+        # arming is `telemetry` alone.  `tel` is the recorder handle on
+        # every node kind (None until armed — `self.tel is not None` is
+        # the canonical gate); `_metrics` the server's stream.
+        flags=("telemetry",),
+        guards=("telemetry", "_telemetry"),
+        home=("deneva_tpu/runtime/telemetry.py",),
+        use_attrs=("tel", "_metrics"),
+    ),
+    GateSpec(
         "fencing",
         # partition & gray-failure tolerance: heartbeat failure
         # detection, fenced slot ownership, quorum reassignment

@@ -87,6 +87,11 @@ OWNER: dict[str, str] = {
     # retire worker PREFETCH returns the plane; _retire consumes it)
     "_repair": DISPATCH, "_rep_salvaged": DISPATCH,
     "_rep_meas": DISPATCH, "_rep_span": DISPATCH,
+    # transaction flight recorder (runtime/telemetry.py): every hook
+    # point — _route admit, the contribution call sites, _retire's
+    # verdict/hold pass, _flush_held_rsp's release — runs on the
+    # dispatch thread; workers never touch the ring or the stream
+    "tel": DISPATCH, "_metrics": DISPATCH,
     # fencing layer (runtime/faildet.py): detector, heartbeat ledgers
     # and fence counters all live on the dispatch thread (_route runs
     # there; workers only READ smap/_FD for the envelope header)

@@ -33,6 +33,7 @@ from deneva_tpu.config import Config
 from deneva_tpu.runtime import replication as georepl
 from deneva_tpu.runtime import wire
 from deneva_tpu.runtime.native import NativeTransport
+from deneva_tpu.runtime.telemetry import ST_APPLY, telemetry_line
 from deneva_tpu.stats import Stats
 
 _EPOCH_HDR = struct.Struct("<Iq")   # magic, epoch (prefix of logger._FRAME)
@@ -79,6 +80,13 @@ class ReplicaNode:
             self.tp.set_delay_us(int(cfg.net_delay_us))
         if self._geo and cfg.geo_wan_us:
             georepl.apply_wan_profile(self.tp, cfg, self.me)
+        # flight recorder (runtime/telemetry.py — off by default): the
+        # replica's per-epoch durability apply is an epoch-scoped event
+        # (tag = -1) the txntrace merger joins to sampled txns by epoch
+        self.tel = None
+        if cfg.telemetry:
+            from deneva_tpu.runtime.telemetry import FlightRecorder
+            self.tel = FlightRecorder(cfg, self.me, "replica")
         self.log_path = os.path.join(cfg.log_dir,
                                      f"replica{self.me}.log.bin")
         os.makedirs(cfg.log_dir, exist_ok=True)
@@ -110,6 +118,8 @@ class ReplicaNode:
                 # region loss: die BEFORE appending the boundary record,
                 # so the log stays clean to the previous boundary (the
                 # same crash model as the server's fault_kill)
+                if self.tel is not None:
+                    self.tel.flush()   # events intact to the boundary
                 os._exit(17)
             self._f.write(payload)
             self._f.flush()
@@ -124,6 +134,13 @@ class ReplicaNode:
                 self.tp.send(src, "LOG_RSP", wire.encode_shutdown(epoch))
             self.stats.incr("log_records")
             self.stats.incr("log_bytes", len(payload))
+            if self.tel is not None:
+                # replica-apply lifecycle hop: this epoch's record is
+                # durable here (the ack above is what the primary's
+                # quorum gate counts)
+                self.tel.record_event(ST_APPLY, int(epoch))
+                if self.tel.should_flush:
+                    self.tel.flush()
         elif rtype == "REGION_READ":
             # follower snapshot read: serve the last applied group
             # boundary (consistent by construction — groups apply
@@ -207,6 +224,10 @@ class ReplicaNode:
             self.stats.set("geo_region", float(self.region))
         if self._fencing:
             self.stats.set("fence_nack_cnt", float(self._fence_nacks))
+        if self.tel is not None:
+            self.tel.flush()
+            self.tel.summary_into(self.stats)
+            print(telemetry_line(self.me, self.tel.fields()), flush=True)
         self._f.close()
         self.stats.set("total_runtime", time.monotonic() - t0)
         return self.stats

@@ -44,6 +44,10 @@ import numpy as np
 from deneva_tpu.config import CCAlg, Config
 from deneva_tpu.runtime import replication as georepl
 from deneva_tpu.runtime import wire
+from deneva_tpu.runtime.telemetry import (ST_ADMIT, ST_BATCH, ST_HOLD,
+                                          ST_RELEASE, ST_VERDICT, V_ABORT,
+                                          V_COMMIT, V_DEFER, V_SALVAGE,
+                                          telemetry_line)
 from deneva_tpu.runtime.native import NativeTransport
 from deneva_tpu.stats import Stats
 
@@ -622,6 +626,22 @@ class ServerNode:
             self.adm = AdmissionController(cfg,
                                            time.monotonic_ns() // 1000)
 
+        # ---- transaction flight recorder (runtime/telemetry.py — off
+        # on a default config: no recorder, no sidecar, no [telemetry]
+        # line, no metrics stream; every wire/log byte bit-identical).
+        # Recovery appends to the pre-crash sidecars like the command
+        # log: events intact to the kill boundary survive the restart.
+        self.tel = None
+        self._metrics = None
+        if cfg.telemetry:
+            from deneva_tpu.runtime import telemetry as _T
+            self.tel = _T.FlightRecorder(cfg, self.me, "node",
+                                         append=cfg.recover)
+            self._metrics = _T.MetricsStream(
+                os.path.join(_T.telemetry_dir(cfg),
+                             f"metrics_node{self.me}.jsonl"),
+                self.me, append=cfg.recover)
+
         # ---- chaos / failover gates (all off on a default config) ------
         # _failover: peers tolerate a dead server and wait for its
         # recovered incarnation instead of raising; acks gate on whole-
@@ -894,6 +914,14 @@ class ServerNode:
                 blk = self._admission_gate(src, blk)
                 if blk is None:
                     return
+            if self.tel is not None:
+                # flight recorder: the "admission pop" lifecycle hop —
+                # the sampled tags (same lane predicate the client used)
+                # entered this server's pending queue.  Keyed on the
+                # packed id the contribution path stamps.
+                self.tel.record(
+                    (np.int64(src) << 40) | (blk.tags & _TAG_MASK),
+                    ST_ADMIT)
             self.pending.append((src, blk))
         elif rtype == "EPOCH_BLOB":
             if self._fencing:
@@ -1245,6 +1273,10 @@ class ServerNode:
                        "epoch": int(epoch),
                        "map_version": int(self.smap.version),
                        "last_acked_epoch": int(self._fence_last_ack)}, f)
+        if self.tel is not None:
+            # the fenced node's lifecycle events stay auditable
+            self.tel.flush()
+            self._metrics.close()
         self.tp.flush()
         os._exit(self._FD.FENCED_EXIT)
 
@@ -1630,11 +1662,17 @@ class ServerNode:
                     break
                 if e > self._fence_last_ack:
                     self._fence_last_ack = e
-            c, _, tags = self._held_rsp.popleft()
+            c, e_rel, tags = self._held_rsp.popleft()
             if self._dedup_on:
                 # the ack is now safe to (re-)issue: only here do the
                 # packed ids gain re-ack authority in the committed set
                 self._retire_dedup((np.int64(c) << 40) | tags)
+            if self.tel is not None:
+                # quorum hold -> release hop: the epoch went durable
+                # (and, under fencing, its ack lease confirmed) — the
+                # CL_RSP leaves right below
+                self.tel.record((np.int64(c) << 40) | tags, ST_RELEASE,
+                                epoch=e_rel)
             # scatter-send parts: identical wire bytes, no encode copy
             self.tp.sendv(c, "CL_RSP", wire.cl_rsp_parts(tags))
 
@@ -2102,6 +2140,37 @@ class ServerNode:
                 self.tp.send(self.n_srv + c, "MAP_UPDATE", msg)
             self.tp.flush()
 
+    # -- flight recorder: verdict-plane hop ------------------------------
+    def _tel_verdicts(self, epoch: int, block: wire.QueryBlock,
+                      commit: np.ndarray, ab: np.ndarray, df: np.ndarray,
+                      rep_row: np.ndarray | None, abort_cnt: np.ndarray,
+                      t_us: int) -> None:
+        """One ST_VERDICT event per sampled txn that got a verdict this
+        epoch — verdict code says which plane (commit / salvage / abort
+        / defer; aux carries the txn's restart count so the waterfall
+        can split first-try from retried commits) — plus the ST_HOLD
+        quorum-gate event for committed tags whose CL_RSP is held for
+        group-commit durability (released in ``_flush_held_rsp``)."""
+        tags = block.tags
+        sampled = self.tel.mask(tags)
+        m = sampled & (commit | ab | df)
+        if m.any():
+            v = np.zeros(len(tags), np.uint8)
+            v[commit] = V_COMMIT
+            if rep_row is not None:
+                v[commit & rep_row] = V_SALVAGE
+            v[ab] = V_ABORT
+            v[df] = V_DEFER
+            self.tel.record(tags[m], ST_VERDICT, epoch=epoch,
+                            verdict=v[m],
+                            aux=abort_cnt[m].astype(np.int32),
+                            t_us=t_us)
+        if self.logger is not None:
+            held = sampled & commit
+            if held.any():
+                self.tel.record(tags[held], ST_HOLD, epoch=epoch,
+                                t_us=t_us)
+
     # -- verdict retirement (the back half of an epoch) ------------------
     def _retire(self, group: dict, tl) -> None:
         """Fetch a dispatched group's commit masks (ONE host<->device
@@ -2136,6 +2205,12 @@ class ServerNode:
                 group["eps"]):
             n = len(block)
             my_commit = done[i, lo:lo + n]
+            # flight recorder: stamp the verdict time BEFORE any of this
+            # epoch's CL_RSPs leave — on a same-box mesh the client's
+            # first-ack record would otherwise beat a post-send verdict
+            # record by microseconds and read as an ordering inversion
+            tel_t = time.monotonic_ns() // 1000 \
+                if self.tel is not None else 0
             if rep is not None:
                 # repaired-plane accounting (host cross-check of the
                 # device rep_salvaged_cnt; surfaces as the [repair]
@@ -2221,6 +2296,24 @@ class ServerNode:
             # exact unique-txn aborts (stats.h:60-61): first abort of a
             # txn is the one whose retry counter is still zero
             self._uniq_aborts += int((ab & (abort_cnt == 0)).sum())
+            if self.tel is not None:
+                self._tel_verdicts(epoch, block, my_commit, ab, df,
+                                   rep[i, lo:lo + n]
+                                   if rep is not None else None,
+                                   abort_cnt, tel_t)
+            if self._metrics is not None:
+                # per-epoch structured counter stream — the [summary]
+                # aggregates as a time series, host-side numbers only
+                self._metrics.emit(
+                    epoch, commit=int(my_commit.sum()),
+                    abort=int(ab.sum()), defer=int(df.sum()),
+                    salvaged=int((rep[i, lo:lo + n] & my_commit).sum())
+                    if rep is not None else 0,
+                    retry_depth=len(self.retry.items),
+                    pending=len(self.pending),
+                    held_rsp=len(self._held_rsp),
+                    adm_depth=self.adm.depth
+                    if self.adm is not None else 0)
             restart = ab | df
             if restart.any():
                 idx = np.where(restart)[0]
@@ -2342,6 +2435,12 @@ class ServerNode:
                         for f in g.get("wire_futs", ()):
                             f.result()
                     self.logger.wait_flushed(epoch0 - 1, timeout=10.0)
+                if self.tel is not None:
+                    # crash-model parity with the command log: lifecycle
+                    # events intact to the kill boundary survive in the
+                    # sidecar (the restarted incarnation appends)
+                    self.tel.flush()
+                    self._metrics.close()
                 if self._elastic:
                     # reassignment (instead of restart) needs every
                     # survivor to stall at the SAME first-missing epoch:
@@ -2415,6 +2514,11 @@ class ServerNode:
                         self._drain()
                     block, abort_cnt, birth_ts, dfc = \
                         self._contribution_into(e, fs, i)
+                    if self.tel is not None:
+                        # epoch-batch assignment hop (retries re-record
+                        # at their re-entry epoch — the span tree keeps
+                        # the committing pass's batch)
+                        self.tel.record(block.tags, ST_BATCH, epoch=e)
                     if self.n_srv > 1:
                         wire_futs.append(self.wire_pool.submit(
                             self._bcast_views, e, block, birth_ts))
@@ -2432,6 +2536,9 @@ class ServerNode:
                             self._drain()
                         block, abort_cnt, birth_ts, dfc = \
                             self._contribution(e)
+                        if self.tel is not None:
+                            # same epoch-batch hop, serial path
+                            self.tel.record(block.tags, ST_BATCH, epoch=e)
                         if self.codec_pool is not None and self.n_srv > 1:
                             futs.append(self.codec_pool.submit(
                                 _bcast, e, block, birth_ts))
@@ -2626,6 +2733,10 @@ class ServerNode:
                 print(f"node {self.me} " + make_prog_line(
                     now - t_start, c, {"epoch_cnt": float(group_end)}),
                     flush=True)
+            if self.tel is not None and self.tel.should_flush:
+                # half-full ring flush at the group boundary: drops only
+                # ever count when a single group outruns half the ring
+                self.tel.flush()
             if self.adm is not None:
                 # per-group SLO tick: quantile the group's queue-delay
                 # samples, re-arm/clear the shed-over-quota state, and
@@ -2775,6 +2886,15 @@ class ServerNode:
             self.adm.summary_into(st)
             for line in self.adm.admission_lines(self.me):
                 print(line, flush=True)
+        if self.tel is not None:
+            # flight-recorder counters ([summary]) + the [telemetry]
+            # line (parsed by harness.parse.parse_telemetry); the final
+            # flush closes the sidecar the txntrace merger joins
+            self.tel.flush()
+            self._metrics.close()
+            self.tel.summary_into(st)
+            st.set("metrics_lines", float(self._metrics.lines))
+            print(telemetry_line(self.me, self.tel.fields()), flush=True)
         if self._fencing:
             # fencing counters ([summary]) + the [fencing] line (parsed
             # by harness.parse.parse_fencing) + the sidecar the chaos

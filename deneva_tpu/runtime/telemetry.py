@@ -1,0 +1,309 @@
+"""Transaction flight recorder (cross-node txn lifecycle tracing).
+
+Every performance claim so far rests on end-of-run ``[summary]``
+aggregates and per-epoch ``[timeline]`` phase lines — *what* the p99 is,
+never *where* one transaction spent it.  This module is the missing
+instrument: the latency-decomposition view the source paper's evaluation
+is built on (Harding et al., VLDB 2017 break down txn time per stage),
+applied to the epoch-batched cluster.
+
+Design points:
+
+* **Deterministic tag-based sampling, zero coordination.**  A txn's tag
+  carries its client ring lane in bits 0..23 (tenant ids ride 24..31,
+  the home client's transport id 40..); ``lane % telemetry_sample == 0``
+  is computable identically by the client (raw tag), every server
+  (packed ``client << 40 | tag``) and the merger — so all nodes record
+  the SAME txn subset without exchanging a single byte.
+* **Preallocated record rings, drop-not-stall.**  Events append into a
+  fixed numpy structured array; a full ring drops (and counts) rather
+  than blocking the epoch loop.  The owner flushes at half-full from
+  its loop and at exit, appending raw records to a per-node
+  ``telemetry_*.bin`` sidecar (header + packed ``REC_DTYPE`` rows).
+* **One shared clock.**  ``t_us`` is CLOCK_MONOTONIC microseconds,
+  which Linux shares across processes on one box — the single-box
+  launcher rig joins cross-node spans exactly.  Multi-host fleets need
+  external clock alignment (the sidecar header carries the node id so a
+  per-host offset can be applied at merge time).
+* **Structured metrics stream.**  Servers append one JSON line per
+  retired epoch to ``metrics_node*.jsonl`` — the counters that
+  previously existed only at exit in ``[summary]`` (commit/abort/defer/
+  salvage, queue depths) become a time series cheap enough to leave on.
+
+With ``telemetry=false`` (default) nothing here is constructed: no
+recorder, no sidecar, no ``[telemetry]`` line, and every wire/log byte
+is bit-identical to the pre-telemetry runtime (wire pin test in
+tests/test_telemetry.py; gate registry runtime/gates.py).
+
+Join + render the sidecars with ``python -m deneva_tpu.harness.txntrace``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+
+import numpy as np
+
+from deneva_tpu.config import Config
+from deneva_tpu.stats import tagged_line
+
+# lane bits of a tag (below the tenant byte at 24..31): the sampling key
+# every node derives identically from its own view of the tag
+LANE_MASK = np.int64((1 << 24) - 1)
+
+# ---- lifecycle stages --------------------------------------------------
+# client-side                     server-side                 replica
+ST_SEND = 0        # CL_QRY_BATCH left the client
+ST_RESEND = 1      # fault-mode resend sweep / backoff re-entry
+ST_BACKOFF = 2     # ADMIT_NACK received; aux = retry-after hint (us)
+ST_ACK = 3         # first CL_RSP accepted for the tag
+ST_ADMIT = 4       # server popped the batch off the transport into pending
+ST_BATCH = 5       # txn assigned to a merged epoch batch (epoch = which)
+ST_VERDICT = 6     # CC verdict retired; verdict field says which plane
+ST_HOLD = 7        # CL_RSP held for group-commit durability (quorum gate)
+ST_RELEASE = 8     # held CL_RSP released (epoch durable + lease ok)
+ST_APPLY = 9       # replica appended/applied the epoch record (tag = -1:
+#                    an epoch-scoped event, joined to txns by epoch)
+
+STAGE_NAMES = ("send", "resend", "backoff", "ack", "admit", "batch",
+               "verdict", "hold", "release", "apply")
+
+# ---- verdict plane codes (the ST_VERDICT event's verdict field) --------
+V_NONE, V_COMMIT, V_ABORT, V_DEFER, V_SALVAGE, V_SHED = range(6)
+VERDICT_NAMES = ("none", "commit", "abort", "defer", "salvage", "shed")
+
+# one record = 32 bytes, little-endian, no padding surprises (explicit
+# field order keeps numpy's default alignment already tight)
+REC_DTYPE = np.dtype([
+    ("tag", "<i8"),     # packed txn id (client << 40 | tag); -1 = epoch event
+    ("t_us", "<i8"),    # CLOCK_MONOTONIC microseconds
+    ("epoch", "<i4"),   # merged epoch (-1 where unknown, e.g. admit)
+    ("aux", "<i4"),     # stage-specific (retry hint us, abort count, ...)
+    ("node", "<i2"),    # recording node's transport id
+    ("stage", "<u1"),   # ST_*
+    ("verdict", "<u1"), # V_* (ST_VERDICT events; V_NONE elsewhere)
+    ("pad", "<u4"),
+])
+
+_HDR = struct.Struct("<4sHh8s")     # magic, version, node, role
+_MAGIC = b"DTEL"
+_VERSION = 1
+
+
+def telemetry_dir(cfg: Config) -> str:
+    """Sidecar directory: ``telemetry_dir`` or the (possibly run-
+    namespaced) ``log_dir`` — one place per run, like the command logs."""
+    return cfg.telemetry_dir or cfg.log_dir
+
+
+def sampled_mask(tags: np.ndarray, sample: int) -> np.ndarray:
+    """The one sampling predicate (client, servers and merger must
+    agree): true where the tag's ring-lane bits hash into the sample."""
+    return (np.asarray(tags, np.int64) & LANE_MASK) % sample == 0
+
+
+def now_us() -> int:
+    return time.monotonic_ns() // 1000
+
+
+class FlightRecorder:
+    """Per-node lifecycle event ring + binary sidecar writer.
+
+    Mutated only from its owner's dispatch thread (the same ownership
+    discipline as ``pending``): every hook point in client/server/
+    replica runs there, so no lock is needed on the hot path.
+    """
+
+    def __init__(self, cfg: Config, node: int, role: str,
+                 append: bool = False):
+        self.sample = max(1, cfg.telemetry_sample)
+        self.cap = max(1024, cfg.telemetry_ring)
+        self.node = node
+        self.role = role
+        d = telemetry_dir(cfg)
+        os.makedirs(d, exist_ok=True)
+        self.path = os.path.join(d, f"telemetry_{role}{node}.bin")
+        if not append:
+            # fresh run: truncate (recovery appends — the pre-crash
+            # events survive the restart exactly like the command log)
+            with open(self.path, "wb"):
+                pass
+        elif os.path.exists(self.path):
+            # recovery: truncate a torn tail (hard crash mid-write) to
+            # a whole-record boundary BEFORE appending, or every
+            # post-recovery record would parse frame-shifted — the same
+            # truncate-then-append discipline as the command log
+            size = os.path.getsize(self.path)
+            if size <= _HDR.size:
+                whole = 0          # partial header: flush rewrites it
+            else:
+                whole = _HDR.size + (size - _HDR.size) \
+                    // REC_DTYPE.itemsize * REC_DTYPE.itemsize
+            if whole != size:
+                with open(self.path, "ab") as f:
+                    f.truncate(whole)
+        self.buf = np.zeros(self.cap, REC_DTYPE)
+        self.n = 0
+        self.sampled_cnt = 0
+        self.dropped_cnt = 0
+        self.highwater = 0
+        self.flush_s = 0.0
+
+    # -- recording -------------------------------------------------------
+    def mask(self, tags: np.ndarray) -> np.ndarray:
+        return sampled_mask(tags, self.sample)
+
+    def record(self, tags, stage: int, epoch: int = -1, verdict=V_NONE,
+               aux=0, t_us: int | None = None) -> int:
+        """Append one event per SAMPLED tag; ``verdict``/``aux`` may be
+        scalars or arrays aligned with ``tags`` (filtered alongside).
+        Returns the number of events recorded (drops count, not raise)."""
+        tags = np.asarray(tags, np.int64).ravel()
+        m = (tags & LANE_MASK) % self.sample == 0
+        k = int(m.sum())
+        if k == 0:
+            return 0
+        if k < len(tags):
+            tags = tags[m]
+            if isinstance(verdict, np.ndarray):
+                verdict = verdict[m]
+            if isinstance(aux, np.ndarray):
+                aux = aux[m]
+        return self._append(tags, stage, epoch, verdict, aux, t_us)
+
+    def record_event(self, stage: int, epoch: int, aux=0,
+                     t_us: int | None = None) -> int:
+        """Epoch-scoped event (tag = -1, bypasses sampling): e.g. a
+        replica's per-epoch apply.  The merger joins it to every sampled
+        txn of that epoch."""
+        return self._append(np.full(1, -1, np.int64), stage, epoch,
+                            V_NONE, aux, t_us)
+
+    def _append(self, tags: np.ndarray, stage: int, epoch: int, verdict,
+                aux, t_us: int | None) -> int:
+        k = len(tags)
+        self.sampled_cnt += k
+        room = self.cap - self.n
+        if k > room:
+            self.dropped_cnt += k - room
+            tags = tags[:room]
+            if isinstance(verdict, np.ndarray):
+                verdict = verdict[:room]
+            if isinstance(aux, np.ndarray):
+                aux = aux[:room]
+            k = room
+            if k == 0:
+                return 0
+        sl = self.buf[self.n:self.n + k]
+        sl["tag"] = tags
+        sl["t_us"] = now_us() if t_us is None else t_us
+        sl["epoch"] = epoch
+        sl["aux"] = aux
+        sl["node"] = self.node
+        sl["stage"] = stage
+        sl["verdict"] = verdict
+        self.n += k
+        if self.n > self.highwater:
+            self.highwater = self.n
+        return k
+
+    # -- flushing --------------------------------------------------------
+    @property
+    def should_flush(self) -> bool:
+        return self.n >= self.cap // 2
+
+    def flush(self) -> None:
+        """Append pending records to the sidecar (header once) and empty
+        the ring.  Called from the owner's loop at half-full, at the
+        planned-kill boundary (the crash model is "events intact to the
+        boundary", like the command log) and at exit."""
+        t0 = time.monotonic()
+        with open(self.path, "ab") as f:
+            if f.tell() == 0:
+                f.write(_HDR.pack(_MAGIC, _VERSION, self.node,
+                                  self.role.encode()[:8].ljust(8, b"\0")))
+            f.write(self.buf[:self.n].tobytes())
+        self.n = 0
+        self.flush_s += time.monotonic() - t0
+
+    # -- reporting -------------------------------------------------------
+    def fields(self) -> dict:
+        return {"sampled_cnt": self.sampled_cnt,
+                "dropped_cnt": self.dropped_cnt,
+                "ring_highwater": self.highwater,
+                "flush_ms": round(self.flush_s * 1e3, 3),
+                "sample": self.sample}
+
+    def summary_into(self, st) -> None:
+        st.set("tel_sampled_cnt", float(self.sampled_cnt))
+        st.set("tel_dropped_cnt", float(self.dropped_cnt))
+        st.set("tel_ring_highwater", float(self.highwater))
+        st.set("tel_flush_ms", self.flush_s * 1e3)
+
+
+def telemetry_line(node: int, fields: dict) -> str:
+    """The ``[telemetry]`` summary line (parsed by
+    ``harness.parse.parse_telemetry`` under the standard ignore-unknown-
+    tags forward/backward-compat contract)."""
+    return tagged_line("telemetry", {"node": node, **fields})
+
+
+def read_telemetry(path: str) -> tuple[dict, np.ndarray]:
+    """Load one sidecar: ({node, role, version}, records).  A torn tail
+    (hard crash mid-write) truncates to whole records."""
+    with open(path, "rb") as f:
+        buf = f.read()
+    if len(buf) < _HDR.size:
+        return {"node": -1, "role": "", "version": 0}, \
+            np.zeros(0, REC_DTYPE)
+    magic, version, node, role = _HDR.unpack_from(buf)
+    if magic != _MAGIC:
+        raise ValueError(f"{path}: not a telemetry sidecar")
+    body = len(buf) - _HDR.size
+    count = body // REC_DTYPE.itemsize
+    recs = np.frombuffer(buf, REC_DTYPE, count=count, offset=_HDR.size)
+    return {"node": node, "role": role.rstrip(b"\0").decode(),
+            "version": version}, recs
+
+
+class MetricsStream:
+    """Per-epoch structured counter stream (``metrics_node*.jsonl``).
+
+    One JSON object per retired epoch — host-side counters only (no
+    device fetch is ever added to the loop), so the cost is one dict +
+    one buffered write per epoch at the retire position."""
+
+    def __init__(self, path: str, node: int, append: bool = False):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.node = node
+        self._f = open(path, "a" if append else "w")
+        self.lines = 0
+
+    def emit(self, epoch: int, **fields) -> None:
+        rec = {"node": self.node, "epoch": epoch, "t_us": now_us()}
+        rec.update(fields)
+        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self.lines += 1
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+def read_metrics(path: str) -> list[dict]:
+    """Load a metrics stream.  Torn lines are SKIPPED, not a stop
+    point: a recovered incarnation appends after an unclean death, so a
+    torn line can sit mid-file with valid post-recovery lines after
+    it."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

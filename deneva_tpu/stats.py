@@ -25,6 +25,32 @@ from typing import Iterable
 import numpy as np
 
 
+def weighted_nearest_rank(values: np.ndarray, weights: np.ndarray | None,
+                          p: float) -> float:
+    """Weighted nearest-rank percentile over a (value, weight) multiset —
+    THE one percentile definition in the repo (reference
+    `stats_array.cpp:127-146` ``get_idx(pct)`` sorted-array indexing).
+    ``StatsArr.percentile``, the admission controller's SLO quantile and
+    the txntrace waterfall all delegate here so a boundary-rank fix can
+    never fork the semantics.  p0 = min, p100 = max; empty/zero-weight
+    input returns 0."""
+    values = np.asarray(values, np.float64)
+    if values.size == 0:
+        return 0.0
+    order = np.argsort(values, kind="stable")
+    vals = values[order]
+    w = np.ones(len(vals)) if weights is None \
+        else np.asarray(weights, np.float64)[order]
+    cum = np.cumsum(w)
+    total = cum[-1]
+    if total <= 0:
+        return 0.0
+    # nearest-rank over the weighted multiset
+    target = p / 100.0 * total
+    idx = int(np.searchsorted(cum, target, side="left"))
+    return float(vals[min(idx, len(vals) - 1)])
+
+
 class StatsArr:
     """Percentile array (reference `statistics/stats_array.cpp:53-146`).
 
@@ -86,18 +112,8 @@ class StatsArr:
                          self._w[: self._n].astype(np.int64))
 
     def percentile(self, p: float) -> float:
-        if self._n == 0:
-            return 0.0
-        order = np.argsort(self._buf[: self._n], kind="stable")
-        vals = self._buf[: self._n][order]
-        cum = np.cumsum(self._w[: self._n][order])
-        total = cum[-1]
-        if total <= 0:
-            return 0.0
-        # nearest-rank over the weighted multiset
-        target = p / 100.0 * total
-        idx = int(np.searchsorted(cum, target, side="left"))
-        return float(vals[min(idx, len(vals) - 1)])
+        return weighted_nearest_rank(self._buf[: self._n],
+                                     self._w[: self._n], p)
 
     def percentiles(self, ps=(50, 90, 95, 99)) -> dict[str, float]:
         return {f"p{p}": self.percentile(p) for p in ps}
@@ -217,12 +233,12 @@ class Stats:
 
 def tagged_line(tag: str, fields: dict) -> str:
     """``[tag] k=v k=v ...`` emitter for subsystem summary-line families
-    (currently ``[repair]``; the older ``[membership]``/
-    ``[replication]``/``[admission]`` lines predate it and keep their
-    own per-family float formatting).  All four share the same
-    space-separated k=v SHAPE, parsed by the matching `harness.parse`
-    regex parsers — which by contract ignore every tag they do not
-    know, so new families never break old tooling."""
+    (currently ``[repair]`` and ``[telemetry]``; the older
+    ``[membership]``/``[replication]``/``[admission]`` lines predate it
+    and keep their own per-family float formatting).  Every family
+    shares the same space-separated k=v SHAPE, parsed by the matching
+    `harness.parse` regex parsers — which by contract ignore every tag
+    they do not know, so new families never break old tooling."""
     body = " ".join(
         f"{k}={_fmt(v) if isinstance(v, (int, float)) else v}"
         for k, v in fields.items())

@@ -1,0 +1,11 @@
+"""Fixture config: just the telemetry flag, default OFF (the registry
+drift check cross-parses this module against the REAL telemetry
+GateSpec)."""
+
+
+class Config:
+    telemetry: bool = False
+    telemetry_sample: int = 1024
+    telemetry_ring: int = 1 << 16
+    telemetry_dir: str = ""
+    node_cnt: int = 1

@@ -1,0 +1,34 @@
+"""Seeded graftlint violations: the REAL ``telemetry`` GateSpec
+(runtime/gates.py) checked against fixture call sites — an unguarded
+call into the telemetry home module must fail the lint, the guarded
+idioms the runtime actually uses (``cfg.telemetry`` at construction,
+the recorder handle's ``self.tel is not None`` check) must stay
+silent."""
+
+from deneva_tpu.runtime.telemetry import (FlightRecorder, sampled_mask,
+                                          telemetry_line)
+
+
+class ServerFx:
+    def __init__(self, cfg):
+        self.tel = None
+        if cfg.telemetry:
+            # the runtime idiom: the flag test dominates construction
+            self.tel = FlightRecorder(cfg, 0, "node")
+
+    def ok_hook(self, tags):
+        # the recorder object doubles as its own guard
+        if self.tel is not None:
+            self.tel.record(tags, 0)
+
+    def ok_line(self, cfg):
+        if cfg.telemetry:
+            return telemetry_line(0, {})
+        return None
+
+    def bad_mask(self, tags):
+        # no dominating telemetry-flag test on any path to the call
+        return sampled_mask(tags, 8)      # EXPECT[gate-unguarded-use]
+
+    def bad_line(self):
+        return telemetry_line(0, {})      # EXPECT[gate-unguarded-use]

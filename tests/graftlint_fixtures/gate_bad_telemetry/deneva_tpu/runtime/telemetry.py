@@ -1,0 +1,22 @@
+"""Fixture stand-in for the telemetry subsystem's home module (never
+imported at runtime; the checker resolves calls against its dotted
+path).  Code HERE is exempt — it only runs once the gate armed it."""
+
+
+class FlightRecorder:
+    def __init__(self, cfg, node, role, append=False):
+        self.sampled_cnt = 0
+
+    def record(self, tags, stage, epoch=-1):
+        return 0
+
+    def flush(self):
+        pass
+
+
+def sampled_mask(tags, sample):
+    return tags
+
+
+def telemetry_line(node, fields):
+    return "[telemetry]"

@@ -109,6 +109,7 @@ def _mini_client(n_srv=2, fault_mode=False, chunk=64):
     c._nacked = np.zeros(TAG_RING, bool)
     c._ledger = BackoffLedger(TAG_RING, 10 * MS, 500 * MS, seed=11)
     c._tag_srv = None
+    c.tel = None                  # flight recorder off (default-off rig)
     c._resend_q = __import__("collections").deque()
     c._resend_us = 100 * MS
     c._resend_cnt = 0

@@ -97,3 +97,70 @@ def test_prog_line_and_proc_utilization():
     assert line.startswith("[prog] total_runtime=2,tput=20,txn_cnt=40")
     assert "mem_util=" in line and "cpu_util=" in line
     assert line.endswith("epoch_cnt=9")
+
+
+def test_stats_arr_boundary_ranks():
+    """Weighted nearest-rank at the boundary ranks: p0 is the min, p100
+    the max, a single bucket answers every percentile with its value,
+    and huge weights neither overflow nor skew the rank arithmetic."""
+    from deneva_tpu.stats import StatsArr, weighted_nearest_rank
+
+    a = StatsArr()
+    a.extend([5.0, 1.0, 9.0])
+    assert a.percentile(0) == 1.0
+    assert a.percentile(100) == 9.0
+    # single bucket: every rank answers the one value
+    b = StatsArr()
+    b.extend_weighted([42.0], [7])
+    for p in (0, 1, 50, 99, 100):
+        assert b.percentile(p) == 42.0
+    assert len(b) == 7
+    # huge weights: 1e12 copies of 1.0 vs one copy of 100.0 — p99 must
+    # stay at the heavy value (float64 cumsum holds the exact rank)
+    c = StatsArr()
+    c.extend_weighted([1.0, 100.0], [1e12, 1.0])
+    assert c.percentile(99) == 1.0
+    assert c.percentile(100) == 100.0
+    # empty / zero-weight input answers 0 by contract
+    assert StatsArr().percentile(50) == 0.0
+    assert weighted_nearest_rank([], None, 50) == 0.0
+    assert weighted_nearest_rank([3.0], [0.0], 50) == 0.0
+    # the shared helper agrees with the array path (one definition)
+    assert weighted_nearest_rank([5.0, 1.0, 9.0], None, 0) == 1.0
+    assert weighted_nearest_rank([5.0, 1.0, 9.0], None, 100) == 9.0
+
+
+def test_stats_arr_merge_from_grown_buffers():
+    """merge_from on arrays that outgrew their initial capacity: the
+    splice must copy only the LIVE prefix (amortized growth leaves
+    np.resize garbage past _n) and weighted entries merge exactly."""
+    import numpy as np
+
+    from deneva_tpu.stats import StatsArr
+
+    a = StatsArr(cap=4)
+    a.extend(np.arange(100, dtype=np.float64))     # grows 4 -> 128
+    assert len(a) == 100
+    b = StatsArr(cap=4)
+    b.extend_weighted([1000.0, 2000.0], [50, 50])  # weighted source
+    b.extend(np.arange(100, 170, dtype=np.float64))  # grown + mixed
+    a.merge_from(b)
+    assert len(a) == 100 + 100 + 70
+    # the merged multiset ranks exactly: 170 unit samples 0..169 below
+    # the 100 heavy samples at 1000/2000
+    assert a.percentile(100) == 2000.0
+    assert a.percentile(0) == 0.0
+    # 170/270 ~ 63% of mass below 170: p50 lands inside the unit ramp,
+    # p75 inside the heavy tail
+    assert a.percentile(50) < 170.0
+    assert a.percentile(75) == 1000.0
+    # view() expands weights for small series — the oracle the
+    # percentile path must match
+    v = np.sort(a.view())
+    assert len(v) == 270
+    assert v[-1] == 2000.0 and (v[:170] == np.arange(170)).all()
+    # merging an EMPTY grown array is a no-op
+    c = StatsArr(cap=4)
+    n0 = len(a)
+    a.merge_from(c)
+    assert len(a) == n0

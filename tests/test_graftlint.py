@@ -166,6 +166,24 @@ def test_fencing_gate_fires_on_unguarded_use():
         "\n".join(f.render() for f in findings)
 
 
+def test_telemetry_gate_fires_on_unguarded_use():
+    """The REAL ``telemetry`` GateSpec (runtime/gates.py) catches an
+    unguarded call into runtime/telemetry.py and accepts the guarded
+    idioms the runtime uses (``cfg.telemetry`` at construction, the
+    recorder handle's ``is not None`` check) — the CI teeth behind the
+    flight recorder's default-off bit-identity contract."""
+    from deneva_tpu.runtime.gates import GATES
+
+    root = os.path.join(FIX, "gate_bad_telemetry")
+    tree = Tree(root, ["."])
+    findings = tree.filter(gateconsistency.check(
+        tree, gates={"telemetry": GATES["telemetry"]}, exempt=(),
+        escrow_funcs=(), escrow_home=(),
+        config_module="deneva_tpu/config.py", guarded=(), model={}))
+    assert _got(findings) == _expected(root), \
+        "\n".join(f.render() for f in findings)
+
+
 def test_gate_registry_matches_config():
     """Executable half of gate-registry-drift: every registered flag is
     a real Config field defaulting OFF, every wiremodel gate names a

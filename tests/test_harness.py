@@ -459,3 +459,112 @@ def test_timeline_chrome_trace_admission_track(tmp_path):
     meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
     assert {m["args"]["name"] for m in meta if m["tid"] == 2} \
         == {"admission"}
+
+
+def test_parse_telemetry_forward_backward_compat(tmp_path):
+    """[telemetry] lines (flight-recorder satellite): per-node sampling
+    health accounting from every node kind; old logs yield [], the new
+    lines perturb no other parser, and the [summary] telemetry fields
+    parse through the standard summary path."""
+    from deneva_tpu.harness.parse import (parse_admission, parse_fencing,
+                                          parse_file, parse_membership,
+                                          parse_repair, parse_replication,
+                                          parse_telemetry)
+    from deneva_tpu.harness.timeline import parse_timeline
+
+    new_log = tmp_path / "telemetry.out"
+    new_log.write_text(
+        "# cfg node_cnt=2\n"
+        "[telemetry] node=0 sampled_cnt=23304 dropped_cnt=0 "
+        "ring_highwater=23304 flush_ms=1.466 sample=1024\n"
+        "[telemetry] node=2 sampled_cnt=18816 dropped_cnt=3 "
+        "ring_highwater=32768 flush_ms=0.42 sample=1024\n"
+        "[summary] total_runtime=2,tput=29588,txn_cnt=59328,"
+        "tel_sampled_cnt=23304,tel_dropped_cnt=0,"
+        "tel_ring_highwater=23304,tel_flush_ms=1.466,metrics_lines=720\n")
+    rows = parse_telemetry(new_log.read_text().splitlines())
+    assert len(rows) == 2
+    assert rows[0]["node"] == 0 and rows[0]["sampled_cnt"] == 23304
+    assert rows[0]["flush_ms"] == 1.466 and rows[0]["sample"] == 1024
+    assert rows[1]["dropped_cnt"] == 3 and rows[1]["ring_highwater"] == 32768
+    row = parse_file(str(new_log))
+    assert row["tel_sampled_cnt"] == 23304 and row["metrics_lines"] == 720
+    # other parsers ignore the new lines entirely
+    text = new_log.read_text().splitlines()
+    assert parse_membership(text) == []
+    assert parse_replication(text) == []
+    assert parse_admission(text) == []
+    assert parse_repair(text) == []
+    assert parse_fencing(text) == []
+    assert parse_timeline(text) == []
+    # old log: no telemetry lines -> [] and unchanged parsing
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_telemetry(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5
+
+
+def test_track_registry_covers_every_span_family():
+    """The declared track registry (timeline.TRACKS) replaces the magic
+    Chrome-trace tids: every tagged-line ledger family maps to exactly
+    one registered track, tids and names are unique, the phase track is
+    tid 0, and the txntrace export's track is registered alongside —
+    so a new subsystem's spans cannot silently collide with an
+    existing tid."""
+    from deneva_tpu.harness.timeline import (ADMISSION_SPANS,
+                                             FENCING_SPANS, PHASE_TRACK,
+                                             REPLICATION_SPANS,
+                                             SPAN_TRACK, TRACKS,
+                                             TXN_TRACK)
+
+    tids = [t.tid for t in TRACKS]
+    names = [t.name for t in TRACKS]
+    assert len(set(tids)) == len(tids), "duplicate track tid"
+    assert len(set(names)) == len(names), "duplicate track name"
+    assert PHASE_TRACK.tid == 0 and PHASE_TRACK in TRACKS
+    assert TXN_TRACK in TRACKS and TXN_TRACK.tid != 0
+    # every ledger span family is registered, with no overlap
+    for fam in (REPLICATION_SPANS, ADMISSION_SPANS, FENCING_SPANS):
+        assert fam, "an exported span family went empty"
+        for name in fam:
+            assert SPAN_TRACK[name].spans == fam
+    all_spans = [n for t in TRACKS for n in t.spans]
+    assert len(set(all_spans)) == len(all_spans), \
+        "a span name is claimed by two tracks"
+    # the registry is what chrome_trace actually uses: an unregistered
+    # span lands on the phase track by contract
+    assert SPAN_TRACK.get("loop", PHASE_TRACK) is PHASE_TRACK
+
+
+def test_regression_gate_telemetry_pairs(tmp_path, monkeypatch):
+    """The telemetry-overhead gate (tools/regression_gate.py): an ok
+    on/off pair passes; an INERT armed run (tel_sampled_cnt == 0), a
+    >2%-slower armed run, a dropping recorder, and a missing _off twin
+    each raise a violation."""
+    import tools.regression_gate as rg
+
+    def point(name, tput, sampled=None, dropped=0.0):
+        body = f"total_runtime=4,tput={tput},txn_cnt={int(tput) * 4}"
+        if sampled is not None:
+            body += f",tel_sampled_cnt={sampled},tel_dropped_cnt={dropped}"
+        (tmp_path / name).write_text(
+            "# cfg node_cnt=2\n[summary] " + body + "\n")
+
+    point("good_off.out", 50000)
+    point("good_on.out", 49600, sampled=300)        # -0.8%: inside 2%
+    point("inert_off.out", 50000)
+    point("inert_on.out", 50000, sampled=0)         # recorder dead
+    point("slow_off.out", 50000)
+    point("slow_on.out", 48000, sampled=300)        # -4%: over the gate
+    point("droppy_off.out", 50000)
+    point("droppy_on.out", 49900, sampled=300, dropped=17.0)
+    point("lonely_on.out", 50000, sampled=300)      # no _off twin
+    monkeypatch.setattr(rg, "TELEMETRY_DIR", str(tmp_path))
+    viol = rg.telemetry_violations()
+    assert len(viol) == 4
+    kinds = "\n".join(viol)
+    assert "inert_on.out" in kinds and "INERT" in kinds
+    assert "slow_on.out" in kinds and "overhead exceeds" in kinds
+    assert "droppy_on.out" in kinds and "dropped" in kinds
+    assert "lonely_on.out" in kinds and "twin" in kinds
+    assert not any("good_on" in v for v in viol)

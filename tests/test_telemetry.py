@@ -1,0 +1,323 @@
+"""Transaction flight recorder (runtime/telemetry.py): deterministic
+sampling agreement between client- and server-side tag views, record
+ring semantics (drop-not-stall, highwater), sidecar flush/read
+round-trips (including the recovery append and torn-tail tolerance),
+the metrics stream, the telemetry-off wire pin on a loopback ServerNode
+and ClientNode (the default-off bit-identity contract), and the armed
+lifecycle hooks on a loopback server."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import CCAlg, Config, WorkloadKind
+from deneva_tpu.runtime import telemetry as T
+from deneva_tpu.runtime import wire
+
+from tests.test_chaos import _solo_server
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(telemetry=True, telemetry_sample=8,
+                telemetry_ring=1024, telemetry_dir=str(tmp_path))
+    base.update(kw)
+    return Config(**base)
+
+
+# ---- sampling ----------------------------------------------------------
+
+def test_sampling_client_and_server_pick_identical_txns():
+    """The zero-coordination contract: the client's raw tag view (lane
+    | tenant << 24) and every server's packed view (client << 40 | tag)
+    sample the SAME txn subset — the predicate keys on the lane bits
+    alone, so tenant ids and the home-client id never perturb it."""
+    lanes = np.arange(4096, dtype=np.int64)
+    tenants = (lanes * 7) % 256
+    wtags = lanes | (tenants << 24)            # client wire view
+    packed = (np.int64(3) << 40) | wtags       # server admission view
+    for sample in (1, 8, 1024):
+        m_cl = T.sampled_mask(wtags, sample)
+        m_srv = T.sampled_mask(packed, sample)
+        np.testing.assert_array_equal(m_cl, m_srv)
+        np.testing.assert_array_equal(m_cl, lanes % sample == 0)
+    # sample=1 records everything
+    assert T.sampled_mask(wtags, 1).all()
+
+
+def test_recorder_samples_filters_and_counts(tmp_path):
+    rec = T.FlightRecorder(_cfg(tmp_path), 0, "node")
+    tags = np.arange(64, dtype=np.int64)
+    n = rec.record(tags, T.ST_ADMIT)
+    assert n == 8 and rec.sampled_cnt == 8      # 64 / sample=8
+    # aligned verdict/aux arrays filter alongside the tags
+    v = np.full(64, T.V_ABORT, np.uint8)
+    v[0] = T.V_COMMIT
+    n = rec.record(tags, T.ST_VERDICT, epoch=3, verdict=v,
+                   aux=np.arange(64, dtype=np.int32))
+    assert n == 8
+    ev = rec.buf[:rec.n]
+    verd = ev[ev["stage"] == T.ST_VERDICT]
+    assert verd["verdict"][0] == T.V_COMMIT
+    assert (verd["verdict"][1:] == T.V_ABORT).all()
+    assert list(verd["aux"]) == [0, 8, 16, 24, 32, 40, 48, 56]
+    assert (verd["epoch"] == 3).all()
+
+
+def test_recorder_ring_drops_past_capacity(tmp_path):
+    """A full ring DROPS (and counts) instead of stalling or growing —
+    the hot loop never blocks on its own instrument."""
+    rec = T.FlightRecorder(_cfg(tmp_path, telemetry_sample=1), 0, "node")
+    assert rec.cap == 1024
+    tags = np.arange(1500, dtype=np.int64)
+    rec.record(tags, T.ST_SEND)
+    assert rec.n == 1024 and rec.dropped_cnt == 476
+    assert rec.highwater == 1024 and rec.should_flush
+    rec.flush()
+    assert rec.n == 0 and not rec.should_flush
+    # post-flush records append again; dropped_cnt is cumulative
+    rec.record(tags[:4], T.ST_SEND)
+    assert rec.n == 4 and rec.dropped_cnt == 476
+
+
+# ---- sidecar round-trip ------------------------------------------------
+
+def test_flush_read_roundtrip_and_append(tmp_path):
+    cfg = _cfg(tmp_path, telemetry_sample=1)
+    rec = T.FlightRecorder(cfg, 2, "client")
+    rec.record(np.arange(5, dtype=np.int64), T.ST_SEND, t_us=111)
+    rec.flush()
+    rec.record(np.arange(3, dtype=np.int64), T.ST_ACK, t_us=222)
+    rec.flush()
+    meta, recs = T.read_telemetry(rec.path)
+    assert meta == {"node": 2, "role": "client", "version": 1}
+    assert len(recs) == 8
+    assert (recs["stage"][:5] == T.ST_SEND).all()
+    assert (recs["stage"][5:] == T.ST_ACK).all()
+    assert (recs["node"] == 2).all()
+    # recovery-style append (append=True keeps the pre-crash prefix)
+    rec2 = T.FlightRecorder(cfg, 2, "client", append=True)
+    rec2.record(np.arange(2, dtype=np.int64), T.ST_SEND, t_us=333)
+    rec2.flush()
+    _, recs = T.read_telemetry(rec2.path)
+    assert len(recs) == 10 and recs["t_us"][-1] == 333
+    # a torn tail (hard crash mid-write) truncates to whole records
+    with open(rec.path, "ab") as f:
+        f.write(b"\x01\x02\x03")
+    _, recs = T.read_telemetry(rec.path)
+    assert len(recs) == 10
+    # recovery append AFTER a torn tail: the constructor truncates to a
+    # record boundary first (command-log discipline), or every
+    # post-recovery record would parse frame-shifted
+    rec3 = T.FlightRecorder(cfg, 2, "client", append=True)
+    rec3.record(np.arange(2, dtype=np.int64), T.ST_ACK, t_us=444)
+    rec3.flush()
+    _, recs = T.read_telemetry(rec3.path)
+    assert len(recs) == 12
+    assert (recs["t_us"][-2:] == 444).all()
+    assert (recs["stage"][-2:] == T.ST_ACK).all()
+    # recovery over a PARTIAL HEADER (crash on first flush) rewrites it
+    stub = T.FlightRecorder(cfg, 5, "node")
+    with open(stub.path, "wb") as f:
+        f.write(b"\x00\x01")
+    rec4 = T.FlightRecorder(cfg, 5, "node", append=True)
+    rec4.record(np.arange(1, dtype=np.int64), T.ST_SEND, t_us=1)
+    rec4.flush()
+    meta, recs = T.read_telemetry(rec4.path)
+    assert meta["node"] == 5 and len(recs) == 1
+
+
+def test_epoch_events_bypass_sampling(tmp_path):
+    rec = T.FlightRecorder(_cfg(tmp_path, telemetry_sample=1024), 3,
+                           "replica")
+    assert rec.record_event(T.ST_APPLY, 17) == 1
+    rec.flush()
+    _, recs = T.read_telemetry(rec.path)
+    assert recs["tag"][0] == -1 and recs["epoch"][0] == 17
+    assert recs["stage"][0] == T.ST_APPLY
+
+
+def test_metrics_stream_roundtrip(tmp_path):
+    path = os.path.join(str(tmp_path), "metrics_node0.jsonl")
+    ms = T.MetricsStream(path, 0)
+    ms.emit(0, commit=64, abort=1)
+    ms.emit(1, commit=63, abort=2)
+    ms.close()
+    rows = T.read_metrics(path)
+    assert [r["epoch"] for r in rows] == [0, 1]
+    assert rows[1]["commit"] == 63 and rows[0]["node"] == 0
+    # torn final line tolerated
+    with open(path, "a") as f:
+        f.write('{"node":0,"epo')
+    assert len(T.read_metrics(path)) == 2
+
+
+def test_telemetry_line_fields():
+    from deneva_tpu.harness.parse import parse_telemetry
+    line = T.telemetry_line(4, {"sampled_cnt": 10, "dropped_cnt": 0,
+                                "ring_highwater": 7, "flush_ms": 1.25,
+                                "sample": 8})
+    rows = parse_telemetry([line])
+    assert rows == [{"node": 4, "sampled_cnt": 10, "dropped_cnt": 0,
+                     "ring_highwater": 7, "flush_ms": 1.25, "sample": 8}]
+
+
+# ---- loopback ServerNode: telemetry-off wire pin ----------------------
+
+def test_telemetry_off_wire_pin():
+    """The house contract, executable: with telemetry off a server
+    builds NO recorder and NO metrics stream, writes no sidecar, and
+    its blob broadcast is byte-identical to the pre-telemetry codec
+    output — the flight recorder is purely observational and its off
+    state is the pre-telemetry runtime byte for byte."""
+    node = _solo_server("tel_off_pin")
+    try:
+        assert node.tel is None and node._metrics is None
+        blk = wire.QueryBlock(
+            keys=np.arange(8, dtype=np.int32).reshape(4, 2),
+            types=np.ones((4, 2), np.int8),
+            scalars=np.zeros((4, 0), np.int32),
+            tags=np.arange(4, dtype=np.int64))
+        ts = np.arange(4, dtype=np.int64) + 100
+        blob = wire.encode_epoch_blob(7, blk, ts)
+        sent = []
+        node.tp.sendv_many = \
+            lambda dests, rt, parts: sent.append((list(dests), rt, parts))
+        node.tp.send = lambda d, rt, pl=b"": sent.append(([d], rt, [pl]))
+        node.n_srv = 2          # pretend a peer so the bcast emits
+        node._bcast_views(7, blk, ts)
+        (dests, rt, parts), = sent
+        assert rt == "EPOCH_BLOB"
+        assert b"".join(bytes(p) for p in parts) == blob
+        assert not any(k.startswith("tel_")
+                       for k in node.stats.counters)
+    finally:
+        node.n_srv = 1
+        node.close()
+
+
+def test_telemetry_off_client_pin():
+    """Client half of the off pin: no recorder, no sidecar, the send
+    path untouched.  (A bare server-side transport fills the mesh so
+    the client's dt_start handshake completes.)"""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deneva_tpu.runtime.client import ClientNode
+    from deneva_tpu.runtime.native import NativeTransport, ipc_endpoints
+
+    cfg = Config(workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+                 node_cnt=1, client_node_cnt=1, node_id=1,
+                 epoch_batch=32, synth_table_size=1024,
+                 req_per_query=2, max_accesses=2)
+    import threading
+
+    eps = ipc_endpoints(2, f"tel_off_cl_pin_{os.getpid()}")
+    peer = NativeTransport(0, eps, 2)
+    # dt_start blocks until the whole mesh connects: start the server-
+    # side stub concurrently with the client's own start
+    t = threading.Thread(target=peer.start)
+    t.start()
+    try:
+        node = ClientNode(cfg, eps, "cpu")
+        try:
+            assert node.tel is None
+        finally:
+            node.close()
+    finally:
+        t.join()
+        peer.close()
+
+
+# ---- loopback ServerNode: armed lifecycle hooks ------------------------
+
+def _tel_server(tag, tmp_path, **kw):
+    base = dict(telemetry=True, telemetry_sample=1,
+                telemetry_dir=str(tmp_path), synth_table_size=1024)
+    base.update(kw)
+    return _solo_server(tag, **base)
+
+
+def test_armed_route_records_admit_with_packed_tags(tmp_path):
+    node = _tel_server("tel_admit", tmp_path)
+    try:
+        blk = wire.QueryBlock(
+            keys=np.zeros((4, 2), np.int32),
+            types=np.zeros((4, 2), np.int8),
+            scalars=np.zeros((4, 0), np.int32),
+            tags=np.arange(4, dtype=np.int64) + 10)
+        node._route(1, "CL_QRY_BATCH", wire.encode_qry_block(blk))
+        ev = node.tel.buf[:node.tel.n]
+        admits = ev[ev["stage"] == T.ST_ADMIT]
+        assert len(admits) == 4
+        # packed id = src << 40 | tag: join key shared with the client
+        assert list(admits["tag"]) == [(1 << 40) | t
+                                       for t in range(10, 14)]
+        assert len(node.pending) == 1
+    finally:
+        node.close()
+
+
+def test_armed_verdict_hook_planes_and_hold(tmp_path):
+    node = _tel_server("tel_verd", tmp_path)
+    try:
+        tags = (np.int64(1) << 40) | np.arange(6, dtype=np.int64)
+        blk = wire.QueryBlock(
+            keys=np.zeros((6, 2), np.int32),
+            types=np.zeros((6, 2), np.int8),
+            scalars=np.zeros((6, 0), np.int32), tags=tags)
+        commit = np.array([1, 1, 0, 0, 1, 0], bool)
+        ab = np.array([0, 0, 1, 0, 0, 0], bool)
+        df = np.array([0, 0, 0, 1, 0, 0], bool)
+        rep = np.array([0, 1, 0, 0, 0, 0], bool)
+        node._tel_verdicts(5, blk, commit, ab, df, rep,
+                           np.zeros(6, np.int32), 12345)
+        ev = node.tel.buf[:node.tel.n]
+        verd = ev[ev["stage"] == T.ST_VERDICT]
+        assert (verd["t_us"] == 12345).all()
+        got = {int(r["tag"]) & 0xFF: int(r["verdict"]) for r in verd}
+        assert got == {0: T.V_COMMIT, 1: T.V_SALVAGE, 2: T.V_ABORT,
+                       3: T.V_DEFER, 4: T.V_COMMIT}
+        # no logger on this solo node -> no hold events
+        assert not (ev["stage"] == T.ST_HOLD).any()
+    finally:
+        node.close()
+
+
+# ---- config gating -----------------------------------------------------
+
+def test_telemetry_knobs_validate():
+    with pytest.raises(ValueError, match="telemetry_sample"):
+        Config().replace(telemetry_sample=0)
+    with pytest.raises(ValueError, match="telemetry_ring"):
+        Config().replace(telemetry_ring=16)
+    cfg = Config().replace(telemetry=True)    # defaults are live
+    assert cfg.telemetry_sample == 1024
+
+
+# ---- end-to-end cluster (slow tier) ------------------------------------
+
+@pytest.mark.slow
+def test_cluster_telemetry_chains_complete(tmp_path):
+    """2 servers + 1 client + logging: every sampled committed txn's
+    chain joins gap-free across the sidecars, with the quorum
+    hold->release hop present (held acks), and the telemetry-off twin
+    of the same config writes no sidecar at all."""
+    from deneva_tpu.harness import txntrace
+    from deneva_tpu.runtime.launch import run_cluster
+
+    cfg = Config(workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+                 node_cnt=2, client_node_cnt=1, epoch_batch=128,
+                 conflict_buckets=512, synth_table_size=4096,
+                 max_txn_in_flight=1024, req_per_query=4, max_accesses=4,
+                 warmup_secs=0.3, done_secs=1.0, logging=True,
+                 log_dir=str(tmp_path), telemetry=True,
+                 telemetry_sample=8)
+    out = run_cluster(cfg, platform="cpu", run_id="telsm")
+    assert {k for k, (kind, _) in out.items() if kind == "server"} \
+        == {0, 1}
+    recs, roles = txntrace.load_dir(os.path.join(str(tmp_path), "telsm"))
+    assert len(recs) > 0 and roles[2] == "client"
+    chains = [txntrace.build_chain(ev)
+              for ev in txntrace.index_txns(recs).values()]
+    committed, full, viol = txntrace.completeness(chains)
+    assert committed > 0 and full > 0 and viol == []

@@ -1,0 +1,202 @@
+"""Flight-recorder trace merger (harness/txntrace.py): span-tree
+construction from synthetic multi-node records, verdict-class
+assignment, the completeness oracle's green and red paths, waterfall
+tables, and the flow-linked Chrome-trace export against the shared
+track registry (harness/timeline.py)."""
+
+import numpy as np
+
+from deneva_tpu.harness import txntrace as X
+from deneva_tpu.runtime import telemetry as T
+
+
+def _rec(tag, t_us, stage, node, epoch=-1, verdict=T.V_NONE, aux=0):
+    r = np.zeros(1, T.REC_DTYPE)
+    r["tag"], r["t_us"], r["stage"], r["node"] = tag, t_us, stage, node
+    r["epoch"], r["verdict"], r["aux"] = epoch, verdict, aux
+    return r
+
+
+def _chain_records(tag=16, client=2, server=0, base=1000,
+                   with_quorum=True, retried=False, shed=False,
+                   salvage=False):
+    """One txn's happy-path lifecycle across client + server records."""
+    rows = [_rec(tag, base, T.ST_SEND, client)]
+    t = base
+    if shed:
+        t += 50
+        rows.append(_rec(tag, t, T.ST_BACKOFF, client, verdict=T.V_SHED,
+                         aux=20_000))
+        t += 100
+        rows.append(_rec(tag, t, T.ST_RESEND, client))
+    t += 100
+    rows.append(_rec(tag, t, T.ST_ADMIT, server))
+    if retried:
+        t += 50
+        rows.append(_rec(tag, t, T.ST_BATCH, server, epoch=4))
+        t += 50
+        rows.append(_rec(tag, t, T.ST_VERDICT, server, epoch=4,
+                         verdict=T.V_ABORT))
+        t += 50
+        rows.append(_rec(tag, t, T.ST_ADMIT, server))
+    t += 100
+    rows.append(_rec(tag, t, T.ST_BATCH, server, epoch=5))
+    t += 300
+    rows.append(_rec(tag, t, T.ST_VERDICT, server, epoch=5,
+                     verdict=T.V_SALVAGE if salvage else T.V_COMMIT))
+    if with_quorum:
+        rows.append(_rec(tag, t + 1, T.ST_HOLD, server, epoch=5))
+        t += 200
+        rows.append(_rec(tag, t, T.ST_RELEASE, server, epoch=5))
+    t += 80
+    rows.append(_rec(tag, t, T.ST_ACK, client))
+    return rows
+
+
+def _concat(rows):
+    recs = np.concatenate(rows)
+    return recs[np.argsort(recs["t_us"], kind="stable")]
+
+
+def test_build_chain_happy_path_and_spans():
+    recs = _concat(_chain_records())
+    txns = X.index_txns(recs)
+    assert set(txns) == {16}
+    ch = X.build_chain(txns[16])
+    assert ch["klass"] == "committed" and ch["epoch"] == 5
+    assert ch["send"] == 1000 and ch["ack"] == 1780
+    sp = X.stage_spans(ch)
+    assert sp["send-admit"] == 0.1 and sp["batch-verdict"] == 0.3
+    assert sp["verdict-release"] == 0.2
+    assert abs(sp["release-ack"] - 0.08) < 1e-9
+    assert sp["total"] == 0.78
+
+
+def test_chain_without_quorum_folds_release_into_verdict():
+    recs = _concat(_chain_records(with_quorum=False))
+    ch = X.build_chain(X.index_txns(recs)[16])
+    assert ch["hold"] is None and ch["release"] is None
+    sp = X.stage_spans(ch)
+    assert sp["verdict-release"] == 0.0
+    assert sp["release-ack"] > 0       # verdict -> ack wire time
+
+
+def test_verdict_class_priority():
+    """salvaged > shed > retried > committed, per the class contract."""
+    recs = _concat(_chain_records(retried=True))
+    assert X.build_chain(X.index_txns(recs)[16])["klass"] == "retried"
+    recs = _concat(_chain_records(shed=True))
+    assert X.build_chain(X.index_txns(recs)[16])["klass"] == "shed"
+    recs = _concat(_chain_records(salvage=True, shed=True, retried=True))
+    assert X.build_chain(X.index_txns(recs)[16])["klass"] == "salvaged"
+    recs = _concat(_chain_records())
+    assert X.build_chain(X.index_txns(recs)[16])["klass"] == "committed"
+
+
+def test_stage_selection_anchors_on_committing_pass():
+    """A retried txn's per-stage attribution describes the committing
+    pass (last batch before the commit verdict), while total latency
+    keeps measuring from the FIRST send."""
+    recs = _concat(_chain_records(retried=True))
+    ch = X.build_chain(X.index_txns(recs)[16])
+    assert ch["epoch"] == 5                       # not the aborted pass
+    sp = X.stage_spans(ch)
+    assert sp["batch-verdict"] == 0.3             # the commit pass only
+    assert sp["total"] > 0.7                      # first send -> ack
+
+
+def test_completeness_green_and_red():
+    rows = _chain_records(tag=16) + _chain_records(tag=24, base=5000)
+    committed, full, viol = X.completeness(
+        [X.build_chain(ev) for ev in X.index_txns(_concat(rows)).values()])
+    assert (committed, full, viol) == (2, 2, [])
+    # red: a committed txn with no ADMIT hop is a recorder gap
+    gap = [r for r in _chain_records(tag=32)
+           if not (r["stage"] == T.ST_ADMIT).any()]
+    committed, full, viol = X.completeness(
+        [X.build_chain(ev) for ev in X.index_txns(_concat(gap)).values()])
+    assert committed == 1 and len(viol) == 1 and "admit" in viol[0]
+    # red: an ack BEFORE the verdict is an ordering inversion
+    inv = _chain_records(tag=40, with_quorum=False)
+    for r in inv:
+        if (r["stage"] == T.ST_ACK).any():
+            r["t_us"] = 1050                     # before the verdict
+    committed, full, viol = X.completeness(
+        [X.build_chain(ev) for ev in X.index_txns(_concat(inv)).values()])
+    assert len(viol) == 1 and "inversion" in viol[0]
+
+
+def test_in_flight_txn_excluded():
+    rows = [_rec(8, 100, T.ST_SEND, 2), _rec(8, 200, T.ST_ADMIT, 0)]
+    ch = X.build_chain(X.index_txns(_concat(rows))[8])
+    assert ch["verdict"] is None and ch["klass"] is None
+    committed, full, viol = X.completeness([ch])
+    assert (committed, full, viol) == (0, 0, [])
+    assert X.stage_spans(ch) is None
+
+
+def test_waterfall_splits_by_verdict_and_tenant():
+    rows = (_chain_records(tag=16)
+            + _chain_records(tag=24 | (3 << 24), base=5000, shed=True))
+    chains = [X.build_chain(ev)
+              for ev in X.index_txns(_concat(rows)).values()]
+    tab = X.waterfall(chains, by="verdict")
+    keys = {r[0] for r in tab[1:]}
+    assert keys == {"committed", "shed"}
+    assert tab[0][:3] == ["verdict", "stage", "txns"]
+    tab = X.waterfall(chains, by="tenant")
+    assert {r[0] for r in tab[1:]} == {"tenant0", "tenant3"}
+    tab = X.waterfall(chains, by="none")
+    assert {r[0] for r in tab[1:]} == {"all"}
+    # every fixed stage reported once per split
+    assert [r[1] for r in tab[1:]] == list(X.STAGES)
+    assert "p99_ms" in tab[0]
+    assert X.render(tab).splitlines()[0].startswith("none")
+    assert X.render([tab[0]]).startswith("(no complete")
+
+
+def test_chrome_trace_flow_arrows_cross_tracks():
+    from deneva_tpu.harness.timeline import TXN_TRACK
+
+    rows = _chain_records() + [_rec(-1, 1650, T.ST_APPLY, 3, epoch=5)]
+    recs = _concat(rows)
+    trace = X.chrome_trace(recs, {2: "client", 0: "node", 3: "replica"})
+    ev = trace["traceEvents"]
+    xs = [e for e in ev if e["ph"] == "X"]
+    assert {e["tid"] for e in xs} == {TXN_TRACK.tid}
+    assert [e["name"] for e in xs] == list(X.STAGES[:-1])
+    # spans land on the owning node: server hops on pid 0, ack on client
+    assert {e["pid"] for e in xs if e["name"] == "batch-verdict"} == {0}
+    assert {e["pid"] for e in xs if e["name"] == "release-ack"} == {2}
+    flow = [e for e in ev if e["ph"] in ("s", "t", "f")]
+    assert [e["ph"] for e in flow] == ["s", "t", "t", "f"]
+    assert flow[0]["pid"] == 2 and flow[1]["pid"] == 0
+    assert flow[-1]["bp"] == "e"
+    # replica apply markers ride the same track as instants
+    inst = [e for e in ev if e["ph"] == "i"]
+    assert len(inst) == 1 and inst[0]["pid"] == 3
+    # track metadata from the shared registry
+    meta = [e for e in ev if e["ph"] == "M"]
+    assert {m["args"]["name"] for m in meta
+            if m["name"] == "thread_name"} == {TXN_TRACK.name}
+    assert {m["args"]["name"] for m in meta
+            if m["name"] == "process_name"} \
+        == {"client 2", "node 0", "replica 3"}
+
+
+def test_load_dir_merges_sidecars(tmp_path):
+    from deneva_tpu.config import Config
+
+    cfg = Config(telemetry=True, telemetry_sample=1,
+                 telemetry_dir=str(tmp_path))
+    a = T.FlightRecorder(cfg, 0, "node")
+    a.record(np.asarray([8]), T.ST_ADMIT, t_us=50)
+    a.flush()
+    b = T.FlightRecorder(cfg, 2, "client")
+    b.record(np.asarray([8]), T.ST_SEND, t_us=10)
+    b.flush()
+    recs, roles = X.load_dir(str(tmp_path))
+    assert len(recs) == 2 and list(recs["t_us"]) == [10, 50]
+    assert roles == {0: "node", 2: "client"}
+    empty, roles = X.load_dir(str(tmp_path / "nope"))
+    assert len(empty) == 0 and roles == {}

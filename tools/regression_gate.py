@@ -49,6 +49,15 @@ SWEEPS = ("isolation_levels", "operating_points", "escrow_ablation",
 RUNTIME_FACTOR = 2.0
 RUNTIME_SLACK_SECS = 2.0
 
+# telemetry-overhead gate (results/telemetry): each <preset>_on.out /
+# <preset>_off.out pair — same preset, flight recorder armed at the
+# default telemetry_sample vs off — must show the armed run's tput
+# within this fraction of the off run's, AND the armed run must have
+# actually sampled (anti-inert: a gate that passes with the recorder
+# dead proves nothing).  tools/telemetry_bench.py writes the pairs.
+TELEMETRY_DIR = "results/telemetry"
+TELEMETRY_TOLERANCE = 0.02
+
 
 def live_table() -> dict[str, float]:
     out: dict[str, float] = {}
@@ -76,6 +85,46 @@ def runtime_violations() -> list[tuple[str, float, float]]:
                 continue
             if float(rt) > RUNTIME_FACTOR * float(win) + RUNTIME_SLACK_SECS:
                 out.append((f"{exp}/{row['file']}", float(rt), float(win)))
+    return out
+
+
+def telemetry_violations() -> list[str]:
+    """Anti-inert + anti-regression over the committed telemetry pairs:
+    for every ``<preset>_on.out`` in results/telemetry, its ``_off``
+    twin must exist, the armed run must have sampled events
+    (tel_sampled_cnt > 0, zero drops), and armed tput must stay within
+    ``TELEMETRY_TOLERANCE`` of off."""
+    out: list[str] = []
+    if not os.path.isdir(TELEMETRY_DIR):
+        return out
+    rows = {r["file"]: r for r in load_results(TELEMETRY_DIR)}
+    for name, row in sorted(rows.items()):
+        if not name.endswith("_on.out"):
+            continue
+        off = rows.get(name[:-len("_on.out")] + "_off.out")
+        if off is None:
+            out.append(f"{name}: missing its _off.out twin")
+            continue
+        if "tput" not in off:
+            out.append(f"{name}: its _off.out twin has no tput "
+                       "(malformed [summary]?)")
+            continue
+        if row.get("tel_sampled_cnt", 0.0) <= 0:
+            out.append(f"{name}: tel_sampled_cnt == 0 — the flight "
+                       "recorder was INERT in the armed run")
+        if row.get("tel_dropped_cnt", 0.0) > 0:
+            out.append(f"{name}: recorder dropped "
+                       f"{row['tel_dropped_cnt']:.0f} events")
+        if "tput" not in row:
+            out.append(f"{name}: no tput in the armed run")
+            continue
+        floor = (1.0 - TELEMETRY_TOLERANCE) * float(off["tput"])
+        if float(row["tput"]) < floor:
+            out.append(
+                f"{name}: telemetry overhead exceeds "
+                f"{TELEMETRY_TOLERANCE:.0%}: armed tput "
+                f"{row['tput']:.0f} < {floor:.0f} "
+                f"(off {off['tput']:.0f})")
     return out
 
 
@@ -117,11 +166,15 @@ def check(tolerance: float = 0.35, runtime: bool = True) -> int:
               f"{win:.1f}s window (> {RUNTIME_FACTOR:g}x + "
               f"{RUNTIME_SLACK_SECS:g}s) — re-run via "
               f"tools/rerun_starved.py or drop the point")
+    tel = telemetry_violations()
+    for msg in tel:
+        print(f"TELEMETRY {msg}")
     if missing:
         print(f"note: {len(missing)} expected points absent from this run")
     print(f"checked {len(expected) - len(missing)} points, "
-          f"{len(bad)} regressions, {len(starved)} starved")
-    return 1 if bad or starved else 0
+          f"{len(bad)} regressions, {len(starved)} starved, "
+          f"{len(tel)} telemetry violations")
+    return 1 if bad or starved or tel else 0
 
 
 if __name__ == "__main__":

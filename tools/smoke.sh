@@ -26,6 +26,17 @@
 #                                         self-fence exit 18, single-
 #                                         writer-per-slot + digest-vs-
 #                                         replay invariants)
+#   tools/smoke.sh trace                  flight-recorder gate:
+#                                         telemetry-off wire pin test
+#                                         (bit-identity contract) + the
+#                                         trace-kill chaos scenario
+#                                         (telemetry=true across a
+#                                         crash/recovery: every sampled
+#                                         committed txn has a gap-free
+#                                         client->admit->batch->verdict
+#                                         ->quorum->ack span chain, the
+#                                         merger renders one flow-linked
+#                                         Chrome trace)
 #   tools/smoke.sh repair                 transaction-repair gate:
 #                                         repair-contention (zipf-0.9
 #                                         write-heavy OCC with repair on +
@@ -99,6 +110,17 @@ case "$SCEN" in
     T="${SMOKE_TIMEOUT_SECS:-${REPAIR_TIMEOUT_SECS:-600}}"
     run "$T" python -m deneva_tpu.harness.chaos repair-contention --quick
     ;;
+  trace)
+    # the off-pin half is fast (loopback ServerNode + ClientNode, no
+    # cluster); the chaos half reuses the kill-one-server recovery
+    # machinery, so it gets the same budget as the repair gate
+    T="${SMOKE_TIMEOUT_SECS:-${TRACE_TIMEOUT_SECS:-600}}"
+    run "$T" python -m pytest \
+        "tests/test_telemetry.py::test_telemetry_off_wire_pin" \
+        "tests/test_telemetry.py::test_telemetry_off_client_pin" \
+        -q -p no:cacheprovider
+    run "$T" python -m deneva_tpu.harness.chaos trace-kill --quick
+    ;;
   lint)
     # static gate; budget 30 s total on the 2-core CI box (graftlint v2
     # measures ~6.5 s full-tree over the 8 families / 78 files, ruff
@@ -121,7 +143,7 @@ case "$SCEN" in
     fi
     ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|lint> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|trace|lint> [args...]" >&2
     exit 2
     ;;
 esac

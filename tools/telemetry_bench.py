@@ -1,0 +1,170 @@
+"""Telemetry-overhead bench: produce the results/telemetry pairs the
+regression gate checks.
+
+For each preset, runs the SAME CI-sized cluster config with the flight
+recorder off and armed at the default ``telemetry_sample`` (1024),
+alternating arms ``--repeat`` times, and writes:
+
+  results/telemetry/<preset>_off.out    median-tput off run
+  results/telemetry/<preset>_on.out     median-tput armed run
+  results/telemetry/<preset>_waterfall.txt   per-stage p50/p95/p99
+                                             waterfall of a DENSE-sample
+                                             run (sample=8) of the same
+                                             preset, via txntrace
+
+The ``.out`` files carry the standard ``# cfg`` echo + the server-0 and
+client ``[summary]`` lines, so ``harness.parse.load_results`` reads them
+like any sweep point; ``tools/regression_gate.py check`` then enforces
+armed tput >= 98% of off AND tel_sampled_cnt > 0 (anti-inert +
+anti-regression in one gate — see TELEMETRY_TOLERANCE there).
+
+Usage:  python tools/telemetry_bench.py [--repeat 3] [--out results/telemetry]
+                                        [--preset ycsb_zipf09 ...]
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deneva_tpu.config import CCAlg, Config, WorkloadKind  # noqa: E402
+from deneva_tpu.harness.parse import cfg_header  # noqa: E402
+from deneva_tpu.stats import parse_summary  # noqa: E402
+
+LOG_DIR = os.environ.get("TELBENCH_DIR", "/dev/shm/deneva_telbench")
+
+# CI-sized presets (the chaos-harness cluster shape): the two the
+# acceptance pins — hot-key YCSB and the overload flash crowd
+PRESETS: dict[str, dict] = {
+    # epoch_batch 1024 (production-shaped, not the chaos harness's
+    # jit-fast 256): the per-epoch host costs the recorder adds
+    # (verdict mask, metrics line) amortize over the batch exactly as
+    # they do at the default 2048 — a 256-batch CI config overstates
+    # per-epoch overhead ~4x.  OPEN-LOOP at 45 k/s (~60-70% of this
+    # box's 65-88 k/s saturated band): saturated closed-loop tput on
+    # the contended 2-core CI box swings ±10% run to run (armed runs
+    # beat off runs as often as not — BASELINE round-15 records the
+    # saturated medians with that caveat), which no 2% gate can ride;
+    # pinning the offered load makes the pair reproducible to ±0.1%
+    # and turns the gate into the production question — the armed
+    # server must HOLD the same offered load with no shedding/backlog.
+    "ycsb_zipf09": dict(
+        workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+        node_cnt=2, client_node_cnt=1, epoch_batch=1024,
+        conflict_buckets=512, synth_table_size=8192,
+        max_txn_in_flight=4096, req_per_query=4, max_accesses=4,
+        zipf_theta=0.9, warmup_secs=1.0, done_secs=4.0,
+        arrival_process="poisson", arrival_rate=45000.0,
+        logging=True, replica_cnt=1, log_dir=LOG_DIR),
+    "overload_flash": dict(
+        workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+        node_cnt=2, client_node_cnt=1, epoch_batch=256,
+        conflict_buckets=512, synth_table_size=8192,
+        max_txn_in_flight=16384, req_per_query=4, max_accesses=4,
+        zipf_theta=0.6, warmup_secs=1.0, done_secs=6.0,
+        admission=True, admission_queue_max=1024,
+        arrival_process="flash", arrival_rate=5000.0,
+        arrival_flash_at_s=2.5, arrival_flash_secs=1.5,
+        arrival_flash_factor=10.0, log_dir=LOG_DIR),
+}
+
+
+def _run(cfg: Config, run_id: str) -> dict[str, dict]:
+    from deneva_tpu.runtime.launch import run_cluster
+    out = run_cluster(cfg, platform="cpu", run_id=run_id)
+    return {f"{kind}{nid}": parse_summary(line)
+            for nid, (kind, line) in out.items() if line}
+
+
+def _write_out(path: str, cfg: Config, reports: list[dict]) -> None:
+    """Standard .out shape: cfg echo + client then server-0 summary
+    (parse takes the LAST [summary] line — the server's tput is the
+    gate's comparand)."""
+    from deneva_tpu.stats import Stats
+    with open(path, "w") as f:
+        f.write(cfg_header(cfg))
+        for rep, tag in ((r, t) for r in reports
+                         for t in ("client2", "server0")):
+            fields = rep.get(tag)
+            if fields is None:
+                continue
+            st = Stats()
+            for k, v in fields.items():
+                st.set(k, v)
+            f.write(st.summary_line() + "\n")
+
+
+def bench_preset(name: str, repeat: int, out_dir: str) -> None:
+    import numpy as np
+
+    base = Config(**PRESETS[name])
+    runs: dict[str, list[dict]] = {"off": [], "on": []}
+    for r in range(repeat):
+        for arm in ("off", "on"):
+            cfg = base if arm == "off" else base.replace(telemetry=True)
+            rep = _run(cfg, f"telbench_{name}_{arm}_{r}_{os.getpid()}")
+            tput = rep["server0"]["tput"]
+            print(f"[telemetry_bench] {name} {arm} run {r}: "
+                  f"tput={tput:.0f}", flush=True)
+            runs[arm].append(rep)
+    os.makedirs(out_dir, exist_ok=True)
+    meds = {}
+    for arm in ("off", "on"):
+        tputs = [r["server0"]["tput"] for r in runs[arm]]
+        med = runs[arm][int(np.argsort(tputs)[len(tputs) // 2])]
+        meds[arm] = med["server0"]["tput"]
+        cfg = base if arm == "off" else base.replace(telemetry=True)
+        _write_out(os.path.join(out_dir, f"{name}_{arm}.out"), cfg,
+                   [med])
+    ratio = meds["on"] / max(meds["off"], 1e-9)
+    print(f"[telemetry_bench] {name}: off={meds['off']:.0f} "
+          f"on={meds['on']:.0f} ratio={ratio:.4f} "
+          f"(median of {repeat}; spread off="
+          f"{statistics.pstdev([r['server0']['tput'] for r in runs['off']]):.0f})",
+          flush=True)
+    # dense-sample run for the checked-in waterfall (sample=8: enough
+    # chains for stable p99s; NOT the overhead arm)
+    from deneva_tpu.harness import txntrace
+    wcfg = base.replace(telemetry=True, telemetry_sample=8)
+    run_id = f"telbench_{name}_wf_{os.getpid()}"
+    _run(wcfg, run_id)
+    recs, _roles = txntrace.load_dir(os.path.join(LOG_DIR, run_id))
+    chains = [txntrace.build_chain(ev)
+              for ev in txntrace.index_txns(recs).values()]
+    committed, full, viol = txntrace.completeness(chains)
+    with open(os.path.join(out_dir, f"{name}_waterfall.txt"), "w") as f:
+        f.write(f"# per-stage latency waterfall — preset {name}, "
+                f"telemetry_sample=8 (dense), CPU cluster 2s1c\n")
+        f.write(f"# {len(chains)} sampled txns, {committed} committed, "
+                f"{full} full quorum chains, {len(viol)} violations\n")
+        f.write(txntrace.render(txntrace.waterfall(chains, "verdict"))
+                + "\n")
+    print(f"[telemetry_bench] {name}: waterfall over {committed} "
+          f"committed chains ({len(viol)} violations)", flush=True)
+
+
+def main(argv: list[str]) -> int:
+    repeat = 3
+    out_dir = "results/telemetry"
+    names = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--repeat":
+            repeat = int(argv[i + 1]); i += 2
+        elif argv[i] == "--out":
+            out_dir = argv[i + 1]; i += 2
+        elif argv[i] == "--preset":
+            names.append(argv[i + 1]); i += 2
+        else:
+            print(f"unknown arg {argv[i]!r}", file=sys.stderr)
+            return 2
+    for name in (names or list(PRESETS)):
+        bench_preset(name, repeat, out_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
