@@ -18,7 +18,8 @@ from typing import Any, Callable
 from deneva_tpu.config import CCAlg, Config
 from deneva_tpu.cc.base import (AccessBatch, Incidence, Verdict,  # noqa: F401
                                 build_conflict_incidence, build_incidence,
-                                committed_write_frontier, gate_order_free)
+                                committed_write_frontier, conflict_density,
+                                gate_order_free)
 from deneva_tpu.cc import maat as _maat
 from deneva_tpu.cc import occ as _occ
 from deneva_tpu.cc import timestamp as _tsmod

@@ -656,6 +656,35 @@ class Config:
     #                                (the launcher namespaces it per run
     #                                exactly like the command logs)
 
+    # ---- live metrics bus (cluster observability plane; runtime/
+    # metricsbus.py).  Default OFF: with metrics=False no frame is ever
+    # built, no METRICS rtype crosses the wire, no aggregator exists,
+    # no [crit]/[watch] line prints, no metrics_bus_*.jsonl is written,
+    # and every broadcast/log byte is bit-identical to the pre-bus
+    # runtime (the same contract as chaos/elastic/geo/overload/repair/
+    # fencing/telemetry). ----
+    metrics: bool = False          # arm the bus: every node samples a
+    #                                per-epoch metrics frame (host-side
+    #                                counters + stage timings + the
+    #                                per-partition conflict density the
+    #                                incidence matmuls yield for free)
+    #                                and ships it as METRICS (rtype 25)
+    #                                to the aggregator on the lowest-id
+    #                                live server, which writes the
+    #                                metrics_bus_*.jsonl stream, emits
+    #                                [crit] critical-path attribution +
+    #                                [watch] anomaly events, and feeds
+    #                                tools/monitor.py (live TUI +
+    #                                --prom exposition)
+    metrics_cadence: int = 1       # epochs between frames (depth knob,
+    #                                live default like telemetry_sample:
+    #                                1 = every retired epoch — the rate
+    #                                the <=2% overhead gate pins,
+    #                                tools/regression_gate.py +
+    #                                results/metricsbus); raise it on
+    #                                fast chips where per-epoch frames
+    #                                would flood the aggregator
+
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
     checkpoint_path: str = ""      # "" = checkpointing off
@@ -1076,6 +1105,11 @@ class Config:
         _check(self.telemetry_ring >= 1024,
                "telemetry_ring must be >= 1024 (one client batch of "
                "events must fit between flush points)")
+        # ---- metrics bus gating (same discipline: the default takes
+        # the pre-bus paths exactly; cadence is a depth knob with a
+        # live default) ----
+        _check(self.metrics_cadence >= 1,
+               "metrics_cadence must be >= 1 (1 frames every epoch)")
         # ---- transaction repair gating (same discipline as elastic/geo/
         # overload: defaults take the pre-repair paths exactly) ----
         _check(self.repair_rounds >= 0 and self.repair_rounds <= 8,

@@ -30,8 +30,10 @@ import numpy as np
 #          4 = round-4 (per-type latency_hist + retry/wait hist leaves);
 #          5 = round-5 (VersionRing flattened to [R*H] storage);
 #          6 = round-13 (rep_* transaction-repair counters in
-#              device stats).
-SCHEMA_VERSION = 6
+#              device stats);
+#          7 = round-16 (conflict_density per-partition counter in
+#              device stats — the metrics bus's contention signal).
+SCHEMA_VERSION = 7
 
 
 def save_state(path: str, state) -> None:

@@ -227,6 +227,17 @@ def run_simulation(cfg: Config, chunk: int = 50,
         for k in ("rep_salvaged_cnt", "rep_frontier_cnt",
                   "rep_fallback_cnt"):
             st.set(k, float(after[k] - before[k]))
+    if cfg.metrics:
+        # metrics bus ([summary] satellite): cumulative per-partition
+        # observed-conflict density over the measured window (the
+        # per-epoch series is the cluster bus's job; in-process runs
+        # get the window totals).  Emitted only when armed so the
+        # default summary line is byte-identical.
+        dens = (after["conflict_density"]
+                - before["conflict_density"]).astype(np.float64)
+        for i, d in enumerate(dens):
+            st.set(f"mb_density_p{i}", float(d))
+        st.set("mb_density_total", float(dens.sum()))
     for i, nm in enumerate(getattr(wl, "txn_type_names", ())):
         for fam in ("commit", "abort"):
             key = f"{fam}_by_type"

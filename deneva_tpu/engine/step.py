@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from deneva_tpu.cc import (AccessBatch, build_conflict_incidence,
-                           gate_order_free, get_backend)
+                           conflict_density, gate_order_free, get_backend)
 from deneva_tpu.config import Config, Mode
 from deneva_tpu.engine.pool import PoolState, TxnPool
 from deneva_tpu.ops import (forward_verdict, forwarding_applies,
@@ -72,9 +72,15 @@ jax.tree_util.register_dataclass(
     meta_fields=[])
 
 
-def init_device_stats(n_txn_types: int = 1) -> dict:
+def init_device_stats(n_txn_types: int = 1, n_parts: int = 1) -> dict:
     z = lambda: jnp.zeros((), jnp.uint32)  # noqa: E731
     return {
+        # per-partition observed-conflict density (cc/base.
+        # conflict_density; the metrics bus's contention signal and the
+        # contention-adaptive router's input).  Always present so the
+        # stats pytree shape depends only on the config; stays zero
+        # unless metrics is armed.
+        "conflict_density": jnp.zeros((max(n_parts, 1),), jnp.uint32),
         "generated_cnt": z(), "admitted_cnt": z(),
         "total_txn_commit_cnt": z(), "total_txn_abort_cnt": z(),
         "unique_txn_abort_cnt": z(),
@@ -172,7 +178,8 @@ class Engine:
             db=db, cc_state=self.backend.init_state(cfg), pool=pool,
             rng=jax.random.PRNGKey(cfg.seed if seed is None else seed),
             epoch=jnp.zeros((), jnp.int32),
-            stats=init_device_stats(len(self.workload.txn_type_names)))
+            stats=init_device_stats(len(self.workload.txn_type_names),
+                                    max(cfg.part_cnt, 1)))
 
     # ------------------------------------------------------------------
     def step(self, state: EngineState) -> EngineState:
@@ -235,6 +242,17 @@ class Engine:
             inc = build_conflict_incidence(cfg, be, batch,
                                            batch.order_free)
             verdict, cc_state = be.validate(cfg, state.cc_state, batch, inc)
+        if cfg.metrics and cfg.device_parts == 1:
+            # metrics bus (runtime/metricsbus.py): accumulate the
+            # per-partition observed-conflict density off the incidence
+            # views (the sweep already materialized them; forwarding
+            # backends pay two bucket scatter-adds).  Multi-chip skips:
+            # the sharded tables have no single bucket space to fold.
+            owner = planned.get("owner",
+                                batch.keys % jnp.int32(max(cfg.part_cnt,
+                                                           1)))
+            stats["conflict_density"] = stats["conflict_density"] + \
+                conflict_density(cfg, batch, owner, inc).astype(jnp.uint32)
         # defer budget (defer_rounds_max, WAIT_DIE-style wait timeout): a
         # txn deferred past the budget force-restarts with fresh ts +
         # backoff — the liveness backstop for waits that never resolve

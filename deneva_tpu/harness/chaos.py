@@ -252,6 +252,25 @@ SCENARIOS: dict[str, dict] = {
         admission_queue_max=1024, arrival_process="diurnal",
         arrival_rate=5000.0, arrival_period_s=2.0, arrival_amp=0.8,
         done_secs=6.0),
+    # live metrics bus under gray failure + aggregator crash (runtime/
+    # metricsbus.py): metrics armed on a 3-server cluster; node 1 turns
+    # gray-SLOW (1.5 s additive outbound stall from t=3 s — frames
+    # arrive, late) while node 0 — the BOOT AGGREGATOR — is fault_killed
+    # at an epoch boundary and restarted in recovery mode (the
+    # kill-one-server shape).  The invariants this buys: the bus stream
+    # carries frames from every node kind, the STRAGGLER watchdog names
+    # exactly the stalled node (transit-lag skew vs the cluster median —
+    # never the killed-and-recovered aggregator, whose own frames are
+    # local), and the aggregator SURVIVES its crash: the recovered
+    # incarnation appends to the same metrics_bus stream and post-
+    # recovery frames appear (epochs past the resume boundary).  No
+    # fencing: a gray-slow peer without the detector is just a slow
+    # cluster — exactly the situation a live monitor must surface.
+    "monitor-grayslow": dict(
+        node_cnt=3, epoch_batch=256, synth_table_size=6144,
+        metrics=True, logging=True, replica_cnt=1, fault_kill="0:64",
+        fault_peer_stall="1:1500:3.0", done_secs=10.0,
+        log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
     # partition & gray-failure tolerance (runtime/faildet.py): fencing
     # armed on a 3-server elastic cluster, the native partition/stall
     # blackholes driving it.  Windows stay FULL under --quick like the
@@ -324,7 +343,7 @@ def run_scenario(name: str, quick: bool = False,
                        f"(have {sorted(SCENARIOS)})")
     spec = dict(SCENARIOS[name])
     if quick and not name.startswith(("elastic-", "geo-", "overload-",
-                                      "partition-")):
+                                      "partition-", "monitor-")):
         # elastic scenarios keep their full window: the cutover stall
         # (row stream + boundary sync, 1.4-2.2 s measured on the CI box;
         # ~5 s replay-jit for kill-reassign) would otherwise swallow a
@@ -374,7 +393,7 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
                  f"{name}: more unique acks ({c['txn_cnt']}) than unique "
                  f"sends ({c['sent_cnt']}) — a tag was acked twice")
     if name not in ("kill-one-server", "repair-contention",
-                    "trace-kill"):
+                    "trace-kill", "monitor-grayslow"):
         # deterministic replicated validation must survive the faults
         # (and any membership cutover): identical [summary] commit
         # counts on every reporting server — except where a server was
@@ -418,6 +437,11 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
                      "repair-contention: a server summary lacks repair "
                      "accounting")
         _check_recovery(cfg, out, run_id, report)
+    if name == "monitor-grayslow":
+        # the crash/recovery oracle first (node 0 = the aggregator is
+        # the killed node), then the bus/watchdog audit on top
+        _check_recovery(cfg, out, run_id, report)
+        _check_monitor(cfg, srv, cls, run_id, report)
     if name.startswith("elastic-"):
         _check_elastic(name, cfg, out, report)
     if name.startswith("geo-"):
@@ -857,6 +881,73 @@ def _check_trace(cfg: Config, srv: list[dict], cls: list[dict],
     _require(any(e["pid"] >= cfg.node_cnt for e in flows),
              "trace-kill: flow arrows never touch a client track")
     report["trace_flow_events"] = len(flows)
+
+
+def _check_monitor(cfg: Config, srv: list[dict], cls: list[dict],
+                   run_id: str, report: dict) -> None:
+    """Metrics-bus oracle (the tools/smoke.sh ``monitor`` gate):
+
+    * the bus was LIVE everywhere (anti-inert: mb_frames_sent > 0 in
+      every reporting summary) and the aggregator actually aggregated
+      (the metrics_bus stream holds frames from every server AND the
+      client);
+    * the STRAGGLER watchdog fired and named EXACTLY the gray-slow node
+      — never the killed-and-recovered aggregator or the healthy peer
+      (transit-lag skew is the criterion, so a locally-fed aggregator
+      and a merely-restarted node stay clean);
+    * the aggregator SURVIVED its fault_kill: the recovered incarnation
+      appended to the same stream, visible as frames with epochs past
+      the recovery resume boundary;
+    * per-epoch conflict density rode the frames (the router item's
+      input signal exists end to end).
+    """
+    from deneva_tpu.runtime.metricschema import read_metrics
+
+    for s in srv + cls:
+        _require(s.get("mb_frames_sent", 0.0) > 0,
+                 "monitor-grayslow: a node's summary shows zero bus "
+                 "frames (is the metrics bus live?)")
+    stall_node = cfg.fault_peer_stall_spec()[0]
+    kill_node, _ = cfg.fault_kill_spec()
+    tdir = os.path.join(cfg.log_dir, run_id)
+    rows = read_metrics(os.path.join(
+        tdir, f"metrics_bus_node{kill_node}.jsonl"))
+    _require(len(rows) > 0,
+             "monitor-grayslow: the aggregator's bus stream is empty")
+    frames = [r for r in rows if "kind" not in r and "commit" in r]
+    by_node = {int(r.get("node", -1)) for r in frames}
+    report["bus_nodes"] = sorted(by_node)
+    _require(set(range(cfg.node_cnt)) <= by_node,
+             f"monitor-grayslow: bus stream missing server frames "
+             f"(saw nodes {sorted(by_node)})")
+    _require(any(n >= cfg.node_cnt for n in by_node),
+             "monitor-grayslow: no client frame ever reached the bus")
+    # aggregator survival: post-recovery frames past the resume boundary
+    resume = report["resume_epoch"]
+    post = [r for r in frames
+            if r.get("role") == "server" and int(r["epoch"]) >= resume]
+    report["bus_frames"] = len(frames)
+    report["bus_post_recovery"] = len(post)
+    _require(len(post) > 0,
+             f"monitor-grayslow: no frame past the resume boundary "
+             f"{resume} — the recovered aggregator never resumed the "
+             "stream")
+    # straggler watchdog: fired, and ONLY on the stalled node
+    watches = [r for r in rows if r.get("kind") == "straggler"]
+    subjects = {int(w.get("subject", -1)) for w in watches}
+    report["straggler_subjects"] = sorted(subjects)
+    _require(len(watches) > 0,
+             "monitor-grayslow: the gray-slow node was never flagged "
+             "(is the straggler watchdog live?)")
+    _require(subjects == {stall_node},
+             f"monitor-grayslow: straggler watchdog named "
+             f"{sorted(subjects)}, expected exactly node {stall_node}")
+    # the contention signal rode the frames end to end
+    dens = [r for r in frames if r.get("density")]
+    report["bus_density_frames"] = len(dens)
+    _require(len(dens) > 0,
+             "monitor-grayslow: no frame carried a conflict-density "
+             "vector (the router item's input signal is missing)")
 
 
 def _check_recovery(cfg: Config, out: dict, run_id: str,

@@ -228,6 +228,36 @@ def parse_telemetry(lines) -> list[dict[str, Any]]:
     return _parse_tagged(lines, _TELEMETRY)
 
 
+_CRIT = re.compile(r"\[crit\] (.*)")
+_WATCH = re.compile(r"\[watch\] (.*)")
+
+
+def parse_metrics(lines) -> list[dict[str, Any]]:
+    """Metrics-bus tagged lines (runtime/metricsbus.py) — BOTH
+    families, each row stamped with its ``family``:
+
+    * ``[crit]`` critical-path attribution (one per emit window):
+      {family: "crit", node, epoch, gate, wall_ms, admit_ms, wire_ms,
+      device_ms, retire_ms, other_ms, quorum_ms} — the wall stages sum
+      to wall_ms by construction (CritLedger), quorum_ms is the
+      overlapped hold->release ledger competing for ``gate``.
+    * ``[watch]`` anomaly watchdog events: {family: "watch", node,
+      kind, subject, ...} with kind in epoch_stall / straggler /
+      jit_recompile (per-kind extra fields ride along; the structured
+      twin of each event also lands in metrics_bus_*.jsonl).
+
+    Logs predating the metrics bus yield [] — and every other parser
+    here ignores ``[crit]``/``[watch]`` lines — the same forward/
+    backward-compat contract as ``parse_membership`` through
+    ``parse_telemetry`` (tested in tests/test_harness.py)."""
+    lines = list(lines)
+    rows = [dict(family="crit", **d)
+            for d in _parse_tagged(lines, _CRIT)]
+    rows += [dict(family="watch", **d)
+             for d in _parse_tagged(lines, _WATCH)]
+    return rows
+
+
 def cfg_header(cfg: Config) -> str:
     """`# cfg key=value` echo lines the runner prepends to each output file
     so parsing never has to re-derive the config from the filename."""

@@ -68,9 +68,17 @@ FENCING_TRACK = Track(3, "fencing",
 # their own track beside the phase clocks — wall-timestamped spans, not
 # running-clock ledgers, so they never share a tid with the above
 TXN_TRACK = Track(4, "txn")
+# metrics-bus critical-path attribution (runtime/metricsbus.py): one
+# span per [crit] emit window named for the GATING stage — the
+# at-a-glance "what bound this node" track beside the phase clocks
+CRITPATH_TRACK = Track(5, "critpath",
+                       frozenset(("crit_admit", "crit_wire",
+                                  "crit_device", "crit_retire",
+                                  "crit_quorum", "crit_other")))
 
 TRACKS: tuple[Track, ...] = (PHASE_TRACK, REPLICATION_TRACK,
-                             ADMISSION_TRACK, FENCING_TRACK, TXN_TRACK)
+                             ADMISSION_TRACK, FENCING_TRACK, TXN_TRACK,
+                             CRITPATH_TRACK)
 
 # span name -> owning track for the [timeline] ledger families
 SPAN_TRACK: dict[str, Track] = {name: t for t in TRACKS
@@ -80,6 +88,7 @@ SPAN_TRACK: dict[str, Track] = {name: t for t in TRACKS
 REPLICATION_SPANS = REPLICATION_TRACK.spans
 ADMISSION_SPANS = ADMISSION_TRACK.spans
 FENCING_SPANS = FENCING_TRACK.spans
+CRITPATH_SPANS = CRITPATH_TRACK.spans
 
 
 def parse_timeline(lines) -> list[dict]:

@@ -170,6 +170,17 @@ class ClientNode:
         if cfg.telemetry:
             from deneva_tpu.runtime.telemetry import FlightRecorder
             self.tel = FlightRecorder(cfg, self.me, "client")
+        # ---- live metrics bus (runtime/metricsbus.py — off on a
+        # default config: no frame is ever built and the send path is
+        # untouched byte for byte).  The client ships wall-cadence
+        # frames (no epochs to key on): ack/resend/backoff rates + the
+        # open-loop backlog, to the lowest-id active server. ----
+        self.mbus = None
+        if cfg.metrics:
+            from deneva_tpu.runtime import metricsbus as _MB
+            self._MB = _MB
+            self.mbus = _MB.BusSender(cfg, self.me, _MB.ROLE_CLIENT)
+            self._mb_last = {"acked": 0, "resend": 0, "backoff": 0}
         # elastic + fault mode: remember which server each tag's inflight
         # credit is CHARGED to.  After a retarget, the first ack may come
         # from a different server than the charge (the drained-but-alive
@@ -509,6 +520,28 @@ class ClientNode:
                                     ST_RESEND, t_us=now_us)
                 self._nack_resend_cnt += n
 
+    def _mb_frame(self, backlog) -> None:
+        """Ship one client metrics frame (wall-cadence) to the lowest-id
+        active server — the aggregator's home.  Counters are deltas
+        since the last SENT frame (a tick with no active target keeps
+        its deltas for the next frame — the series may gap in transit,
+        never at the source); backlog is the open-loop arrival debt."""
+        act = np.where(self._active)[0]
+        if not len(act):
+            return
+        last = self._mb_last
+        acked = int(self.stats.counters.get("txn_cnt", 0))
+        counters = dict(
+            commit=acked - last["acked"],
+            resend=self._resend_cnt - last["resend"],
+            backoff=self._nack_resend_cnt - last["backoff"],
+            backlog=int(backlog) if backlog is not None else 0,
+            pending=len(self._resend_q))
+        last.update(acked=acked, resend=self._resend_cnt,
+                    backoff=self._nack_resend_cnt)
+        parts, _rec = self.mbus.frame(-1, counters)
+        self.tp.sendv(int(act[0]), "METRICS", parts)
+
     # -- geo tier: nearest-primary writes + follower snapshot reads -----
     def _geo_write_targets(self) -> list[int]:
         """Servers of the nearest tier (by region, then WAN delay) that
@@ -696,6 +729,11 @@ class ClientNode:
                 # boundaries): a saturated multi-second run otherwise
                 # fills the ring and silently drops the tail's acks
                 self.tel.flush()
+            if self.mbus is not None \
+                    and self.mbus.client_due(time.monotonic_ns() // 1000):
+                # metrics bus: wall-cadence client frame (ack/resend/
+                # backoff rates + the open-loop backlog)
+                self._mb_frame(backlog)
             self._drain(lat, timeout_us=0 if progressed else 2_000)
         # drain trailing responses so server-side commits are counted
         t_end = time.monotonic() + 0.3
@@ -739,6 +777,10 @@ class ClientNode:
             self.tel.flush()
             self.tel.summary_into(st)
             print(telemetry_line(self.me, self.tel.fields()), flush=True)
+        if self.mbus is not None:
+            # metrics bus counters (frames shipped; no density or crit
+            # windows on a client)
+            self.mbus.summary_into(st)
         if self._elastic:
             st.set("map_version", float(self._map_version))
             st.set("redirect_resend_cnt", float(self._redirect_resends))

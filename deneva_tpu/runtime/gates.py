@@ -163,6 +163,25 @@ GATES: dict[str, GateSpec] = {s.name: s for s in (
         use_attrs=("tel", "_metrics"),
     ),
     GateSpec(
+        "metrics",
+        # live metrics bus (runtime/metricsbus.py): per-epoch frames ->
+        # lowest-id live aggregator, [crit]/[watch] analysis layers,
+        # metrics_bus_*.jsonl stream.  metrics_cadence is a depth knob
+        # with a live default (like telemetry_sample) — arming is
+        # `metrics` alone.  `mbus` is the per-node sender handle
+        # (None until armed — `self.mbus is not None` is the canonical
+        # gate on server AND client); `magg` the aggregator (lazily
+        # built on the lowest live server); `_MB` the lazily-imported
+        # module stamped under `if cfg.metrics:` — any self._MB.x IS a
+        # use, like elastic's _M.  The SHARED schema module
+        # (runtime/metricschema.py) is deliberately NOT home here: the
+        # flight recorder writes its per-epoch stream through it too.
+        flags=("metrics",),
+        guards=("metrics",),
+        home=("deneva_tpu/runtime/metricsbus.py",),
+        use_attrs=("mbus", "magg", "_MB"),
+    ),
+    GateSpec(
         "fencing",
         # partition & gray-failure tolerance: heartbeat failure
         # detection, fenced slot ownership, quorum reassignment

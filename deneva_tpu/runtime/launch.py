@@ -119,10 +119,11 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
         endpoints = tcp_endpoints(n_all, base_port=base)
     else:
         endpoints = ipc_endpoints(n_all, run_id)
-    if cfg.logging or cfg.telemetry:
+    if cfg.logging or cfg.telemetry or cfg.metrics:
         # namespace log files per run like the IPC endpoints, or two
         # concurrent clusters would truncate each other's logs; the
-        # telemetry sidecars live in the same per-run directory
+        # telemetry sidecars and the metrics-bus stream live in the
+        # same per-run directory
         cfg = cfg.replace(log_dir=os.path.join(cfg.log_dir, run_id))
     if timeout_s is None:
         # generous: every node jit-compiles its epoch step before the

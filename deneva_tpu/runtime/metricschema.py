@@ -1,0 +1,83 @@
+"""Shared per-epoch metrics JSONL schema (stdlib-only, gate-neutral).
+
+Two subsystems stream per-epoch counter records as JSON lines: the
+transaction flight recorder's ``metrics_node*.jsonl`` (PR 13,
+runtime/telemetry.py) and the live metrics bus's ``metrics_bus_*.jsonl``
+(runtime/metricsbus.py).  Both write through THIS one module — one
+record shape ({node, epoch, t_us, **fields}), one torn-line-tolerant
+reader, one sidecar-directory rule — so the two streams cannot drift
+apart.  This module belongs to neither gate: importing it arms nothing
+(a ``MetricsStream`` is only ever constructed behind ``telemetry`` or
+``metrics``), and with both flags off no code here runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def now_us() -> int:
+    """CLOCK_MONOTONIC microseconds — shared across processes on one
+    Linux box, which is what lets the single-box launcher rig join (and
+    lag-compare) cross-node records exactly.  Multi-host fleets need an
+    external clock alignment step (records carry the node id so a
+    per-host offset can be applied at read time)."""
+    return time.monotonic_ns() // 1000
+
+
+def stream_dir(cfg) -> str:
+    """Sidecar directory for every metrics stream: ``telemetry_dir`` or
+    the (possibly run-namespaced) ``log_dir`` — one place per run, like
+    the command logs and the flight-recorder sidecars."""
+    return cfg.telemetry_dir or cfg.log_dir
+
+
+class MetricsStream:
+    """Per-epoch structured counter stream (one JSON object per line).
+
+    Host-side counters only (no device fetch is ever added to a loop),
+    so the cost is one dict + one buffered write per record.  The
+    flight recorder emits at the server's retire position; the metrics
+    bus aggregator emits one line per received cluster frame."""
+
+    def __init__(self, path: str, node: int, append: bool = False):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.node = node
+        self._f = open(path, "a" if append else "w")
+        self.lines = 0
+
+    def emit(self, epoch: int, node: int | None = None, **fields) -> None:
+        """One record.  ``node`` defaults to the stream owner's id; the
+        bus aggregator overrides it with the FRAME's origin node so one
+        file carries the whole cluster."""
+        rec = {"node": self.node if node is None else node,
+               "epoch": epoch, "t_us": now_us()}
+        rec.update(fields)
+        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+        self.lines += 1
+
+    def flush(self) -> None:
+        if not self._f.closed:
+            self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self._f.close()
+
+
+def read_metrics(path: str) -> list[dict]:
+    """Load a metrics stream.  Torn lines are SKIPPED, not a stop
+    point: a recovered incarnation appends after an unclean death, so a
+    torn line can sit mid-file with valid post-recovery lines after
+    it."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

@@ -60,6 +60,13 @@ RTYPE = {
     # and HEAL rides the heal transition — their fault mode is the
     # partition itself, never silent single-frame loss.
     "HEARTBEAT": 22, "FENCE_NACK": 23, "HEAL": 24,
+    # live metrics bus (runtime/metricsbus.py): per-epoch metrics frame,
+    # node -> lowest-id live server (the aggregator).  Deliberately
+    # OUTSIDE FAULT_RTYPE_MASK like every gated rtype since 15 — frames
+    # are telemetry, lossy BY DESIGN: a dropped frame is a gap in a
+    # chart, never a correctness event, and the next cadence tick
+    # supersedes it.
+    "METRICS": 25,
 }
 RTYPE_NAME = {v: k for k, v in RTYPE.items()}
 

@@ -92,6 +92,10 @@ OWNER: dict[str, str] = {
     # verdict/hold pass, _flush_held_rsp's release — runs on the
     # dispatch thread; workers never touch the ring or the stream
     "tel": DISPATCH, "_metrics": DISPATCH,
+    # live metrics bus (runtime/metricsbus.py): frames assemble at the
+    # retire positions, the aggregator feeds from _route and ticks at
+    # group boundaries — all dispatch; workers never touch the bus
+    "mbus": DISPATCH, "magg": DISPATCH, "_MB": DISPATCH,
     # fencing layer (runtime/faildet.py): detector, heartbeat ledgers
     # and fence counters all live on the dispatch thread (_route runs
     # there; workers only READ smap/_FD for the envelope header)

@@ -62,18 +62,22 @@ def _make_epoch_body(cfg: Config, wl, be):
     merged batch, so verdicts agree without any vote exchange.
     Returns (body, b_merged) where body maps
     (db, cc_state, stats, active, ts, query) ->
-    (db, cc_state, stats, done, restart_abort, defer, rep).
+    (db, cc_state, stats, done, restart_abort, defer, rep, dens).
     ``rep`` marks txns that committed via transaction repair
     (engine/repair.py — a subset of ``done``; all-false when
     ``cfg.repair`` is off, and the group jit only packs its plane when
-    armed, so the off-wire stays bit-identical).
+    armed, so the off-wire stays bit-identical).  ``dens`` is the
+    per-partition observed-conflict density (int32[P], the metrics
+    bus's per-epoch contention signal) when ``cfg.metrics`` is armed,
+    else None — with metrics off the body computes nothing extra and
+    the group jit's outputs are exactly the pre-bus ones.
     """
     import jax.numpy as jnp
 
     import dataclasses as _dc
 
     from deneva_tpu.cc import (AccessBatch, build_conflict_incidence,
-                               gate_order_free)
+                               conflict_density, gate_order_free)
     from deneva_tpu.engine.step import forced_sentinel_mask
     from deneva_tpu.ops import forward_verdict, forwarding_applies
 
@@ -83,6 +87,7 @@ def _make_epoch_body(cfg: Config, wl, be):
 
     def step(db, cc_state, stats, active, ts, query):
         rep = None
+        dens = None
         rank = jnp.arange(b, dtype=jnp.int32)
         planned = wl.plan(db, query)
         batch = AccessBatch(
@@ -92,6 +97,7 @@ def _make_epoch_body(cfg: Config, wl, be):
             order_free=gate_order_free(cfg, be,
                                        planned.get("order_free")))
         forced = forced_sentinel_mask(batch) if cfg.ycsb_abort_mode else None
+        inc = None
         if forwarding:
             fbatch = batch if forced is None else _dc.replace(
                 batch, active=batch.active & ~forced)
@@ -134,6 +140,13 @@ def _make_epoch_body(cfg: Config, wl, be):
                     cfg, wl, be, db, query, batch, inc, verdict,
                     cc_state, stats, exec_commit, forced)
                 exec_commit = exec_commit | rep
+        if cfg.metrics:
+            # metrics bus: per-partition observed-conflict density off
+            # the incidence views the sweep already materialized (the
+            # forwarding path pays two bucket scatter-adds instead) —
+            # an OBSERVATION of the batch, never an input to any
+            # verdict, so replay determinism is untouched
+            dens = conflict_density(cfg, batch, planned["owner"], inc)
         # forced txns complete (acked + released by the caller via the
         # commit mask) but count as aborts, exactly like the engine
         commit = exec_commit & active
@@ -149,7 +162,7 @@ def _make_epoch_body(cfg: Config, wl, be):
         from deneva_tpu.engine.step import count_by_type
         count_by_type(stats, wl, query, commit, abort)
         rep = jnp.zeros_like(done) if rep is None else rep & active
-        return db, cc_state, stats, done, abort & ~done, defer, rep
+        return db, cc_state, stats, done, abort & ~done, defer, rep, dens
 
     return step, b
 
@@ -217,10 +230,15 @@ def make_dist_group(cfg: Config, wl, be, width: int, n_scalars: int):
         db, cc_state, stats = carry
         active, ts, keys, types, scal = xs
         query = wl.from_wire_dev(keys, types, scal)
-        db, cc_state, stats, done, abort, defer, rep = body(
+        db, cc_state, stats, done, abort, defer, rep, dens = body(
             db, cc_state, stats, active, ts, query)
-        return (db, cc_state, stats), (done[sl], abort[sl], defer[sl],
-                                       rep[sl])
+        outs = (done[sl], abort[sl], defer[sl], rep[sl])
+        if cfg.metrics:
+            # per-epoch density plane rides the scan outputs ONLY when
+            # the bus is armed — off, the d2h volume is exactly the
+            # pre-bus verdict planes
+            outs = outs + (dens,)
+        return (db, cc_state, stats), outs
 
     def pack(m):
         # bool[C, b_loc] -> uint8[C, pb/8], little-endian bit order (the
@@ -247,8 +265,12 @@ def make_dist_group(cfg: Config, wl, be, width: int, n_scalars: int):
         (db, cc_state, stats), masks = jax.lax.scan(
             scan_body, (db, cc_state, stats),
             (active, ts, keys, types, scal))
-        return db, cc_state, stats, jnp.stack(
-            [pack(masks[i]) for i in range(n_planes)])
+        planes = jnp.stack([pack(masks[i]) for i in range(n_planes)])
+        if cfg.metrics:
+            # int32[C, P] per-epoch density beside the packed planes
+            # (always the LAST scan output when armed)
+            return db, cc_state, stats, planes, masks[-1]
+        return db, cc_state, stats, planes
 
     return group
 
@@ -641,6 +663,24 @@ class ServerNode:
                 os.path.join(_T.telemetry_dir(cfg),
                              f"metrics_node{self.me}.jsonl"),
                 self.me, append=cfg.recover)
+
+        # ---- live metrics bus (runtime/metricsbus.py — off on a
+        # default config: no frame, no rtype 25 on the wire, no
+        # aggregator, no [crit]/[watch] line; every broadcast byte
+        # bit-identical).  The boot aggregator is server 0; the role
+        # follows the lowest-id LIVE server (a later receiver builds
+        # its aggregator lazily at the first frame addressed to it).
+        # Recovery appends to the pre-crash bus stream like the command
+        # log, so a killed aggregator resumes its series. ----
+        self.mbus = None
+        self.magg = None
+        if cfg.metrics:
+            from deneva_tpu.runtime import metricsbus as _MB
+            self._MB = _MB
+            self.mbus = _MB.BusSender(cfg, self.me, _MB.ROLE_SERVER)
+            if self.me == 0:
+                self.magg = _MB.Aggregator(cfg, self.me,
+                                           append=cfg.recover)
 
         # ---- chaos / failover gates (all off on a default config) ------
         # _failover: peers tolerate a dead server and wait for its
@@ -1057,6 +1097,15 @@ class ServerNode:
             if ver > self.smap.version and self._mig_pending is None \
                     and self.me not in owners:
                 self._self_fence("healed_out", ep)
+        elif rtype == "METRICS":
+            # metrics bus frame: the sender believes we are the lowest
+            # live server — aggregate (building the aggregator lazily
+            # covers the role handoff after the boot aggregator retires)
+            if self.mbus is not None:
+                if self.magg is None:
+                    self.magg = self._MB.Aggregator(self.cfg, self.me,
+                                                    append=self.cfg.recover)
+                self.magg.feed(self._MB.frame_record(payload))
         elif rtype == "INIT_DONE":
             pass  # late barrier duplicate; the barrier itself already ran
 
@@ -1127,6 +1176,9 @@ class ServerNode:
         if ok.all():
             return blk
         nk = np.where(~ok)[0]
+        if self.mbus is not None:
+            # bus frame field: admission NACKs since the last frame
+            self.mbus.shed += len(nk)
         # clip before the uint32 narrowing: a tiny quota against a big
         # deficit can push the refill hint past 2^32 us
         self.tp.sendv(src, "ADMIT_NACK",
@@ -1277,6 +1329,8 @@ class ServerNode:
             # the fenced node's lifecycle events stay auditable
             self.tel.flush()
             self._metrics.close()
+        if self.magg is not None:
+            self.magg.close()
         self.tp.flush()
         os._exit(self._FD.FENCED_EXIT)
 
@@ -1629,6 +1683,11 @@ class ServerNode:
                 elif self.n_repl:
                     self._drain(timeout_us=10_000)
         durable = self._durable_ack_epoch()
+        if self.mbus is not None:
+            # bus quorum ledger: hold -> release lag of every epoch
+            # whose acks just went durable (the generic twin of the geo
+            # quorum ledger below — armed by metrics alone)
+            self.mbus.release_through(durable, time.monotonic())
         if self._geo and self._quorum_hold_t:
             # quorum wait ledger: hold -> release lag of each retiring
             # epoch.  Epochs wait overlapped (the pipeline holds whole
@@ -2171,6 +2230,36 @@ class ServerNode:
                 self.tel.record(tags[held], ST_HOLD, epoch=epoch,
                                 t_us=t_us)
 
+    # -- metrics bus: frame emission + aggregator targeting --------------
+    def _mb_agg(self) -> int:
+        """The aggregator's node id: the lowest-id LIVE server (elastic
+        retirement hands the role down; a killed-and-recovering
+        aggregator keeps it — frames sent into its death window are
+        lost, which the bus's lossy-telemetry contract permits)."""
+        if self._elastic and self._reassigned:
+            return min(p for p in range(self.n_srv)
+                       if p not in self._reassigned)
+        return 0
+
+    def _mb_emit(self, epoch: int, dens_row, commit: int, ab: int,
+                 df: int, salv: int) -> None:
+        """Ship one per-epoch frame (or feed it straight into the local
+        aggregator when this node holds the role)."""
+        counters = dict(
+            commit=commit, abort=ab, defer=df, salvage=salv,
+            pending=len(self.pending), retry_depth=len(self.retry.items),
+            held_rsp=len(self._held_rsp),
+            adm_depth=self.adm.depth if self.adm is not None else 0)
+        parts, rec = self.mbus.frame(epoch, counters, dens_row)
+        agg = self._mb_agg()
+        if agg == self.me:
+            if self.magg is None:
+                self.magg = self._MB.Aggregator(self.cfg, self.me,
+                                                append=self.cfg.recover)
+            self.magg.feed(rec)
+        else:
+            self.tp.sendv(agg, "METRICS", parts)
+
     # -- verdict retirement (the back half of an epoch) ------------------
     def _retire(self, group: dict, tl) -> None:
         """Fetch a dispatched group's commit masks (ONE host<->device
@@ -2200,6 +2289,11 @@ class ServerNode:
             done, abort, defer = (np.asarray(m)
                                   for m in jax.device_get(group["masks"]))
         self._ph["process"] += time.monotonic() - t0
+        dens = None
+        if self.mbus is not None and group.get("dens_dev") is not None:
+            # per-epoch density plane [C, P]: same d2h cadence as the
+            # verdict planes (the async copy started at dispatch)
+            dens = np.asarray(jax.device_get(group["dens_dev"]))
         lo = self._plane_lo if group["packed"] else 0
         for i, (epoch, block, abort_cnt, birth_ts, dfc) in enumerate(
                 group["eps"]):
@@ -2314,6 +2408,19 @@ class ServerNode:
                     held_rsp=len(self._held_rsp),
                     adm_depth=self.adm.depth
                     if self.adm is not None else 0)
+            if self.mbus is not None:
+                # metrics bus: quorum-hold ledger + the per-epoch frame
+                # (the aggregator's cluster view; density row from the
+                # group's device plane when the merged path produced one)
+                if self.logger is not None and my_commit.any():
+                    self.mbus.hold(epoch, time.monotonic())
+                if self.mbus.due(epoch):
+                    self._mb_emit(
+                        epoch, dens[i] if dens is not None else None,
+                        int(my_commit.sum()), int(ab.sum()),
+                        int(df.sum()),
+                        int((rep[i, lo:lo + n] & my_commit).sum())
+                        if rep is not None else 0)
             restart = ab | df
             if restart.any():
                 idx = np.where(restart)[0]
@@ -2406,6 +2513,10 @@ class ServerNode:
                 cfg, [p for p in range(self.n_srv) if p != self.me],
                 time.monotonic())
         self._t_run0 = time.monotonic()
+        if self.mbus is not None:
+            # re-anchor the critical-path ledger NOW: jit compile +
+            # barrier time is setup, not epoch wall
+            self.mbus.crit.reset()
         t_start = time.monotonic()
         prog_next = t_start + cfg.prog_timer_secs
         warm_edge = t_start + cfg.warmup_secs
@@ -2441,6 +2552,10 @@ class ServerNode:
                     # sidecar (the restarted incarnation appends)
                     self.tel.flush()
                     self._metrics.close()
+                if self.magg is not None:
+                    # bus stream intact to the kill boundary; the
+                    # recovered aggregator appends (its series resumes)
+                    self.magg.close()
                 if self._elastic:
                     # reassignment (instead of restart) needs every
                     # survivor to stall at the SAME first-missing epoch:
@@ -2558,6 +2673,12 @@ class ServerNode:
                 self.tp.flush()
             if tl:
                 tl.mark("admit")
+            if self.mbus is not None:
+                # critical-path ledger: everything since the last pass
+                # closed (inbound drain, heartbeats, contribution
+                # assembly, admission, blob broadcast staging) is the
+                # admit stage
+                self.mbus.crit.lap("admit")
             # ---- collect every peer's contributions -------------------
             t0 = time.monotonic()
             if self._overlap:
@@ -2575,6 +2696,10 @@ class ServerNode:
                 self._ph["idle"] += time.monotonic() - t0
             if tl:
                 tl.mark("collect")
+            if self.mbus is not None:
+                # the blob-collect wait: the wire stage (peer skew +
+                # network transit show up exactly here)
+                self.mbus.crit.lap("wire")
             # ---- build the stacked device feed [C, b] -----------------
             if self._overlap:
                 keys, types, scal = fs["keys"], fs["types"], fs["scal"]
@@ -2652,6 +2777,7 @@ class ServerNode:
                 masks = (commit[None, mine], abort[None, mine],
                          defer[None, mine])
                 packed = False
+                dens_dev = None     # vote mode: no merged density plane
             else:
                 # FLAT explicit async device_put: the raw wire columns
                 # decode on device (wl.from_wire_dev inside the group
@@ -2676,6 +2802,14 @@ class ServerNode:
                                       self.dev_stats, *feed)
                 self.db, self.cc_state, self.dev_stats = out[:3]
                 masks = out[3]
+                if self.mbus is not None:
+                    # the bus-armed group jit returns the density plane
+                    # beside the packed verdict planes
+                    dens_dev = out[4]
+                    if hasattr(dens_dev, "copy_to_host_async"):
+                        dens_dev.copy_to_host_async()
+                else:
+                    dens_dev = None
                 packed = True
                 # start the verdict d2h now; retirement K groups later
                 # finds the copy already landed instead of paying the
@@ -2685,8 +2819,13 @@ class ServerNode:
             self._ph["process"] += time.monotonic() - t_step
             if tl:
                 tl.mark("dispatch")
+            if self.mbus is not None:
+                # feed build + device dispatch: the device stage (a
+                # recompile spike is the jit watchdog's signature)
+                self.mbus.crit.lap("device")
             group = {"eps": eps, "masks": masks, "packed": packed,
-                     "feed": fs, "wire_futs": wire_futs}
+                     "feed": fs, "wire_futs": wire_futs,
+                     "dens_dev": dens_dev}
             if self._full_planes and packed:
                 # full-plane retirement needs every slice's packed tags
                 # (copied: overlap feed buffers recycle under the group)
@@ -2719,6 +2858,10 @@ class ServerNode:
             # ---- retire the oldest group once K are in flight ----------
             while len(inflight) > K - 1:
                 self._retire(inflight.popleft(), tl)
+            if self.mbus is not None:
+                # verdict retirement (mask fetch + acks + retry
+                # routing): the retire stage
+                self.mbus.crit.lap("retire")
             now = time.monotonic()
             if progress and group_end % 50 < C:
                 progress(self, group_end)
@@ -2775,6 +2918,17 @@ class ServerNode:
                             # by 1e3); the geo ledgers are ms
                             tl.spans.append((name, ms / 1e3))
                 tl.emit(self.me, group_end)
+            if self.mbus is not None:
+                # close the critical-path pass; at the emit cadence the
+                # ledger prints the [crit] attribution line and hands
+                # back the gating stage for the critpath trace track
+                gated = self.mbus.crit.end_pass(group_end)
+                if gated is not None and tl:
+                    tl.spans.append(("crit_" + gated[0], gated[1]))
+                if self.magg is not None:
+                    # aggregator heartbeat: the cluster-silence watchdog
+                    # + a stream flush so the live TUI tails fresh lines
+                    self.magg.tick(time.monotonic())
             if self.stop_epoch is not None and group_end >= self.stop_epoch:
                 while inflight:
                     self._retire(inflight.popleft(), tl)
@@ -2895,6 +3049,15 @@ class ServerNode:
             self.tel.summary_into(st)
             st.set("metrics_lines", float(self._metrics.lines))
             print(telemetry_line(self.me, self.tel.fields()), flush=True)
+        if self.mbus is not None:
+            # metrics bus counters ([summary] satellite): frames sent,
+            # [crit] windows, per-partition density totals; the
+            # aggregator adds its receive/watch accounting and closes
+            # the metrics_bus_*.jsonl stream the TUI tails
+            self.mbus.summary_into(st)
+            if self.magg is not None:
+                self.magg.summary_into(st)
+                self.magg.close()
         if self._fencing:
             # fencing counters ([summary]) + the [fencing] line (parsed
             # by harness.parse.parse_fencing) + the sidecar the chaos
@@ -2950,6 +3113,10 @@ class ServerNode:
             self.wire_pool.shutdown(wait=True)
         if self.retire_pool is not None:
             self.retire_pool.shutdown(wait=True)
+        if self.magg is not None:
+            # idempotent: the summary path already closed it on the
+            # normal exit; this covers error unwinds
+            self.magg.close()
         self.tp.close()
 
 

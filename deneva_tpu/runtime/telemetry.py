@@ -40,7 +40,6 @@ Join + render the sidecars with ``python -m deneva_tpu.harness.txntrace``.
 
 from __future__ import annotations
 
-import json
 import os
 import struct
 import time
@@ -48,6 +47,7 @@ import time
 import numpy as np
 
 from deneva_tpu.config import Config
+from deneva_tpu.runtime import metricschema as _schema
 from deneva_tpu.stats import tagged_line
 
 # lane bits of a tag (below the tenant byte at 24..31): the sampling key
@@ -95,8 +95,10 @@ _VERSION = 1
 
 def telemetry_dir(cfg: Config) -> str:
     """Sidecar directory: ``telemetry_dir`` or the (possibly run-
-    namespaced) ``log_dir`` — one place per run, like the command logs."""
-    return cfg.telemetry_dir or cfg.log_dir
+    namespaced) ``log_dir`` — one place per run, like the command logs
+    (the shared rule lives in runtime/metricschema.py so the metrics
+    bus's sidecars land beside these)."""
+    return _schema.stream_dir(cfg)
 
 
 def sampled_mask(tags: np.ndarray, sample: int) -> np.ndarray:
@@ -105,8 +107,7 @@ def sampled_mask(tags: np.ndarray, sample: int) -> np.ndarray:
     return (np.asarray(tags, np.int64) & LANE_MASK) % sample == 0
 
 
-def now_us() -> int:
-    return time.monotonic_ns() // 1000
+now_us = _schema.now_us
 
 
 class FlightRecorder:
@@ -269,41 +270,9 @@ def read_telemetry(path: str) -> tuple[dict, np.ndarray]:
             "version": version}, recs
 
 
-class MetricsStream:
-    """Per-epoch structured counter stream (``metrics_node*.jsonl``).
-
-    One JSON object per retired epoch — host-side counters only (no
-    device fetch is ever added to the loop), so the cost is one dict +
-    one buffered write per epoch at the retire position."""
-
-    def __init__(self, path: str, node: int, append: bool = False):
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        self.path = path
-        self.node = node
-        self._f = open(path, "a" if append else "w")
-        self.lines = 0
-
-    def emit(self, epoch: int, **fields) -> None:
-        rec = {"node": self.node, "epoch": epoch, "t_us": now_us()}
-        rec.update(fields)
-        self._f.write(json.dumps(rec, separators=(",", ":")) + "\n")
-        self.lines += 1
-
-    def close(self) -> None:
-        if not self._f.closed:
-            self._f.close()
-
-
-def read_metrics(path: str) -> list[dict]:
-    """Load a metrics stream.  Torn lines are SKIPPED, not a stop
-    point: a recovered incarnation appends after an unclean death, so a
-    torn line can sit mid-file with valid post-recovery lines after
-    it."""
-    out = []
-    with open(path) as f:
-        for line in f:
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue
-    return out
+# Per-epoch structured counter stream (``metrics_node*.jsonl``) and its
+# reader: the SHARED schema module owns both, so this stream and the
+# metrics bus's ``metrics_bus_*.jsonl`` (runtime/metricsbus.py) cannot
+# drift apart.  Re-exported under the established names.
+MetricsStream = _schema.MetricsStream
+read_metrics = _schema.read_metrics

@@ -1,0 +1,9 @@
+"""Fixture config: just the metrics flag, default OFF (the registry
+drift check cross-parses this module against the REAL metrics
+GateSpec)."""
+
+
+class Config:
+    metrics: bool = False
+    metrics_cadence: int = 1
+    node_cnt: int = 1

@@ -1,0 +1,39 @@
+"""Seeded graftlint violations: the REAL ``metrics`` GateSpec
+(runtime/gates.py) checked against fixture call sites — an unguarded
+call into the metrics-bus home module must fail the lint, the guarded
+idioms the runtime actually uses (``cfg.metrics`` at construction, the
+sender/aggregator handles' ``is not None`` checks, the
+``rtype == "METRICS"`` route branch) must stay silent."""
+
+from deneva_tpu.runtime.metricsbus import (Aggregator, BusSender,
+                                           crit_line, frame_record)
+
+
+class ServerFx:
+    def __init__(self, cfg):
+        self.mbus = None
+        self.magg = None
+        if cfg.metrics:
+            # the runtime idiom: the flag test dominates construction
+            self.mbus = BusSender(cfg, 0, 0)
+            self.magg = Aggregator(cfg, 0)
+
+    def ok_emit(self, epoch):
+        # the sender object doubles as its own guard
+        if self.mbus is not None:
+            return self.mbus.frame(epoch, {})
+        return None
+
+    def ok_route(self, rtype, payload):
+        # a gated rtype's route branch establishes the gate (the
+        # message only exists once the subsystem armed it)
+        if rtype == "METRICS":
+            if self.magg is not None:
+                self.magg.feed(frame_record(payload))
+
+    def bad_record(self, payload):
+        # no dominating metrics-flag test on any path to the call
+        return frame_record(payload)      # EXPECT[gate-unguarded-use]
+
+    def bad_line(self):
+        return crit_line(0, {})           # EXPECT[gate-unguarded-use]

@@ -184,6 +184,27 @@ def test_telemetry_gate_fires_on_unguarded_use():
         "\n".join(f.render() for f in findings)
 
 
+def test_metrics_gate_fires_on_unguarded_use():
+    """The REAL ``metrics`` GateSpec (runtime/gates.py) catches an
+    unguarded call into runtime/metricsbus.py and accepts the guarded
+    idioms the runtime uses (``cfg.metrics`` at construction, the
+    sender/aggregator handles' ``is not None`` checks, and the
+    ``rtype == "METRICS"`` route branch — a gated rtype only exists
+    once the subsystem armed it) — the CI teeth behind the metrics
+    bus's default-off bit-identity contract."""
+    from deneva_tpu.runtime.gates import GATES
+
+    root = os.path.join(FIX, "gate_bad_metrics")
+    tree = Tree(root, ["."])
+    findings = tree.filter(gateconsistency.check(
+        tree, gates={"metrics": GATES["metrics"]}, exempt=(),
+        escrow_funcs=(), escrow_home=(),
+        config_module="deneva_tpu/config.py", guarded=(),
+        model={"METRICS": WIRE_MODEL["METRICS"]}))
+    assert _got(findings) == _expected(root), \
+        "\n".join(f.render() for f in findings)
+
+
 def test_gate_registry_matches_config():
     """Executable half of gate-registry-drift: every registered flag is
     a real Config field defaulting OFF, every wiremodel gate names a

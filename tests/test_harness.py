@@ -504,6 +504,60 @@ def test_parse_telemetry_forward_backward_compat(tmp_path):
     assert parse_file(str(old_log))["tput"] == 5
 
 
+def test_parse_metrics_forward_backward_compat(tmp_path):
+    """[crit]/[watch] lines (metrics-bus satellite): critical-path
+    attribution + anomaly watchdog events via the shared _parse_tagged
+    body; old logs yield [], the new lines perturb no other parser, and
+    the [summary] bus fields parse through the standard summary path."""
+    from deneva_tpu.harness.parse import (parse_admission, parse_fencing,
+                                          parse_file, parse_membership,
+                                          parse_metrics, parse_repair,
+                                          parse_replication,
+                                          parse_telemetry)
+    from deneva_tpu.harness.timeline import parse_timeline
+
+    new_log = tmp_path / "metricsbus.out"
+    new_log.write_text(
+        "# cfg node_cnt=3\n"
+        "[crit] node=0 epoch=96 admit_ms=3.1 wire_ms=41.7 device_ms=9.2 "
+        "retire_ms=2.4 other_ms=1.1 quorum_ms=0.0 wall_ms=57.5 "
+        "gate=wire\n"
+        "[watch] node=0 kind=straggler subject=1 lag_ms=1480.2 "
+        "cluster_ms=2.1 epoch=211\n"
+        "[watch] node=0 kind=jit_recompile subject=2 device_ms=912.0 "
+        "median_ms=8.4 epoch=340\n"
+        "[summary] total_runtime=2,tput=29588,txn_cnt=59328,"
+        "mb_frames_sent=720,mb_frames_rx=2103,mb_crit_cnt=9,"
+        "mb_watch_cnt=3,mb_density_p0=4412,mb_density_p1=391\n")
+    rows = parse_metrics(new_log.read_text().splitlines())
+    assert len(rows) == 3
+    crit = [r for r in rows if r["family"] == "crit"]
+    watch = [r for r in rows if r["family"] == "watch"]
+    assert crit[0]["gate"] == "wire" and crit[0]["wall_ms"] == 57.5
+    # the attribution contract: wall stages sum to wall_ms (within 5%)
+    stages = sum(crit[0][s + "_ms"] for s in
+                 ("admit", "wire", "device", "retire", "other"))
+    assert abs(stages - crit[0]["wall_ms"]) <= 0.05 * crit[0]["wall_ms"]
+    assert {w["kind"] for w in watch} == {"straggler", "jit_recompile"}
+    assert watch[0]["subject"] == 1 and watch[0]["lag_ms"] == 1480.2
+    row = parse_file(str(new_log))
+    assert row["mb_frames_sent"] == 720 and row["mb_density_p0"] == 4412
+    # other parsers ignore the new lines entirely
+    text = new_log.read_text().splitlines()
+    assert parse_membership(text) == []
+    assert parse_replication(text) == []
+    assert parse_admission(text) == []
+    assert parse_repair(text) == []
+    assert parse_fencing(text) == []
+    assert parse_telemetry(text) == []
+    assert parse_timeline(text) == []
+    # old log: no bus lines -> [] and unchanged parsing
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_metrics(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5
+
+
 def test_track_registry_covers_every_span_family():
     """The declared track registry (timeline.TRACKS) replaces the magic
     Chrome-trace tids: every tagged-line ledger family maps to exactly
@@ -512,6 +566,7 @@ def test_track_registry_covers_every_span_family():
     so a new subsystem's spans cannot silently collide with an
     existing tid."""
     from deneva_tpu.harness.timeline import (ADMISSION_SPANS,
+                                             CRITPATH_SPANS,
                                              FENCING_SPANS, PHASE_TRACK,
                                              REPLICATION_SPANS,
                                              SPAN_TRACK, TRACKS,
@@ -524,7 +579,8 @@ def test_track_registry_covers_every_span_family():
     assert PHASE_TRACK.tid == 0 and PHASE_TRACK in TRACKS
     assert TXN_TRACK in TRACKS and TXN_TRACK.tid != 0
     # every ledger span family is registered, with no overlap
-    for fam in (REPLICATION_SPANS, ADMISSION_SPANS, FENCING_SPANS):
+    for fam in (REPLICATION_SPANS, ADMISSION_SPANS, FENCING_SPANS,
+                CRITPATH_SPANS):
         assert fam, "an exported span family went empty"
         for name in fam:
             assert SPAN_TRACK[name].spans == fam

@@ -1,0 +1,520 @@
+"""Metrics bus (runtime/metricsbus.py): frame codec round-trips +
+forward-compat, the shared JSONL schema module, per-partition conflict
+density (unit + rank cross-validation against measured abort rates),
+critical-path ledger sum contract, anomaly watchdogs, the metrics-off
+wire pin on a loopback ServerNode (the default-off bit-identity
+contract), armed loopback aggregation, the monitor TUI/Prom renderers,
+and the end-to-end cluster stream (slow tier)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deneva_tpu.config import CCAlg, Config, WorkloadKind
+from deneva_tpu.runtime import metricsbus as MB
+from deneva_tpu.runtime import metricschema as MS
+from deneva_tpu.runtime import wire
+
+from tests.test_chaos import _solo_server
+
+
+def _cfg(tmp_path, **kw):
+    base = dict(metrics=True, telemetry_dir=str(tmp_path))
+    base.update(kw)
+    return Config(**base)
+
+
+# ---- frame codec -------------------------------------------------------
+
+def test_frame_roundtrip_and_parts_byte_identity():
+    fields = MB.pack_fields(dict(commit=12, abort=3, wall_ms=4.5))
+    dens = np.array([7, 0, 2], np.int32)
+    buf = MB.encode_metrics_frame(2, MB.ROLE_SERVER, 96, 123456,
+                                  fields, dens)
+    parts = MB.metrics_frame_parts(2, MB.ROLE_SERVER, 96, 123456,
+                                   fields, dens)
+    assert b"".join(bytes(p) for p in parts) == buf
+    node, role, epoch, t_us, f2, d2 = MB.decode_metrics_frame(buf)
+    assert (node, role, epoch, t_us) == (2, MB.ROLE_SERVER, 96, 123456)
+    np.testing.assert_array_equal(fields, f2)
+    np.testing.assert_array_equal(dens, d2)
+    # empty density (clients, vote-mode servers) round-trips too
+    buf0 = MB.encode_metrics_frame(5, MB.ROLE_CLIENT, -1, 9, fields,
+                                   np.zeros(0, np.int32))
+    *_, d0 = MB.decode_metrics_frame(buf0)
+    assert len(d0) == 0
+
+
+def test_frame_record_forward_compat():
+    """An OLDER sender's shorter field vector reads as zeros for the
+    fields it predates — the ignore-unknown posture of the tagged-line
+    parsers, applied to the binary frame."""
+    short = np.array([5.0, 2.0], np.float32)        # commit, abort only
+    buf = MB._FHDR.pack(1, MB.ROLE_SERVER, MB.MB_VERSION, 8, 77,
+                        len(short), 0) + short.tobytes()
+    rec = MB.frame_record(buf)
+    assert rec["commit"] == 5.0 and rec["abort"] == 2.0
+    assert rec["wall_ms"] == 0.0 and "density" not in rec
+    assert rec["role"] == "server" and rec["epoch"] == 8
+
+
+def test_pack_fields_rejects_unknown_keys():
+    with pytest.raises(ValueError):
+        MB.pack_fields(dict(not_a_field=1.0))
+
+
+# ---- shared schema module ----------------------------------------------
+
+def test_schema_module_is_the_single_writer(tmp_path):
+    """The dedupe satellite, executable: the flight recorder's stream
+    class IS the schema module's (no second implementation to drift),
+    and the bus stream writes the same record shape with a node
+    override."""
+    from deneva_tpu.runtime import telemetry as T
+    assert T.MetricsStream is MS.MetricsStream
+    assert T.read_metrics is MS.read_metrics
+    path = os.path.join(str(tmp_path), "bus.jsonl")
+    ms = MS.MetricsStream(path, 0)
+    ms.emit(4, commit=9)                   # owner node
+    ms.emit(4, node=2, commit=1)           # bus override
+    ms.close()
+    rows = MS.read_metrics(path)
+    assert [r["node"] for r in rows] == [0, 2]
+    assert all("t_us" in r and r["epoch"] == 4 for r in rows)
+    # torn tail tolerated (recovered-aggregator append model)
+    with open(path, "a") as f:
+        f.write('{"node":0,"epo')
+    assert len(MS.read_metrics(path)) == 2
+
+
+def test_telemetry_dir_and_bus_path_share_the_rule(tmp_path):
+    from deneva_tpu.runtime.telemetry import telemetry_dir
+    cfg = _cfg(tmp_path)
+    assert telemetry_dir(cfg) == MS.stream_dir(cfg) == str(tmp_path)
+    assert MB.bus_path(cfg, 3) == os.path.join(str(tmp_path),
+                                               "metrics_bus_node3.jsonl")
+
+
+# ---- conflict density --------------------------------------------------
+
+def _batch(keys, is_write, active=None):
+    import jax.numpy as jnp
+    from deneva_tpu.cc import AccessBatch
+    keys = jnp.asarray(keys, jnp.int32)
+    b = keys.shape[0]
+    return AccessBatch(
+        table_ids=jnp.zeros_like(keys), keys=keys,
+        is_read=~jnp.asarray(is_write, bool),
+        is_write=jnp.asarray(is_write, bool),
+        valid=jnp.ones_like(keys, dtype=bool),
+        ts=jnp.arange(b, dtype=jnp.int32),
+        rank=jnp.arange(b, dtype=jnp.int32),
+        active=jnp.ones(b, bool) if active is None
+        else jnp.asarray(active, bool))
+
+
+def test_conflict_density_partitions_and_paths_agree():
+    """Write-write contention lands in its owner partition; a
+    partition of solo reads stays zero; the incidence-backed and the
+    scatter-add (forwarding) paths compute the identical vector."""
+    import jax.numpy as jnp
+    from deneva_tpu.cc import build_incidence, conflict_density
+    cfg = Config(part_cnt=2, conflict_buckets=256)
+    # txns 0,1 write key 2 (partition 0) -> both contend; txns 2,3 read
+    # distinct partition-1 keys nobody writes -> no conflict
+    keys = [[2, 2], [2, 2], [3, 7], [5, 9]]
+    w = [[True, True], [True, True], [False, False], [False, False]]
+    batch = _batch(keys, w)
+    owner = batch.keys % jnp.int32(2)
+    d_scatter = np.asarray(conflict_density(cfg, batch, owner, None))
+    inc = build_incidence(batch, cfg.conflict_buckets, exact=False)
+    d_inc = np.asarray(conflict_density(cfg, batch, owner, inc))
+    np.testing.assert_array_equal(d_scatter, d_inc)
+    assert d_inc[0] >= 4 and d_inc[1] == 0
+    # inactive txns contribute nothing
+    b2 = _batch(keys, w, active=[True, False, True, True])
+    d2 = np.asarray(conflict_density(cfg, b2, owner, None))
+    assert d2[0] == 0 and d2[1] == 0
+
+
+def test_density_ranks_order_like_abort_rates():
+    """The acceptance cross-validation: sweep zipf skew on a
+    write-heavy OCC engine — the exported conflict-density series must
+    RANK the configs exactly as their measured abort counts do (the
+    signal is a usable contention proxy, not just a counter)."""
+    import jax
+    from deneva_tpu.engine.step import Engine
+    from deneva_tpu.workloads import get_workload
+
+    dens, aborts = [], []
+    for theta in (0.0, 0.6, 0.9):
+        # conflict_buckets >= table size: the density signal is a
+        # bucket-space over-approximation, and a bucket space smaller
+        # than the keyspace saturates it with hash-collision mass
+        # (uniform traffic reads as contended) — the same K-sizing rule
+        # every sweep backend documents
+        cfg = Config(cc_alg=CCAlg.OCC, epoch_batch=64,
+                     conflict_buckets=2048, synth_table_size=1024,
+                     max_txn_in_flight=128, req_per_query=4,
+                     max_accesses=4, zipf_theta=theta, write_perc=0.9,
+                     read_perc=0.1, part_cnt=4, metrics=True)
+        eng = Engine(cfg, get_workload(cfg))
+        st = eng.init_state()
+        st = eng.jit_run(st, 12)
+        dens.append(int(np.asarray(
+            jax.device_get(st.stats["conflict_density"])).sum()))
+        aborts.append(int(jax.device_get(
+            st.stats["total_txn_abort_cnt"])))
+    assert np.argsort(dens).tolist() == np.argsort(aborts).tolist(), \
+        (dens, aborts)
+    assert dens[0] < dens[2] and aborts[0] < aborts[2]
+
+
+def test_density_counter_stays_zero_when_off():
+    import jax
+    from deneva_tpu.engine.step import Engine
+    from deneva_tpu.workloads import get_workload
+    cfg = Config(cc_alg=CCAlg.OCC, epoch_batch=64, conflict_buckets=256,
+                 synth_table_size=1024, max_txn_in_flight=128,
+                 req_per_query=4, max_accesses=4, zipf_theta=0.9,
+                 part_cnt=4)
+    eng = Engine(cfg, get_workload(cfg))
+    st = eng.jit_run(eng.init_state(), 6)
+    assert np.asarray(
+        jax.device_get(st.stats["conflict_density"])).sum() == 0
+
+
+# ---- critical-path ledger ----------------------------------------------
+
+def _fake_clock(led):
+    t = [100.0]
+    led._time = lambda: t[0]
+    led.reset()
+    return t
+
+
+def test_crit_ledger_stages_sum_to_wall(capsys):
+    """The attribution contract: measured stages + the other bucket sum
+    to the window wall EXACTLY (the 5% acceptance bound is measurement
+    noise on a live run, not bookkeeping slack), and the gate is the
+    argmax stage."""
+    led = MB.CritLedger(0)
+    t = _fake_clock(led)
+    for _ in range(2):
+        t[0] += 0.010; led.lap("admit")      # noqa: E702
+        t[0] += 0.040; led.lap("wire")       # noqa: E702
+        t[0] += 0.020; led.lap("device")     # noqa: E702
+        t[0] += 0.005; led.lap("retire")     # noqa: E702
+        t[0] += 0.002
+        out = led.end_pass(8)
+    t[0] += 1.0                              # cross the emit cadence
+    out = led.end_pass(16)
+    assert out is not None and out[0] == "other"   # the 1s idle gap
+    line = capsys.readouterr().out
+    from deneva_tpu.harness.parse import parse_metrics
+    [row] = parse_metrics(line.splitlines())
+    assert row["family"] == "crit" and row["gate"] == "other"
+    stages = sum(row[s + "_ms"] for s in
+                 ("admit", "wire", "device", "retire", "other"))
+    assert abs(stages - row["wall_ms"]) <= 0.05 * row["wall_ms"]
+    assert row["wire_ms"] == pytest.approx(80.0, abs=0.5)
+    # quorum ledger competes for the gate without joining the wall sum
+    t2 = _fake_clock(led)
+    t2[0] += 0.010; led.lap("admit")         # noqa: E702
+    led.quorum(5.0)
+    t2[0] += 1.1
+    gate, _ = led.end_pass(24)
+    assert gate == "quorum"
+    row2 = parse_metrics(capsys.readouterr().out.splitlines())[0]
+    assert row2["quorum_ms"] == pytest.approx(5000.0)
+    wall_sum = sum(row2[s + "_ms"] for s in
+                   ("admit", "wire", "device", "retire", "other"))
+    assert abs(wall_sum - row2["wall_ms"]) <= 0.05 * row2["wall_ms"]
+
+
+# ---- watchdogs ---------------------------------------------------------
+
+def _frame_rec(node, epoch, now_s, lag_s=0.0, role="server", **fields):
+    rec = {"node": node, "role": role, "epoch": epoch,
+           "frame_t_us": (now_s - lag_s) * 1e6}
+    for name in MB.FRAME_FIELDS:
+        rec.setdefault(name, 0.0)
+    rec.update(fields)
+    return rec
+
+
+def test_straggler_watchdog_names_only_the_slow_node(tmp_path, capsys):
+    agg = MB.Aggregator(_cfg(tmp_path), 0)
+    now = 50.0
+    for i in range(4):
+        now += 0.1
+        agg.feed(_frame_rec(0, i, now), now_s=now)
+        agg.feed(_frame_rec(2, i, now, lag_s=0.002), now_s=now)
+        agg.feed(_frame_rec(1, i, now, lag_s=1.5), now_s=now)
+    agg.close()
+    watches = [r for r in MS.read_metrics(agg.stream.path)
+               if "kind" in r]
+    assert watches and {w["kind"] for w in watches} == {"straggler"}
+    assert {w["subject"] for w in watches} == {1}
+    # the tagged line twin went to the log
+    from deneva_tpu.harness.parse import parse_metrics
+    rows = [r for r in parse_metrics(capsys.readouterr().out.splitlines())
+            if r["family"] == "watch"]
+    assert rows and all(r["subject"] == 1 for r in rows)
+    # rate limit: many triggers, few events
+    assert len(watches) < 4
+
+
+def test_jit_recompile_watchdog(tmp_path):
+    agg = MB.Aggregator(_cfg(tmp_path), 0)
+    now = 10.0
+    for i in range(6):
+        now += 0.05
+        agg.feed(_frame_rec(0, i, now, device_ms=4.0), now_s=now)
+        agg.feed(_frame_rec(1, i, now, device_ms=4.0), now_s=now)
+    now += 0.05
+    agg.feed(_frame_rec(0, 9, now, device_ms=900.0), now_s=now)
+    agg.close()
+    watches = [r for r in MS.read_metrics(agg.stream.path)
+               if r.get("kind") == "jit_recompile"]
+    assert len(watches) == 1 and watches[0]["subject"] == 0
+    assert watches[0]["device_ms"] == 900.0
+
+
+def test_epoch_stall_watchdog(tmp_path):
+    agg = MB.Aggregator(_cfg(tmp_path), 0)
+    agg.feed(_frame_rec(0, 1, 5.0), now_s=5.0)
+    agg.tick(6.0)                      # under the threshold: quiet
+    agg.tick(5.0 + MB.WATCH_STALL_S + 1.0)
+    agg.tick(5.0 + MB.WATCH_STALL_S + 2.0)   # latched: one event only
+    agg.close()
+    stalls = [r for r in MS.read_metrics(agg.stream.path)
+              if r.get("kind") == "epoch_stall"]
+    assert len(stalls) == 1 and stalls[0]["idle_s"] >= MB.WATCH_STALL_S
+    # a fresh frame re-arms the watchdog
+    agg2 = MB.Aggregator(_cfg(tmp_path), 0, append=True)
+    agg2.feed(_frame_rec(0, 2, 20.0), now_s=20.0)
+    assert not agg2._stalled
+    agg2.close()
+
+
+# ---- loopback ServerNode: metrics-off wire pin -------------------------
+
+def test_metrics_off_wire_pin():
+    """The house contract, executable: with metrics off a server builds
+    NO bus sender and NO aggregator, writes no bus stream, and its blob
+    broadcast is byte-identical to the pre-bus codec output — the bus
+    is purely observational and its off state is the pre-bus runtime
+    byte for byte (no METRICS rtype can ever reach the wire: nothing
+    constructs a frame)."""
+    node = _solo_server("mb_off_pin")
+    try:
+        assert node.mbus is None and node.magg is None
+        blk = wire.QueryBlock(
+            keys=np.arange(8, dtype=np.int32).reshape(4, 2),
+            types=np.ones((4, 2), np.int8),
+            scalars=np.zeros((4, 0), np.int32),
+            tags=np.arange(4, dtype=np.int64))
+        ts = np.arange(4, dtype=np.int64) + 100
+        blob = wire.encode_epoch_blob(7, blk, ts)
+        sent = []
+        node.tp.sendv_many = \
+            lambda dests, rt, parts: sent.append((list(dests), rt, parts))
+        node.tp.send = lambda d, rt, pl=b"": sent.append(([d], rt, [pl]))
+        node.n_srv = 2          # pretend a peer so the bcast emits
+        node._bcast_views(7, blk, ts)
+        (dests, rt, parts), = sent
+        assert rt == "EPOCH_BLOB"
+        assert b"".join(bytes(p) for p in parts) == blob
+        assert not any(k.startswith("mb_") for k in node.stats.counters)
+    finally:
+        node.n_srv = 1
+        node.close()
+
+
+def test_metrics_off_group_outputs():
+    """The group jit's output arity is exactly the pre-bus one with
+    metrics off (3 state leaves + the packed planes) and grows the
+    density plane only when armed — the d2h volume is part of the
+    off-contract."""
+    import jax
+    import numpy as np
+    node = _solo_server("mb_off_arity")
+    try:
+        C, b = node.C, node.b_merged
+        W, S = node._width, node._n_scalars
+        warm = jax.device_put((
+            np.zeros(C * b, bool), np.zeros(C * b, np.int32),
+            np.zeros(C * b * W, np.int32), np.zeros(C * b * W, np.int8),
+            np.zeros(C * b * S, np.int32)))
+        out = node.group_step(node.db, node.cc_state, node.dev_stats,
+                              *warm)
+        assert len(out) == 4
+    finally:
+        node.close()
+
+
+# ---- loopback ServerNode: armed aggregation ----------------------------
+
+def _mb_server(tag, tmp_path, **kw):
+    base = dict(metrics=True, telemetry_dir=str(tmp_path),
+                synth_table_size=1024)
+    base.update(kw)
+    return _solo_server(tag, **base)
+
+
+def test_armed_server_aggregates_and_emits(tmp_path):
+    node = _mb_server("mb_armed", tmp_path)
+    try:
+        assert node.mbus is not None and node.magg is not None
+        # a peer's frame routed in lands in the bus stream verbatim
+        fields = MB.pack_fields(dict(commit=7, abort=1))
+        node._route(1, "METRICS", MB.encode_metrics_frame(
+            1, MB.ROLE_SERVER, 12, MS.now_us(), fields,
+            np.array([3, 4], np.int32)))
+        # a local frame feeds the aggregator without touching the wire
+        node._mb_emit(16, np.array([9], np.int32), 5, 1, 0, 0)
+        assert node.mbus.frames_sent == 1
+        node.magg.close()
+        rows = MS.read_metrics(MB.bus_path(node.cfg, 0))
+        assert [r["node"] for r in rows] == [1, 0]
+        assert rows[0]["commit"] == 7 and rows[0]["density"] == [3, 4]
+        assert rows[1]["epoch"] == 16 and rows[1]["density"] == [9]
+        assert node.magg.frames_rx == 2
+        # armed group jit returns the density plane
+        import jax
+        C, b = node.C, node.b_merged
+        W, S = node._width, node._n_scalars
+        warm = jax.device_put((
+            np.zeros(C * b, bool), np.zeros(C * b, np.int32),
+            np.zeros(C * b * W, np.int32), np.zeros(C * b * W, np.int8),
+            np.zeros(C * b * S, np.int32)))
+        out = node.group_step(node.db, node.cc_state, node.dev_stats,
+                              *warm)
+        assert len(out) == 5
+        assert np.asarray(out[4]).shape == (C, 1)   # part_cnt=1 solo
+    finally:
+        node.close()
+
+
+def test_aggregator_role_follows_lowest_live(tmp_path):
+    """Elastic retirement hands the role down: a non-zero server
+    becomes the target once every lower id is reassigned, and builds
+    its aggregator lazily at the first routed frame."""
+    node = _mb_server("mb_role", tmp_path)
+    try:
+        assert node._mb_agg() == 0
+        node._elastic = True
+        node._reassigned = {0}
+        node.n_srv = 3
+        node.me = 1
+        assert node._mb_agg() == 1
+        node.magg = None
+        fields = MB.pack_fields(dict(commit=1))
+        node._route(2, "METRICS", MB.encode_metrics_frame(
+            2, MB.ROLE_SERVER, 3, MS.now_us(), fields,
+            np.zeros(0, np.int32)))
+        assert node.magg is not None and node.magg.frames_rx == 1
+    finally:
+        node.me = 0
+        node.n_srv = 1
+        node.close()
+
+
+# ---- monitor tool ------------------------------------------------------
+
+def test_monitor_render_and_prom(tmp_path):
+    import importlib
+    monitor = importlib.import_module("tools.monitor")
+    path = os.path.join(str(tmp_path), "metrics_bus_node0.jsonl")
+    ms = MS.MetricsStream(path, 0)
+    for e in range(4):
+        ms.emit(e, node=0, role="server", frame_t_us=e * 1_000_000,
+                commit=100, abort=5, wall_ms=12.0, wire_ms=8.0,
+                admit_ms=2.0, device_ms=1.0, retire_ms=0.5,
+                other_ms=0.5, density=[4, 1])
+        ms.emit(-1, node=3, role="client", frame_t_us=e * 1_000_000,
+                commit=90, resend=2, backlog=10)
+    ms.emit(7, node=0, kind="straggler", subject=1, lag_ms=1500.0)
+    ms.close()
+    rows = MS.read_metrics(path)
+    table = monitor.render_table(rows)
+    assert "straggler" in table and "wire" in table
+    assert "client" in table and "4,1" in table
+    prom = monitor.prom_dump(rows)
+    assert 'deneva_conflict_density{node="0",part="0"} 4' in prom
+    assert 'deneva_watch_events_total{kind="straggler"} 1' in prom
+    assert "# TYPE deneva_commit_per_frame gauge" in prom
+    # directory resolution finds the stream
+    assert monitor.resolve_stream(str(tmp_path)) == path
+
+
+# ---- config gating -----------------------------------------------------
+
+def test_metrics_knobs_validate():
+    with pytest.raises(ValueError, match="metrics_cadence"):
+        Config().replace(metrics_cadence=0)
+    cfg = Config().replace(metrics=True)       # defaults are live
+    assert cfg.metrics_cadence == 1
+
+
+def test_bus_sender_cadence_and_shed():
+    snd = MB.BusSender(Config(metrics=True, metrics_cadence=4), 0,
+                       MB.ROLE_SERVER)
+    assert [e for e in range(8) if snd.due(e)] == [0, 4]
+    snd.shed = 3
+    _, rec = snd.frame(0, dict(commit=1))
+    assert rec["shed"] == 3.0 and snd.shed == 0
+    # quorum ledger: hold -> release feeds the crit ledger
+    snd.hold(5, 100.0)
+    snd.hold(6, 100.5)
+    snd.release_through(5, 101.0)
+    assert snd.crit.quorum_n == 1
+    assert snd.crit.quorum_s == pytest.approx(1.0)
+    assert 6 in snd._hold_t
+
+
+# ---- end-to-end cluster (slow tier) ------------------------------------
+
+@pytest.mark.slow
+def test_cluster_bus_stream_and_crit_sums(tmp_path):
+    """2 servers + 1 client with the bus armed: the aggregator's stream
+    carries frames from every node kind with per-partition density, the
+    critical-path decomposition in the frames sums to its wall within
+    5%, and the off twin of the same config writes no bus stream."""
+    from deneva_tpu.runtime.launch import run_cluster
+    from deneva_tpu.stats import parse_summary
+
+    cfg = Config(workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+                 node_cnt=2, client_node_cnt=1, epoch_batch=128,
+                 conflict_buckets=512, synth_table_size=4096,
+                 max_txn_in_flight=1024, req_per_query=4, max_accesses=4,
+                 zipf_theta=0.9, warmup_secs=0.3, done_secs=2.0,
+                 log_dir=str(tmp_path), metrics=True)
+    out = run_cluster(cfg, platform="cpu", run_id="mbsm")
+    srv = [parse_summary(out[s][1]) for s in range(2)]
+    for s in srv:
+        assert s["mb_frames_sent"] > 0
+    assert srv[0]["mb_frames_rx"] > 0
+    rows = MS.read_metrics(os.path.join(str(tmp_path), "mbsm",
+                                        "metrics_bus_node0.jsonl"))
+    frames = [r for r in rows if "kind" not in r and "commit" in r]
+    assert {0, 1} <= {r["node"] for r in frames}
+    assert any(r["role"] == "client" for r in frames)
+    dens = [r for r in frames if r.get("density")]
+    assert dens and all(len(r["density"]) == 2 for r in dens)
+    crit = [r for r in frames
+            if r.get("role") == "server" and r.get("wall_ms", 0) > 0]
+    assert crit, "no frame carried a critical-path window"
+    for r in crit:
+        stages = sum(r[s + "_ms"] for s in
+                     ("admit", "wire", "device", "retire", "other"))
+        assert abs(stages - r["wall_ms"]) <= 0.05 * r["wall_ms"] + 0.1, r
+    # off twin: no stream, no bus fields
+    off = run_cluster(cfg.replace(metrics=False), platform="cpu",
+                      run_id="mbsm_off")
+    assert not os.path.exists(os.path.join(str(tmp_path), "mbsm_off",
+                                           "metrics_bus_node0.jsonl"))
+    assert "mb_frames_sent" not in parse_summary(off[0][1])

@@ -16,6 +16,7 @@ import pytest
 from deneva_tpu.runtime import admission as A
 from deneva_tpu.runtime import faildet as FD
 from deneva_tpu.runtime import membership as M
+from deneva_tpu.runtime import metricsbus as MB
 from deneva_tpu.runtime import replication as R
 from deneva_tpu.runtime import logger, native, wire
 from tools.graftlint.wiremodel import WIRE_MODEL
@@ -41,7 +42,7 @@ def test_declared_codecs_exist():
     for spec in WIRE_MODEL.values():
         for fn in (*spec.codec_encode, *spec.codec_decode):
             assert any(hasattr(m, fn)
-                       for m in (wire, M, logger, R, A, FD)), \
+                       for m in (wire, M, logger, R, A, FD, MB)), \
                 f"{spec.name}: declared codec {fn} not found"
 
 
@@ -239,6 +240,24 @@ def _rt_heal():
     assert b"".join(bytes(p) for p in parts) == buf
 
 
+def _rt_metrics():
+    r = np.random.default_rng(31)
+    fields = r.random(len(MB.FRAME_FIELDS)).astype(np.float32) * 100
+    for dens in (r.integers(0, 9999, 4).astype(np.int32),
+                 np.zeros(0, np.int32)):       # clients ship no density
+        buf = MB.encode_metrics_frame(2, MB.ROLE_SERVER, 640, 123456789,
+                                      fields, dens)
+        node, role, epoch, t_us, f2, d2 = MB.decode_metrics_frame(buf)
+        assert (node, role, epoch, t_us) == (2, MB.ROLE_SERVER, 640,
+                                             123456789)
+        np.testing.assert_array_equal(fields, f2)
+        np.testing.assert_array_equal(dens, d2)
+        # zero-copy parts path must be byte-identical to the codec
+        parts = MB.metrics_frame_parts(2, MB.ROLE_SERVER, 640, 123456789,
+                                       fields, dens)
+        assert b"".join(bytes(p) for p in parts) == buf
+
+
 def _rt_payload_free():
     return None     # no payload on the wire: nothing to round-trip
 
@@ -268,6 +287,7 @@ ROUNDTRIP = {
     "HEARTBEAT": _rt_heartbeat,
     "FENCE_NACK": _rt_fence_nack,
     "HEAL": _rt_heal,
+    "METRICS": _rt_metrics,
 }
 
 

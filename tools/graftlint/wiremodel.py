@@ -37,6 +37,7 @@ CODEC_MODULES = (
     "deneva_tpu/runtime/replication.py",
     "deneva_tpu/runtime/admission.py",
     "deneva_tpu/runtime/faildet.py",
+    "deneva_tpu/runtime/metricsbus.py",
 )
 
 # handler qualname -> (module, function name) to scan for route branches
@@ -201,4 +202,12 @@ WIRE_MODEL: dict[str, RtypeSpec] = {s.name: s for s in (
        note="partition-heal map catch-up on a suspected->fresh "
             "transition (rides beside the REJOIN blob resend): control "
             "plane; a lost HEAL re-arms on the next heal transition"),
+    _s("METRICS", False, gate="metrics",
+       enc=("encode_metrics_frame", "metrics_frame_parts"),
+       dec=("decode_metrics_frame",),
+       routes=("ServerNode._route",),
+       note="per-epoch metrics frame (node -> aggregator): telemetry, "
+            "lossy BY DESIGN — a dropped frame is a chart gap the next "
+            "cadence tick supersedes, never a correctness event; "
+            "outside the mask like every gated control-plane rtype"),
 )}

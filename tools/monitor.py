@@ -1,0 +1,220 @@
+"""Live cluster monitor: tail a metrics-bus stream as a per-node TUI.
+
+The metrics bus (runtime/metricsbus.py, ``metrics=true``) aggregates
+every node's per-epoch frames into ``metrics_bus_node*.jsonl`` on the
+lowest-id live server.  This tool renders that stream:
+
+  python tools/monitor.py <stream.jsonl | run-dir>            live TUI
+  python tools/monitor.py <stream.jsonl | run-dir> --once     one render
+  python tools/monitor.py <stream.jsonl | run-dir> --prom     one-shot
+                                       Prometheus text exposition dump
+
+TUI columns (per node, from each node's most recent frames):
+epoch, commit/s over the tail window, abort fraction, retry/admission
+queue depths, the critical-path gate stage (argmax of the last [crit]
+window), and the per-partition conflict density of the latest frame.
+``[watch]`` events (epoch-stall / straggler / jit-recompile) render as
+a scrolling event pane under the table.
+
+Everything reads through the SHARED schema module
+(runtime/metricschema.read_metrics), so a recovered aggregator's
+appended stream (torn line mid-file) renders fine.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deneva_tpu.runtime.metricschema import read_metrics  # noqa: E402
+
+# frames participating in the rate window (per node)
+TAIL = 32
+
+# gauge fields exported to Prometheus (frame field -> metric suffix)
+PROM_GAUGES = (
+    ("commit", "commit_per_frame"),
+    ("abort", "abort_per_frame"),
+    ("defer", "defer_per_frame"),
+    ("salvage", "salvage_per_frame"),
+    ("shed", "shed_per_frame"),
+    ("pending", "pending_depth"),
+    ("retry_depth", "retry_depth"),
+    ("held_rsp", "held_rsp_depth"),
+    ("adm_depth", "admission_depth"),
+    ("quorum_ms", "quorum_hold_ms"),
+    ("resend", "resend_per_frame"),
+    ("backoff", "backoff_per_frame"),
+    ("backlog", "backlog_depth"),
+    ("wall_ms", "critpath_wall_ms"),
+)
+PROM_STAGES = ("admit", "wire", "device", "retire", "other")
+
+
+def split_rows(rows: list[dict]) -> tuple[dict[int, list[dict]],
+                                          list[dict]]:
+    """{node: [frames...]} (file order) + the [watch] event records."""
+    frames: dict[int, list[dict]] = {}
+    watches: list[dict] = []
+    for r in rows:
+        if "kind" in r:
+            watches.append(r)
+        elif "commit" in r:
+            frames.setdefault(int(r.get("node", -1)), []).append(r)
+    return frames, watches
+
+
+def node_summary(frames: list[dict]) -> dict:
+    """Rolled-up view of one node's frame tail."""
+    tail = frames[-TAIL:]
+    last = tail[-1]
+    span_us = max(tail[-1].get("frame_t_us", 0)
+                  - tail[0].get("frame_t_us", 0), 1)
+    commits = sum(f.get("commit", 0.0) for f in tail)
+    aborts = sum(f.get("abort", 0.0) for f in tail)
+    stage_ms = {s: last.get(f"{s}_ms", 0.0) for s in PROM_STAGES}
+    q = last.get("quorum_ms", 0.0)
+    gate = max(stage_ms, key=stage_ms.get)
+    if q > stage_ms[gate]:
+        gate = "quorum"
+    dens = last.get("density", [])
+    return {
+        "role": last.get("role", "?"),
+        "epoch": int(last.get("epoch", -1)),
+        "commit_s": commits / (span_us / 1e6) if len(tail) > 1 else 0.0,
+        "abort_frac": aborts / max(commits + aborts, 1.0),
+        "retry": int(last.get("retry_depth", 0)),
+        "adm": int(last.get("adm_depth", 0)),
+        "resend_s": sum(f.get("resend", 0.0) + f.get("backoff", 0.0)
+                        for f in tail) / (span_us / 1e6)
+        if len(tail) > 1 else 0.0,
+        "gate": gate,
+        "wall_ms": last.get("wall_ms", 0.0),
+        "density": dens,
+    }
+
+
+def render_table(rows: list[dict], max_watch: int = 6) -> str:
+    frames, watches = split_rows(rows)
+    out = [f"{'node':>4} {'role':<7} {'epoch':>7} {'commit/s':>9} "
+           f"{'abort%':>7} {'retry':>6} {'adm':>5} {'resend/s':>9} "
+           f"{'gate':>7} {'wall_ms':>8}  density"]
+    for node in sorted(frames):
+        s = node_summary(frames[node])
+        dens = ",".join(str(d) for d in s["density"][:8]) or "-"
+        out.append(
+            f"{node:>4} {s['role']:<7} {s['epoch']:>7} "
+            f"{s['commit_s']:>9.0f} {s['abort_frac'] * 100:>6.1f}% "
+            f"{s['retry']:>6} {s['adm']:>5} {s['resend_s']:>9.0f} "
+            f"{s['gate']:>7} {s['wall_ms']:>8.1f}  {dens}")
+    if not frames:
+        out.append("  (no frames yet)")
+    if watches:
+        out.append("")
+        out.append("watch events:")
+        for w in watches[-max_watch:]:
+            extra = " ".join(f"{k}={v}" for k, v in w.items()
+                             if k not in ("kind", "subject", "node",
+                                          "epoch", "t_us"))
+            out.append(f"  [{w.get('kind')}] subject={w.get('subject')} "
+                       f"epoch={w.get('epoch')} {extra}")
+    return "\n".join(out)
+
+
+def prom_dump(rows: list[dict]) -> str:
+    """One-shot Prometheus text exposition of the latest cluster state
+    (gauges from each node's newest frame + watch counters)."""
+    frames, watches = split_rows(rows)
+    out: list[str] = []
+
+    def gauge(name: str, help_text: str, samples: list[tuple[str, float]]):
+        out.append(f"# HELP deneva_{name} {help_text}")
+        out.append(f"# TYPE deneva_{name} gauge")
+        for labels, v in samples:
+            out.append(f"deneva_{name}{{{labels}}} {v:g}")
+
+    latest = {n: fr[-1] for n, fr in frames.items()}
+    for field, suffix in PROM_GAUGES:
+        gauge(suffix, f"metrics-bus frame field {field!r}",
+              [(f'node="{n}",role="{f.get("role", "?")}"',
+                float(f.get(field, 0.0)))
+               for n, f in sorted(latest.items())])
+    for s in PROM_STAGES:
+        gauge(f"critpath_{s}_ms",
+              f"critical-path {s} stage of the last window",
+              [(f'node="{n}"', float(f.get(f"{s}_ms", 0.0)))
+               for n, f in sorted(latest.items())
+               if f.get("role") == "server"])
+    dens_samples = []
+    for n, f in sorted(latest.items()):
+        for i, d in enumerate(f.get("density", [])):
+            dens_samples.append((f'node="{n}",part="{i}"', float(d)))
+    if dens_samples:
+        gauge("conflict_density",
+              "per-partition observed-conflict density (latest frame)",
+              dens_samples)
+    counts: dict[str, int] = {}
+    for w in watches:
+        counts[str(w.get("kind"))] = counts.get(str(w.get("kind")), 0) + 1
+    gauge("watch_events_total", "anomaly watchdog events by kind",
+          [(f'kind="{k}"', float(v)) for k, v in sorted(counts.items())])
+    return "\n".join(out) + "\n"
+
+
+def resolve_stream(path: str) -> str:
+    """Accept a stream file or a run directory (newest bus stream)."""
+    if os.path.isdir(path):
+        cands = sorted(f for f in os.listdir(path)
+                       if f.startswith("metrics_bus_")
+                       and f.endswith(".jsonl"))
+        if not cands:
+            raise FileNotFoundError(
+                f"no metrics_bus_*.jsonl under {path} (run with "
+                "--metrics=true)")
+        return os.path.join(
+            path, max(cands, key=lambda f: os.path.getmtime(
+                os.path.join(path, f))))
+    return path
+
+
+def main(argv: list[str]) -> int:
+    interval = 1.0
+    args: list[str] = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--interval":
+            interval = float(argv[i + 1])
+            i += 2
+        else:
+            args.append(argv[i])
+            i += 1
+    pos = [a for a in args if not a.startswith("--")]
+    if not pos:
+        print("usage: python tools/monitor.py <metrics_bus.jsonl|run-dir>"
+              " [--once|--prom] [--interval S]", file=sys.stderr)
+        return 2
+    path = resolve_stream(pos[0])
+    if "--prom" in argv:
+        sys.stdout.write(prom_dump(read_metrics(path)))
+        return 0
+    if "--once" in argv:
+        print(render_table(read_metrics(path)))
+        return 0
+    try:
+        while True:
+            rows = read_metrics(path)
+            sys.stdout.write("\x1b[2J\x1b[H")       # clear + home
+            print(f"metrics bus  {path}  "
+                  f"({len(rows)} records, ^C to quit)\n")
+            print(render_table(rows))
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

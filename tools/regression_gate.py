@@ -49,13 +49,16 @@ SWEEPS = ("isolation_levels", "operating_points", "escrow_ablation",
 RUNTIME_FACTOR = 2.0
 RUNTIME_SLACK_SECS = 2.0
 
-# telemetry-overhead gate (results/telemetry): each <preset>_on.out /
-# <preset>_off.out pair — same preset, flight recorder armed at the
-# default telemetry_sample vs off — must show the armed run's tput
-# within this fraction of the off run's, AND the armed run must have
-# actually sampled (anti-inert: a gate that passes with the recorder
-# dead proves nothing).  tools/telemetry_bench.py writes the pairs.
+# instrument-overhead gates: each <preset>_on.out / <preset>_off.out
+# pair — same preset, the instrument armed at its default depth knob vs
+# off — must show the armed run's tput within the tolerance of the off
+# run's, AND the armed run must prove the instrument was LIVE via its
+# anti-inert field (a gate that passes with the instrument dead proves
+# nothing).  tools/telemetry_bench.py writes the telemetry pairs
+# (flight recorder at telemetry_sample=1024); tools/metricsbus_bench.py
+# the metricsbus pairs (live bus at metrics_cadence=1).
 TELEMETRY_DIR = "results/telemetry"
+METRICSBUS_DIR = "results/metricsbus"
 TELEMETRY_TOLERANCE = 0.02
 
 
@@ -88,16 +91,17 @@ def runtime_violations() -> list[tuple[str, float, float]]:
     return out
 
 
-def telemetry_violations() -> list[str]:
-    """Anti-inert + anti-regression over the committed telemetry pairs:
-    for every ``<preset>_on.out`` in results/telemetry, its ``_off``
-    twin must exist, the armed run must have sampled events
-    (tel_sampled_cnt > 0, zero drops), and armed tput must stay within
-    ``TELEMETRY_TOLERANCE`` of off."""
+def _pair_violations(pair_dir: str, label: str, inert_field: str,
+                     zero_field: str | None) -> list[str]:
+    """One instrument's anti-inert + anti-regression pass: for every
+    ``<preset>_on.out``, its ``_off`` twin must exist, the armed run
+    must prove liveness (``inert_field`` > 0, ``zero_field`` == 0 when
+    declared), and armed tput must stay within TELEMETRY_TOLERANCE of
+    off."""
     out: list[str] = []
-    if not os.path.isdir(TELEMETRY_DIR):
+    if not os.path.isdir(pair_dir):
         return out
-    rows = {r["file"]: r for r in load_results(TELEMETRY_DIR)}
+    rows = {r["file"]: r for r in load_results(pair_dir)}
     for name, row in sorted(rows.items()):
         if not name.endswith("_on.out"):
             continue
@@ -109,22 +113,38 @@ def telemetry_violations() -> list[str]:
             out.append(f"{name}: its _off.out twin has no tput "
                        "(malformed [summary]?)")
             continue
-        if row.get("tel_sampled_cnt", 0.0) <= 0:
-            out.append(f"{name}: tel_sampled_cnt == 0 — the flight "
-                       "recorder was INERT in the armed run")
-        if row.get("tel_dropped_cnt", 0.0) > 0:
-            out.append(f"{name}: recorder dropped "
-                       f"{row['tel_dropped_cnt']:.0f} events")
+        if row.get(inert_field, 0.0) <= 0:
+            out.append(f"{name}: {inert_field} == 0 — the {label} "
+                       "instrument was INERT in the armed run")
+        if zero_field is not None and row.get(zero_field, 0.0) > 0:
+            out.append(f"{name}: {zero_field} = "
+                       f"{row[zero_field]:.0f} (must be 0)")
         if "tput" not in row:
             out.append(f"{name}: no tput in the armed run")
             continue
         floor = (1.0 - TELEMETRY_TOLERANCE) * float(off["tput"])
         if float(row["tput"]) < floor:
             out.append(
-                f"{name}: telemetry overhead exceeds "
+                f"{name}: {label} overhead exceeds "
                 f"{TELEMETRY_TOLERANCE:.0%}: armed tput "
                 f"{row['tput']:.0f} < {floor:.0f} "
                 f"(off {off['tput']:.0f})")
+    return out
+
+
+def telemetry_violations() -> list[str]:
+    """Anti-inert + anti-regression over every committed instrument
+    pair family (flight recorder + metrics bus).  The dirs resolve at
+    call time so tests can repoint them."""
+    pairs = (
+        # (dir, label, anti-inert field, zero-required field or None)
+        (TELEMETRY_DIR, "telemetry", "tel_sampled_cnt",
+         "tel_dropped_cnt"),
+        (METRICSBUS_DIR, "metricsbus", "mb_frames_sent", None),
+    )
+    out: list[str] = []
+    for pair_dir, label, inert_field, zero_field in pairs:
+        out += _pair_violations(pair_dir, label, inert_field, zero_field)
     return out
 
 

@@ -37,6 +37,16 @@
 #                                         ->quorum->ack span chain, the
 #                                         merger renders one flow-linked
 #                                         Chrome trace)
+#   tools/smoke.sh monitor                metrics-bus gate:
+#                                         metrics-off wire pin test
+#                                         (bit-identity contract) + the
+#                                         monitor-grayslow chaos
+#                                         scenario (metrics=true with a
+#                                         gray-slow peer + an aggregator
+#                                         fault_kill: straggler watchdog
+#                                         names the stalled node, the
+#                                         recovered aggregator resumes
+#                                         the metrics_bus stream)
 #   tools/smoke.sh repair                 transaction-repair gate:
 #                                         repair-contention (zipf-0.9
 #                                         write-heavy OCC with repair on +
@@ -110,6 +120,17 @@ case "$SCEN" in
     T="${SMOKE_TIMEOUT_SECS:-${REPAIR_TIMEOUT_SECS:-600}}"
     run "$T" python -m deneva_tpu.harness.chaos repair-contention --quick
     ;;
+  monitor)
+    # off-pin first (fast, loopback); then the gray-slow + aggregator-
+    # kill scenario — the kill-one-server recovery machinery plus the
+    # stall, so it gets the partition-family budget
+    T="${SMOKE_TIMEOUT_SECS:-${MONITOR_TIMEOUT_SECS:-900}}"
+    run "$T" python -m pytest \
+        "tests/test_metricsbus.py::test_metrics_off_wire_pin" \
+        "tests/test_metricsbus.py::test_metrics_off_group_outputs" \
+        -q -p no:cacheprovider
+    run "$T" python -m deneva_tpu.harness.chaos monitor-grayslow --quick
+    ;;
   trace)
     # the off-pin half is fast (loopback ServerNode + ClientNode, no
     # cluster); the chaos half reuses the kill-one-server recovery
@@ -143,7 +164,7 @@ case "$SCEN" in
     fi
     ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|trace|lint> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|monitor|trace|lint> [args...]" >&2
     exit 2
     ;;
 esac
