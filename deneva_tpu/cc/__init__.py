@@ -16,7 +16,9 @@ from dataclasses import dataclass
 from typing import Any, Callable
 
 from deneva_tpu.config import CCAlg, Config
-from deneva_tpu.cc.base import (AccessBatch, Incidence, Verdict,  # noqa: F401
+from deneva_tpu.cc.base import (AUDIT_KEY, AccessBatch,  # noqa: F401
+                                Incidence, Verdict, audit_init,
+                                audit_mutate_verdict, audit_observe,
                                 build_conflict_incidence, build_incidence,
                                 committed_write_frontier, conflict_density,
                                 gate_order_free)

@@ -284,6 +284,263 @@ def conflict_density(cfg, batch: AccessBatch, owner,
     return onehot.sum(axis=(0, 1), dtype=jnp.int32)
 
 
+# ---- isolation audit plane: on-device dependency observations ----------
+# (Config.audit; the export half lives in runtime/audit.py, the graph/
+# certifier half in harness/auditgraph.py.)
+
+AUDIT_KEY = "__audit__"     # db dict key of the audit stamp tables
+#                             (control plane like __membership__:
+#                             excluded from logger.state_digest)
+
+# exported edge kinds (packed as kind<<28 | src<<14 | dst over
+# merged-batch ranks; decode in runtime/audit.py)
+AUDIT_WW, AUDIT_WR, AUDIT_RW = 0, 1, 2
+
+
+def audit_init(cfg):
+    """Fresh audit state: per-bucket version-stamp tables (the audit
+    twin of the `storage.table.VersionRing` — last committed writer's
+    epoch + merged rank per hashed bucket; -1 = never written).  Lives
+    in ``db[AUDIT_KEY]`` so every db-construction path (engine init,
+    server boot, log replay, follower boot) threads it identically and
+    checkpointing carries it (engine/checkpoint schema v8)."""
+    import jax.numpy as jnp
+
+    k = cfg.audit_buckets
+    return {"epoch": jnp.full((k,), -1, jnp.int32),
+            "writer": jnp.full((k,), -1, jnp.int32)}
+
+
+def audit_observe(cfg, batch: AccessBatch, committed, order, lvl,
+                  order_vis: bool, stamps, epoch):
+    """Per-epoch committed-txn dependency observations, derived ON
+    DEVICE from the planned access sets under the backend's visibility
+    rule — the isolation audit plane's measurement half.  Epochs off
+    the ``audit_cadence`` grid skip the whole derivation via
+    ``lax.cond`` (every node skips the same epochs, so the sidecar
+    streams stay consensus-comparable; the overhead gate pins the
+    default cadence, chaos scenarios pin cadence=1 for full-coverage
+    certification).
+
+    Model: the executors are mechanical (applies by serialization
+    order/level, reads at their visibility point), so the data flow a
+    committed set ACTUALLY produced is determined by (committed, order,
+    lvl) plus the access sets — and any committed conflicting pair the
+    backend's edge derivation wrongly admitted shows up here as
+    dependency edges the claimed serial order cannot explain (the
+    harness's cycle check).  Visibility per backend class:
+
+    * ``order_vis=True`` (forwarding executor): a read observes the
+      latest committed writer of its key with strictly LOWER
+      serialization order (`ops.forward` serial-in-rank semantics).
+    * ``order_vis=False``: a read observes the latest committed writer
+      with strictly lower ``lvl`` (chained levels / repair salvage
+      rounds); with every txn at lvl 0 this is the level-0 sweep rule —
+      reads observe the epoch-start snapshot only.
+
+    Edges emitted over EXACT combined keys (`ops.combine_key` — no
+    bucket-collision false edges): wr (observed writer -> reader), rw
+    (reader -> first writer past its observed version), ww (version
+    chain).  Escrow (``order_free``) lanes are excluded: commutative
+    deltas carry no ordering claim (same exemption as
+    `committed_write_frontier`).  Self-edges are dropped (a txn's own
+    RMW dataflow is program order, and its ww edge covers the chain).
+
+    Honest level-0 sweep epochs emit ZERO edges (their committed sets
+    are conflict-free by the Verdict invariant), so the export is
+    empty exactly when the backend kept its claim.
+
+    Returns ``(aud', edges, ebkt, cnt, dropped, vdig, rdig)``:
+    updated stamp state, int32[audit_edges_max] packed edges (-1 pad)
+    with their audit-bucket forensics column, the total edge-lane count
+    (pre-cap, pre-dedup), the overflow count, and two uint32 digests —
+    the post-epoch stamp tables (``vdig``) and this epoch's epoch-start
+    read observations (``rdig``) — which every node of a merged cluster
+    must reproduce bit-identically (harness/auditgraph.py's split-brain
+    cross-check)."""
+    import jax.numpy as jnp
+
+    cadence = max(1, cfg.audit_cadence)
+    if cadence == 1:
+        return _audit_observe_impl(cfg, batch, committed, order, lvl,
+                                   order_vis, stamps, epoch)
+    e_max = cfg.audit_edges_max
+
+    def live(_):
+        return _audit_observe_impl(cfg, batch, committed, order, lvl,
+                                   order_vis, stamps, epoch)
+
+    def skip(_):
+        z = jnp.zeros((), jnp.int32)
+        return (stamps, jnp.full((e_max,), -1, jnp.int32),
+                jnp.full((e_max,), -1, jnp.int32), z, z,
+                jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.uint32))
+
+    due = jnp.asarray(epoch, jnp.int32) % cadence == 0
+    return jax.lax.cond(due, live, skip, None)
+
+
+def _audit_observe_impl(cfg, batch: AccessBatch, committed, order, lvl,
+                        order_vis: bool, stamps, epoch):
+    import jax.numpy as jnp
+
+    from deneva_tpu.ops.forward import _seg_scan, _shift1
+
+    b, a = batch.shape
+    cm = batch.valid & committed[:, None]
+    if batch.order_free is not None:
+        cm = cm & ~batch.order_free
+    rm = cm & batch.is_read
+    wm = cm & batch.is_write
+    ident = combine_key(batch.table_ids, batch.keys)
+    big = jnp.uint32(0xFFFFFFFF)
+
+    # dense serialization positions: opos ranks `order` over committed
+    # txns (stable iota tiebreak), banded by lvl so writer positions
+    # order lexicographically by (lvl, order) and reader visibility
+    # points sit below their band (order_vis) or at its floor (level
+    # visibility).  Doubling keeps read and write positions disjoint.
+    okey = jnp.where(committed, order, jnp.int32(2**31 - 1))
+    perm = jnp.argsort(okey, stable=True)
+    opos = jnp.zeros((b,), jnp.int32).at[perm].set(
+        jnp.arange(b, dtype=jnp.int32))
+    band = lvl * jnp.int32(b + 2)
+    wpos = (band + 1 + opos) * 2 + 1
+    rpos = (band + 1 + opos) * 2 if order_vis else band * 2
+
+    # flat double-lane view: each access contributes a read lane and/or
+    # a write lane (an RMW access is both), sorted by (key, position).
+    # Lean operand count: write-ness is the position's PARITY (wpos odd,
+    # rpos even) and the audit bucket rehashes from the sorted ident,
+    # so only the txn id rides as payload — CPU XLA's comparator sort
+    # charges per operand (measured ~35% of the armed cost back)
+    n = b * a
+    tid = jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[:, None],
+                           (b, a))
+    keys2 = jnp.concatenate([jnp.where(rm, ident, big).reshape(-1),
+                             jnp.where(wm, ident, big).reshape(-1)])
+    pos2 = jnp.concatenate([
+        jnp.broadcast_to(rpos[:, None], (b, a)).reshape(-1),
+        jnp.broadcast_to(wpos[:, None], (b, a)).reshape(-1)])
+    tid2 = jnp.concatenate([tid.reshape(-1), tid.reshape(-1)])
+    sk, sp, sid = jax.lax.sort((keys2, pos2, tid2), num_keys=2,
+                               is_stable=False)
+    sw = (sp & 1) == 1
+    sbk = bucket_hash(sk, cfg.audit_buckets, family=0)
+    live = sk != big
+    head = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    tail = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones((1,), bool)])
+    cand = jnp.where(sw & live, sid, jnp.int32(-1))
+    keep_last = lambda va, v: jnp.where(v >= 0, v, va)  # noqa: E731
+    # nearest preceding / following writer within the key segment (sort
+    # order IS position order; write positions are unique per txn and
+    # never tie a read position, so "preceding" is "strictly lower pos")
+    prev = _shift1(_seg_scan(head, cand, keep_last), jnp.int32(-1))
+    prev = jnp.where(head, jnp.int32(-1), prev)
+    nrev = _shift1(_seg_scan(tail[::-1], cand[::-1], keep_last),
+                   jnp.int32(-1))
+    nxt = jnp.where(tail[::-1], jnp.int32(-1), nrev)[::-1]
+
+    def pack(kind, src, dst):
+        return (jnp.int32(kind) << 28) | (src << 14) | dst
+
+    # per sorted lane: a read's preceding writer is its wr source, its
+    # following writer the rw target (next version past the observed);
+    # a write's preceding writer is its ww predecessor
+    f_prev = live & (prev >= 0) & (prev != sid)
+    e_prev = jnp.where(f_prev,
+                       pack(jnp.where(sw, AUDIT_WW, AUDIT_WR), prev, sid),
+                       jnp.int32(-1))
+    f_next = live & ~sw & (nxt >= 0) & (nxt != sid)
+    e_next = jnp.where(f_next, pack(AUDIT_RW, sid, nxt), jnp.int32(-1))
+    flags = jnp.concatenate([f_prev, f_next])
+    allp = jnp.concatenate([e_prev, e_next])
+    allb = jnp.concatenate([sbk, sbk])
+    cnt = flags.sum(dtype=jnp.int32)
+    # compact to the static export cap by prefix-sum scatter (stable:
+    # flagged lanes keep their sorted-lane positions, themselves
+    # deterministic — every node emits the identical list; a sort here
+    # measured ~60% of the armed cost on CPU XLA).  Overflow past the
+    # cap lands in the trash slot and is COUNTED, never silent.
+    e_max = cfg.audit_edges_max
+    slot = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    tgt = jnp.where(flags, jnp.minimum(slot, e_max), e_max)
+    edges = jnp.full((e_max + 1,), -1, jnp.int32).at[tgt].set(
+        allp, mode="drop")[:e_max]
+    ebkt = jnp.full((e_max + 1,), -1, jnp.int32).at[tgt].set(
+        allb, mode="drop")[:e_max]
+    dropped = jnp.maximum(cnt - jnp.int32(e_max), 0)
+
+    # epoch-start read observations (reads with no in-epoch visible
+    # writer) gather the PRE-update stamps: their digest is the
+    # cross-epoch fingerprint every node must reproduce
+    m1, m2, m3, m4 = (jnp.uint32(0x9E3779B9), jnp.uint32(0x85EBCA6B),
+                      jnp.uint32(0xC2B2AE35), jnp.uint32(0x27D4EB2F))
+    obs = live & ~sw & (prev < 0)
+    oe = jnp.take(stamps["epoch"], sbk)
+    ow = jnp.take(stamps["writer"], sbk)
+    mix = ((sid.astype(jnp.uint32) * m1) ^ (sbk.astype(jnp.uint32) * m2)
+           ^ (oe.astype(jnp.uint32) * m3) ^ (ow.astype(jnp.uint32) * m4))
+    rdig = jnp.where(obs, mix, jnp.uint32(0)).sum(dtype=jnp.uint32)
+
+    # advance the stamp tables: last committed writer per audit bucket
+    # by (lvl, order) position — argmax via two scatter-max passes
+    k = cfg.audit_buckets
+    wl_mask = live & sw
+    sbk_safe = jnp.where(wl_mask, sbk, 0)
+    top = jnp.zeros((k,), jnp.int32).at[sbk_safe].max(
+        jnp.where(wl_mask, sp + 1, 0))
+    upd = top > 0
+    match = wl_mask & (sp + 1 == jnp.take(top, sbk))
+    wid = jnp.zeros((k,), jnp.int32).at[jnp.where(match, sbk, 0)].max(
+        jnp.where(match, sid + 1, 0))
+    new_e = jnp.where(upd, jnp.asarray(epoch, jnp.int32), stamps["epoch"])
+    new_w = jnp.where(upd, wid - 1, stamps["writer"])
+    vdig = ((new_e.astype(jnp.uint32) * m1)
+            ^ (new_w.astype(jnp.uint32) * m2)).sum(dtype=jnp.uint32)
+    return ({"epoch": new_e, "writer": new_w}, edges, ebkt, cnt,
+            dropped, vdig, rdig)
+
+
+def audit_mutate_verdict(cfg, batch: AccessBatch, inc: Incidence,
+                         verdict, epoch):
+    """Seeded edge-derivation fault (``Config.audit_mutate``, the
+    certifier's anti-inert knob): emulate dropping OCC's read-set-vs-
+    winner-write-set check on the chosen epoch window.  A Kung-Robinson
+    loser whose WRITE lanes miss every winner-written bucket was
+    aborted purely for its stale reads — with the check gone it commits
+    (and executes, and acks), a real isolation violation: reciprocal
+    read/write overlaps among the flipped losers and the winners form
+    rw cycles (write skew) that harness/auditgraph.py must reject with
+    a witness naming an epoch in the window."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    _, start, count = cfg.audit_mutate_spec()
+    committed = verdict.commit & batch.active
+    wrote = jnp.matmul(committed.astype(inc.w1.dtype)[None, :], inc.w1,
+                       preferred_element_type=jnp.float32)[0] > 0
+    hit = jnp.take(wrote, inc.bucket1)
+    if inc.w2 is not None:
+        ident = combine_key(batch.table_ids, batch.keys)
+        b2 = bucket_hash(ident, inc.w2.shape[1], family=1)
+        wrote2 = jnp.matmul(committed.astype(inc.w2.dtype)[None, :],
+                            inc.w2, preferred_element_type=jnp.float32
+                            )[0] > 0
+        hit = hit & jnp.take(wrote2, b2)
+    wmask = batch.valid & batch.is_write
+    if batch.order_free is not None:
+        wmask = wmask & ~batch.order_free
+    dirty_writes = (wmask & hit).any(axis=1)
+    e = jnp.asarray(epoch, jnp.int32)
+    in_window = (e >= start) & (e < start + count)
+    flip = verdict.abort & batch.active & ~dirty_writes & in_window
+    return dataclasses.replace(
+        verdict, commit=verdict.commit | flip,
+        abort=verdict.abort & ~flip)
+
+
 def build_incidence(batch: AccessBatch, n_buckets: int, exact: bool,
                     order_free: jax.Array | None = None) -> Incidence:
     # `shard_buckets` is a no-op single-device; under a parallel.use_mesh

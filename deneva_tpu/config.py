@@ -685,6 +685,69 @@ class Config:
     #                                fast chips where per-epoch frames
     #                                would flood the aggregator
 
+    # ---- isolation audit plane (online serializability certifier with
+    # cycle-witness forensics; cc/base.audit_observe + runtime/audit.py
+    # + harness/auditgraph.py).  Default OFF: with audit=False no
+    # observation is ever derived, no audit_*.jsonl sidecar is written,
+    # no [audit] line prints, the group jit's outputs are exactly the
+    # pre-audit ones and every wire/log byte is bit-identical to the
+    # pre-audit runtime (the same contract as chaos/elastic/geo/
+    # overload/repair/fencing/telemetry/metrics). ----
+    audit: bool = False            # arm the certifier: each epoch derives
+    #                                committed-txn dependency observations
+    #                                ON DEVICE (ww/wr/rw edge lists between
+    #                                committed txns off the planned access
+    #                                sets under the backend's visibility
+    #                                rule, plus per-bucket version stamps
+    #                                — the audit twin of the VersionRing)
+    #                                and exports them beside the verdict
+    #                                planes into audit_node*.jsonl;
+    #                                harness/auditgraph.py joins the
+    #                                sidecars across nodes/epochs into the
+    #                                cluster-wide Direct Serialization
+    #                                Graph and either certifies the run
+    #                                serializable or renders a minimal
+    #                                cycle witness (Adya G0/G1c/G-single/
+    #                                G2 classification)
+    audit_cadence: int = 8         # epochs between audited epochs (depth
+    #                                knob with a live default, like
+    #                                telemetry_sample: the whole device
+    #                                derivation skips off-cadence epochs
+    #                                via lax.cond, so coverage trades
+    #                                against cost — the <=2% overhead
+    #                                gate pins THIS default rate
+    #                                (tools/audit_bench.py; the exact-key
+    #                                lane sort is ~4 ms/epoch at B=1024
+    #                                on the CPU rig, so always-on costs
+    #                                ~12% there).  1 = certify every
+    #                                epoch — what every chaos scenario
+    #                                pins (harness/chaos.py chaos_cfg),
+    #                                so the standing oracles and the
+    #                                mutation catch run at FULL coverage.
+    #                                Every node skips the same epochs,
+    #                                keeping sidecars consensus-
+    #                                comparable.
+    audit_edges_max: int = 4096    # per-epoch exported-edge cap (static
+    #                                d2h shape); overflow counts as
+    #                                audit_drop_cnt and degrades the
+    #                                certificate to "incomplete", never
+    #                                silently
+    audit_buckets: int = 1 << 16   # hashed width of the audit version-
+    #                                stamp tables (the cross-epoch
+    #                                observation space; O(K) memory like
+    #                                the T/O watermarks, so it can be much
+    #                                wider than conflict_buckets)
+    audit_mutate: str = ""         # seeded edge-derivation fault (the
+    #                                anti-inert knob): "occ-read-skip:
+    #                                START[:COUNT]" drops OCC's read-set-
+    #                                vs-winner-write-set check on epochs
+    #                                [START, START+COUNT) — losers whose
+    #                                writes miss every winner-written
+    #                                bucket commit anyway, a REAL isolation
+    #                                violation the certifier must reject
+    #                                with a cycle witness naming an epoch
+    #                                in the window.  Test/chaos use only.
+
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
     checkpoint_path: str = ""      # "" = checkpointing off
@@ -808,6 +871,27 @@ class Config:
         _check(all(w > 0 for w in ws), "tenant_weights must be positive")
         s = sum(ws)
         return [w / s for w in ws]
+
+    def audit_mutate_spec(self) -> tuple[str, int, int] | None:
+        """Parse audit_mutate 'KIND:START[:COUNT]' into (kind, start,
+        count); None when unset.  COUNT defaults to 1."""
+        if not self.audit_mutate:
+            return None
+        parts = self.audit_mutate.split(":")
+        if len(parts) not in (2, 3) or parts[0] != "occ-read-skip":
+            raise ValueError(
+                f"config: audit_mutate {self.audit_mutate!r} must be "
+                "'occ-read-skip:START_EPOCH[:COUNT]'")
+        try:
+            start = int(parts[1])
+            count = int(parts[2]) if len(parts) == 3 else 1
+        except ValueError:
+            raise ValueError(
+                f"config: audit_mutate {self.audit_mutate!r}: START/"
+                "COUNT must be integers")
+        _check(start >= 0 and count >= 1,
+               "audit_mutate needs START >= 0 and COUNT >= 1")
+        return parts[0], start, count
 
     def elastic_plan_spec(self) -> tuple[str, int, int] | None:
         """Parse elastic_plan 'grow|drain:node:epoch' (None when unset)."""
@@ -1110,6 +1194,56 @@ class Config:
         # live default) ----
         _check(self.metrics_cadence >= 1,
                "metrics_cadence must be >= 1 (1 frames every epoch)")
+        # ---- isolation audit gating (same discipline: the default
+        # takes the pre-audit paths exactly; cadence/edges/buckets are
+        # depth knobs with live defaults) ----
+        _check(self.audit_cadence >= 1,
+               "audit_cadence must be >= 1 (1 exports every epoch)")
+        _check(self.audit_edges_max >= 64,
+               "audit_edges_max must be >= 64")
+        _check(self.audit_buckets >= 1024
+               and (self.audit_buckets & (self.audit_buckets - 1)) == 0,
+               "audit_buckets must be a power of two >= 1024")
+        if self.audit:
+            _check(self.mode == Mode.NORMAL,
+                   "audit certifies executed state; degraded modes "
+                   "(SIMPLE/NOCC/QRY_ONLY) execute nothing to certify")
+            _check(self.device_parts == 1,
+                   "audit observations do not compose with multi-chip "
+                   "execution yet (the edge derivation is single-device)")
+            _check(self.cc_alg != CCAlg.MVCC,
+                   "audit does not model MVCC's in-ring version-select "
+                   "reads yet (its observed versions are ts-dependent, "
+                   "not epoch-start; every other backend's reads are "
+                   "epoch-start / level / order visible)")
+            _check(self.workload in (WorkloadKind.YCSB, WorkloadKind.TPCC),
+                   "audit is wired for YCSB and TPCC (the workload load "
+                   "path installs the audit stamp tables)")
+            _check(self.epoch_batch <= 16384,
+                   "audit needs epoch_batch <= 16384: exported edges "
+                   "pack (kind, src, dst) merged-batch ranks into 14-bit "
+                   "fields of one int32")
+            _check(self.dist_protocol != "vote",
+                   "audit needs the merged epoch body (the VOTE "
+                   "dispatch path derives no observation, so the "
+                   "certifier would be armed but provably inert)")
+            if self.node_cnt > 1:
+                _check(self.dist_protocol == "merged"
+                       or self.cc_alg in (CCAlg.CALVIN, CCAlg.TPU_BATCH),
+                       "cluster audit needs the replicated deterministic "
+                       "verdict (--dist_protocol=merged or a "
+                       "deterministic backend): the VOTE protocol's "
+                       "partitioned local validation exports no "
+                       "cluster-consistent observation")
+        else:
+            _check(not self.audit_mutate,
+                   "audit_mutate needs --audit=true (the certifier must "
+                   "be armed to catch the mutation)")
+        if self.audit_mutate:
+            self.audit_mutate_spec()    # raises on a malformed spec
+            _check(self.cc_alg == CCAlg.OCC,
+                   "audit_mutate 'occ-read-skip' weakens OCC's "
+                   "read-set-vs-winner-write-set check; set cc_alg=OCC")
         # ---- transaction repair gating (same discipline as elastic/geo/
         # overload: defaults take the pre-repair paths exactly) ----
         _check(self.repair_rounds >= 0 and self.repair_rounds <= 8,

@@ -32,8 +32,11 @@ import numpy as np
 #          6 = round-13 (rep_* transaction-repair counters in
 #              device stats);
 #          7 = round-16 (conflict_density per-partition counter in
-#              device stats — the metrics bus's contention signal).
-SCHEMA_VERSION = 7
+#              device stats — the metrics bus's contention signal);
+#          8 = round-17 (isolation audit plane: audit_edge_cnt/
+#              audit_drop_cnt device counters, and with audit armed the
+#              db pytree gains the __audit__ version-stamp tables).
+SCHEMA_VERSION = 8
 
 
 def save_state(path: str, state) -> None:

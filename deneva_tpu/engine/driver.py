@@ -238,6 +238,14 @@ def run_simulation(cfg: Config, chunk: int = 50,
         for i, d in enumerate(dens):
             st.set(f"mb_density_p{i}", float(d))
         st.set("mb_density_total", float(dens.sum()))
+    if cfg.audit:
+        # isolation audit ([summary] satellite): dependency edge lanes
+        # observed among committed txns + export-cap overflows over the
+        # measured window (the sidecar export is the cluster runtime's
+        # job — in-process runs surface the device counters).  Emitted
+        # only when armed so the default summary line is byte-identical.
+        for k in ("audit_edge_cnt", "audit_drop_cnt"):
+            st.set(k, float(after[k] - before[k]))
     for i, nm in enumerate(getattr(wl, "txn_type_names", ())):
         for fam in ("commit", "abort"):
             key = f"{fam}_by_type"

@@ -105,10 +105,14 @@ def run_repair(cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
     Inputs are the main round's artifacts: the planned ``batch``, its
     ``inc``idence views, the backend ``verdict`` (post defer-budget
     merge), the threaded ``cc_state`` and the executed commit mask
-    ``exec_commit``.  Returns ``(db, cc_state, verdict', salvaged)``
-    where ``verdict'`` has the salvaged txns moved from ``abort`` to
-    ``commit`` — so retry routing, ack planes and the abort counters
-    downstream never see a salvaged txn as aborted
+    ``exec_commit``.  Returns ``(db, cc_state, verdict', salvaged,
+    rounds)`` where ``rounds`` is int32[B] naming each salvaged txn's
+    sub-round (1-based; 0 = main-round/not salvaged — the audit
+    plane's visibility level: a round-r salvage re-read state that
+    includes every wave < r) and ``verdict'`` has the salvaged txns
+    moved from ``abort`` to ``commit`` — so retry routing, ack planes
+    and the abort counters downstream never see a salvaged txn as
+    aborted
     (``rep_salvaged_cnt`` counts them instead; the satellite contract
     for `harness/parse.py` compatibility).  Device-counter contract:
     ``rep_salvaged_cnt + rep_fallback_cnt`` equals the repair-eligible
@@ -122,8 +126,9 @@ def run_repair(cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
         losers = losers & ~forced
     committed = exec_commit & batch.active
     salvaged = jnp.zeros_like(losers)
+    rounds = jnp.zeros_like(batch.rank)
     fresh = repair_ts(batch, ts_base)
-    for _ in range(cfg.repair_rounds):
+    for rnd in range(cfg.repair_rounds):
         frontier = be.repair_rule(cfg, cc_state, batch, inc, committed,
                                   losers)
         stats["rep_frontier_cnt"] = stats["rep_frontier_cnt"] \
@@ -143,6 +148,7 @@ def run_repair(cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
         # sub-round dataflow)
         db = wl.re_execute(db, queries, rep, rv.order, stats)
         salvaged = salvaged | rep
+        rounds = jnp.where(rep, jnp.int32(rnd + 1), rounds)
         committed = committed | rep
         # the sub-round's own aborts/defers (still-conflicting losers)
         # chain into the next pass; leftovers past the budget fall back
@@ -154,7 +160,7 @@ def run_repair(cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
     verdict = dataclasses.replace(
         verdict, commit=verdict.commit | salvaged,
         abort=verdict.abort & ~salvaged)
-    return db, cc_state, verdict, salvaged
+    return db, cc_state, verdict, salvaged, rounds
 
 
 def repair_line(node: int, fields: dict) -> str:

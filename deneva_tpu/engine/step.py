@@ -102,6 +102,11 @@ def init_device_stats(n_txn_types: int = 1, n_parts: int = 1) -> dict:
         # independent); stay zero unless repair is armed.
         "rep_salvaged_cnt": z(), "rep_frontier_cnt": z(),
         "rep_fallback_cnt": z(),
+        # isolation audit plane (cc/base.audit_observe, Config.audit):
+        # dependency edge-lanes observed among committed txns and
+        # export-cap overflows.  Always present (pytree structure is
+        # config-independent); stay zero unless audit is armed.
+        "audit_edge_cnt": z(), "audit_drop_cnt": z(),
         # per-txn-kind commit/abort breakdown (reference Stats_thd's
         # per-type counter families); names come from
         # Workload.txn_type_names at summary time
@@ -242,6 +247,13 @@ class Engine:
             inc = build_conflict_incidence(cfg, be, batch,
                                            batch.order_free)
             verdict, cc_state = be.validate(cfg, state.cc_state, batch, inc)
+            if cfg.audit_mutate:
+                # seeded edge-derivation fault (the audit plane's
+                # anti-inert knob): flipped losers execute and ack like
+                # any commit — a real violation the certifier must catch
+                from deneva_tpu.cc import audit_mutate_verdict
+                verdict = audit_mutate_verdict(cfg, batch, inc, verdict,
+                                               state.epoch)
         if cfg.metrics and cfg.device_parts == 1:
             # metrics bus (runtime/metricsbus.py): accumulate the
             # per-partition observed-conflict density off the incidence
@@ -312,6 +324,7 @@ class Engine:
         # Mode.SIMPLE / QRY_ONLY: ack without touching tables
         # (reference SIMPLE_MODE / QRY_ONLY_MODE, config.h:276-281)
 
+        srounds = None
         # 5b. transaction repair (engine/repair.py, default off): the
         # losers of the sweep re-execute as chained sub-rounds against
         # the post-winner state inside this same jitted step; salvaged
@@ -326,12 +339,37 @@ class Engine:
             # stamp authority pool.update uses for abort restamps, so
             # repaired stamps sit strictly above every committed
             # watermark and every stamp in this epoch
-            db, cc_state, verdict, salvaged = run_repair(
+            db, cc_state, verdict, salvaged, srounds = run_repair(
                 cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
                 stats, exec_commit, forced,
                 ts_base=pool.next_seq - jnp.int32(self.pool.b))
             exec_commit = exec_commit | salvaged
             release = release | salvaged
+
+        # 5c. isolation audit (cc/base.audit_observe, default off): an
+        # OBSERVATION of the final committed set — never an input to any
+        # verdict or table write, so armed-vs-off row state is
+        # bit-identical (tested).  The in-process engine keeps the stamp
+        # tables + device counters; the sidecar export is the cluster
+        # runtime's job (runtime/audit.py).
+        if cfg.audit and cfg.mode == Mode.NORMAL \
+                and cfg.device_parts == 1:
+            from deneva_tpu.cc import AUDIT_KEY, audit_observe
+            order_vis = forwarding
+            if forwarding:
+                lvl = jnp.zeros_like(verdict.level)
+            elif be.chained:
+                lvl = verdict.level
+            else:
+                lvl = srounds if srounds is not None \
+                    else jnp.zeros_like(verdict.level)
+            aud2, _e, _bk, cnt, drop, _vd, _rd = audit_observe(
+                cfg, batch, exec_commit & active, verdict.order, lvl,
+                order_vis, db[AUDIT_KEY], state.epoch)
+            db = dict(db)
+            db[AUDIT_KEY] = aud2
+            stats["audit_edge_cnt"] += cnt.astype(jnp.uint32)
+            stats["audit_drop_cnt"] += drop.astype(jnp.uint32)
 
         # 6. update pool + counters (forced txns release like commits)
         pre_abort_cnt = sel(pool.abort_cnt)   # pre-update: 0 = never aborted

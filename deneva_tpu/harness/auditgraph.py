@@ -1,0 +1,393 @@
+"""Cluster-wide serializability certifier with cycle-witness forensics.
+
+The runtime half (``cc/base.audit_observe`` + ``runtime/audit.py``,
+armed by ``Config.audit``) exports each epoch's committed-txn
+dependency observations — ww/wr/rw edge lists over merged-batch ranks,
+slice tag joins, and version-stamp digests — into per-node
+``audit_node*.jsonl`` sidecars.  This module is the judgment half:
+
+1. **Join** the sidecars across nodes and epochs.  Merged-mode servers
+   derive the IDENTICAL observations per epoch, so any disagreement on
+   an epoch's edge list or stamp digests is itself a finding
+   (``divergences`` — the split-brain signature, independent of cycle
+   structure).
+2. **Build** the Direct Serialization Graph.  Cross-epoch dependencies
+   in this runtime always point forward in epoch order (reads observe
+   the true latest version at their visibility point, applies advance
+   monotonically — the stamp digests cross-check that bookkeeping), so
+   every cycle lies within one epoch's committed set and the per-epoch
+   subgraphs are exactly the cycle search space.
+3. **Certify or witness.**  Tarjan SCC + shortest-cycle extraction per
+   offending epoch; each cycle classifies Adya-style by its edge kinds
+   — all-ww = G0 (write cycle), ww/wr only = G1c (circular information
+   flow), exactly one rw = G-single, two or more rw = G2-item (write
+   skew family) — and renders as an incident report: txn tags, owning
+   nodes, edges with their row-bucket forensics, and (when flight-
+   recorder sidecars sit beside the audit stream) each witness txn's
+   lifecycle span chain.
+
+A certificate is only as complete as its coverage: epochs whose edge
+export overflowed ``audit_edges_max`` (``dropped`` > 0) or that were
+thinned by ``audit_cadence`` degrade ``complete`` to False — reported,
+never silent.
+
+CLI:  python -m deneva_tpu.harness.auditgraph <run-dir> [--json]
+          [--nodes 0,1,...]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+
+from deneva_tpu.runtime.audit import EDGE_KINDS, decode_edge
+from deneva_tpu.runtime.metricschema import read_metrics
+
+_NODE_RE = re.compile(r"audit_node(\d+)\.jsonl$")
+
+# fields that must agree across every node exporting the same epoch
+# (merged-mode determinism; vdig/rdig additionally cross-check the
+# version-stamp bookkeeping itself)
+_CONSENSUS = ("edge_cnt", "edges", "vdig", "rdig")
+
+
+def load_audit(run_dir: str, nodes: list[int] | None = None
+               ) -> dict[int, list[dict]]:
+    """{node: [records...]} from a run directory's audit sidecars.
+    ``nodes`` restricts to the given ids (the chaos oracle passes the
+    nodes that finished as live servers — a fenced/killed-in-place
+    node's trailing observations are not part of the authoritative
+    history)."""
+    out: dict[int, list[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "audit_node*.jsonl"))):
+        m = _NODE_RE.search(path)
+        if not m:
+            continue
+        node = int(m.group(1))
+        if nodes is not None and node not in nodes:
+            continue
+        out[node] = read_metrics(path)
+    return out
+
+
+def merge_epochs(by_node: dict[int, list[dict]]
+                 ) -> tuple[dict[int, dict], list[dict]]:
+    """Join per-node records into one view per epoch + the divergence
+    findings.  Per epoch: the consensus edge list, each edge's bucket,
+    the union tag map (rank -> tag) and rank ownership (rank -> the
+    node whose admission slice carried it)."""
+    per_epoch: dict[int, dict[int, dict]] = {}
+    for node, recs in sorted(by_node.items()):
+        for r in recs:
+            e = int(r.get("epoch", -1))
+            per_epoch.setdefault(e, {})[node] = r
+    epochs: dict[int, dict] = {}
+    divergences: list[dict] = []
+    for e, noderecs in sorted(per_epoch.items()):
+        ref_node = min(noderecs)
+        ref = noderecs[ref_node]
+        for node in sorted(noderecs):
+            r = noderecs[node]
+            bad = [f for f in _CONSENSUS if r.get(f) != ref.get(f)]
+            if bad:
+                divergences.append({
+                    "epoch": e, "nodes": [ref_node, node],
+                    "fields": bad})
+        tags: dict[int, int] = {}
+        owner: dict[int, int] = {}
+        for node in sorted(noderecs):
+            r = noderecs[node]
+            for k, v in sorted(r.get("tags", {}).items()):
+                tags[int(k)] = int(v)
+            lo, n = int(r.get("lo", 0)), int(r.get("b_loc", 0))
+            for rank in range(lo, lo + n):
+                owner[rank] = node
+        epochs[e] = {
+            "edges": [int(x) for x in ref.get("edges", [])],
+            "ebkt": [int(x) for x in ref.get("ebkt", [])],
+            "edge_cnt": int(ref.get("edge_cnt", 0)),
+            "dropped": max(int(noderecs[n].get("dropped", 0))
+                           for n in noderecs),
+            "commit": sum(int(noderecs[n].get("commit", 0))
+                          for n in noderecs),
+            "tags": tags, "owner": owner,
+        }
+    return epochs, divergences
+
+
+def _adjacency(ep: dict) -> dict[int, list[tuple[int, int, int]]]:
+    """Deduped edge list -> {src: [(dst, kind, bucket), ...]}."""
+    adj: dict[int, list[tuple[int, int, int]]] = {}
+    seen = set()
+    for packed, bkt in zip(ep["edges"], ep["ebkt"]):
+        kind, src, dst = decode_edge(packed)
+        if src == dst or (kind, src, dst) in seen:
+            continue
+        seen.add((kind, src, dst))
+        adj.setdefault(src, []).append((dst, kind, bkt))
+    return adj
+
+
+def _sccs(adj: dict[int, list]) -> list[list[int]]:
+    """Iterative Tarjan: strongly connected components with > 1 node
+    (self-edges are filtered at build time, so singletons are acyclic)."""
+    index: dict[int, int] = {}
+    low: dict[int, int] = {}
+    on: set[int] = set()
+    stack: list[int] = []
+    out: list[list[int]] = []
+    counter = [0]
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work = [(root, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on.add(v)
+            advanced = False
+            succs = adj.get(v, ())
+            for i in range(pi, len(succs)):
+                w = succs[i][0]
+                if w not in index:
+                    work[-1] = (v, i + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if w in on:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+    return out
+
+
+def _shortest_cycle(adj: dict[int, list], comp: list[int]
+                    ) -> list[tuple[int, int, int, int]]:
+    """Minimal cycle inside one SCC as [(src, dst, kind, bucket), ...]
+    — BFS from each member restricted to the component."""
+    cset = set(comp)
+    best: list[tuple[int, int, int, int]] | None = None
+    for start in comp:
+        # BFS tree of (pred lane) back-pointers; first re-entry into
+        # `start` closes the shortest cycle through it
+        pred: dict[int, tuple[int, int, int]] = {}
+        frontier = [start]
+        found = None
+        while frontier and found is None:
+            nxt: list[int] = []
+            for u in frontier:
+                for (w, kind, bkt) in adj.get(u, ()):
+                    if w not in cset:
+                        continue
+                    if w == start:
+                        found = (u, kind, bkt)
+                        break
+                    if w not in pred:
+                        pred[w] = (u, kind, bkt)
+                        nxt.append(w)
+                if found is not None:
+                    break
+            frontier = nxt
+        if found is None:
+            continue
+        u, kind, bkt = found
+        path = [(u, start, kind, bkt)]
+        while u != start:
+            pu, pkind, pbkt = pred[u]
+            path.append((pu, u, pkind, pbkt))
+            u = pu
+        path.reverse()
+        if best is None or len(path) < len(best):
+            best = path
+    return best or []
+
+
+def classify(kinds: list[int]) -> str:
+    """Adya anomaly class of one cycle from its edge kinds (0=ww, 1=wr,
+    2=rw): G0 write cycle, G1c circular information flow, G-single
+    (one anti-dependency), G2-item (two or more — write skew family)."""
+    rw = sum(1 for k in kinds if k == 2)
+    if rw == 0:
+        return "G0" if all(k == 0 for k in kinds) else "G1c"
+    return "G-single" if rw == 1 else "G2-item"
+
+
+def _witness(epoch: int, ep: dict, cycle) -> dict:
+    kinds = [k for (_s, _d, k, _b) in cycle]
+    txns = sorted({s for (s, _d, _k, _b) in cycle}
+                  | {d for (_s, d, _k, _b) in cycle})
+    return {
+        "epoch": epoch,
+        "anomaly": classify(kinds),
+        "txns": [{"rank": r,
+                  "tag": ep["tags"].get(r),
+                  "node": ep["owner"].get(r)} for r in txns],
+        "edges": [{"src": s, "dst": d, "kind": EDGE_KINDS[k],
+                   "bucket": b} for (s, d, k, b) in cycle],
+    }
+
+
+def attach_spans(run_dir: str, cert: dict) -> None:
+    """Join witness txns to their flight-recorder span chains when
+    telemetry sidecars sit beside the audit stream (Config.telemetry):
+    the violation then reads as an incident — which client sent the
+    txn, when it was admitted, batched, acked — not just a graph."""
+    if not cert["cycles"] or not glob.glob(
+            os.path.join(run_dir, "telemetry_*.bin")):
+        return
+    from deneva_tpu.harness import txntrace
+
+    recs, _roles = txntrace.load_dir(run_dir)
+    if not len(recs):
+        return
+    by_tag = txntrace.index_txns(recs)
+    for w in cert["cycles"]:
+        for t in w["txns"]:
+            ev = by_tag.get(t["tag"]) if t["tag"] is not None else None
+            if ev is None:
+                continue
+            ch = txntrace.build_chain(ev)
+            t["spans"] = {k: ch.get(k) for k in
+                          ("send", "admit", "batch", "verdict", "ack")
+                          if ch.get(k) is not None}
+
+
+def certify(run_dir: str, nodes: list[int] | None = None,
+            with_spans: bool = True) -> dict:
+    """Certify one run's audit sidecars.  Returns the certificate:
+
+    {ok, epochs, commits, edge_lanes, edges_deduped, dropped_epochs,
+     complete, divergences, cycles} — ``ok`` is True iff NO dependency
+    cycle exists in any audited epoch; ``divergences`` (cross-node
+    observation mismatches) are reported alongside so the chaos oracle
+    can fail on either; ``complete`` is False when edge export was
+    capped (dropped > 0 anywhere) — the certificate then only covers
+    what was exported."""
+    by_node = load_audit(run_dir, nodes)
+    epochs, divergences = merge_epochs(by_node)
+    cycles: list[dict] = []
+    edge_lanes = 0
+    edges_deduped = 0
+    dropped_epochs = 0
+    commits = 0
+    for e in sorted(epochs):
+        ep = epochs[e]
+        edge_lanes += ep["edge_cnt"]
+        commits += ep["commit"]
+        if ep["dropped"]:
+            dropped_epochs += 1
+        adj = _adjacency(ep)
+        edges_deduped += sum(len(v) for v in adj.values())
+        for comp in _sccs(adj):
+            cyc = _shortest_cycle(adj, comp)
+            if cyc:
+                cycles.append(_witness(e, ep, cyc))
+    cert = {
+        "ok": not cycles,
+        "epochs": len(epochs),
+        "commits": commits,
+        "edge_lanes": edge_lanes,
+        "edges_deduped": edges_deduped,
+        "dropped_epochs": dropped_epochs,
+        "complete": dropped_epochs == 0,
+        "divergences": divergences,
+        "cycles": cycles,
+    }
+    if with_spans:
+        attach_spans(run_dir, cert)
+    return cert
+
+
+def render(cert: dict) -> str:
+    """Human incident report / certificate."""
+    lines = []
+    if cert["ok"]:
+        lines.append(
+            f"[auditgraph] CERTIFIED serializable: {cert['epochs']} "
+            f"epochs, {cert['commits']} commits, "
+            f"{cert['edges_deduped']} dependency edges "
+            f"({cert['edge_lanes']} edge lanes), no cycle")
+        if not cert["complete"]:
+            lines.append(
+                f"[auditgraph] WARNING: certificate incomplete — "
+                f"{cert['dropped_epochs']} epoch(s) overflowed the "
+                "edge-export cap (raise audit_edges_max)")
+    else:
+        lines.append(
+            f"[auditgraph] VIOLATION: {len(cert['cycles'])} dependency "
+            f"cycle(s) across {cert['epochs']} audited epochs")
+        for w in cert["cycles"]:
+            path = " -> ".join(
+                f"{e['src']}-{e['kind']}[b{e['bucket']}]"
+                for e in w["edges"]) + f" -> {w['edges'][0]['src']}"
+            lines.append(
+                f"[auditgraph]   epoch={w['epoch']} "
+                f"anomaly={w['anomaly']} cycle: {path}")
+            for t in w["txns"]:
+                tag = "?" if t["tag"] is None else t["tag"]
+                node = "?" if t["node"] is None else t["node"]
+                extra = ""
+                if t.get("spans"):
+                    extra = "  spans: " + " ".join(
+                        f"{k}={v}" for k, v in sorted(t["spans"].items()))
+                lines.append(
+                    f"[auditgraph]     txn rank={t['rank']} tag={tag} "
+                    f"node={node}{extra}")
+    for d in cert["divergences"]:
+        lines.append(
+            f"[auditgraph] DIVERGENCE: epoch={d['epoch']} nodes="
+            f"{d['nodes']} disagree on {'/'.join(d['fields'])} — "
+            "split-brain observation")
+    return "\n".join(lines)
+
+
+def main(argv: list[str]) -> int:
+    nodes = None
+    args: list[str] = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--nodes":
+            if i + 1 >= len(argv):
+                print("--nodes needs a value", file=sys.stderr)
+                return 2
+            nodes = [int(x) for x in argv[i + 1].split(",") if x]
+            i += 2
+        else:
+            args.append(argv[i])
+            i += 1
+    pos = [a for a in args if not a.startswith("--")]
+    if not pos:
+        print("usage: python -m deneva_tpu.harness.auditgraph "
+              "<run-dir> [--json] [--nodes 0,1,...]", file=sys.stderr)
+        return 2
+    cert = certify(pos[0], nodes=nodes)
+    if "--json" in args:
+        print(json.dumps(cert, indent=2))
+    else:
+        print(render(cert))
+    return 0 if cert["ok"] and not cert["divergences"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

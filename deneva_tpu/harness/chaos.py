@@ -93,10 +93,31 @@ state is bit-identical to a replay of its own log under its FINAL map):
                    (map_version stays 0) and commits stay identical on
                    all three servers.
 
+Isolation audit (cc/base.audit_observe + runtime/audit.py +
+harness/auditgraph.py; `audit` expands to the pair — the tools/smoke.sh
+``audit`` gate).  The serializability CERTIFICATE is additionally armed
+as a STANDING ORACLE on every kill/partition/repair/geo scenario above
+(audit=true in their configs; `_check_audit` joins the per-node
+audit_node*.jsonl sidecars into the cluster-wide Direct Serialization
+Graph and requires zero dependency cycles and zero cross-node
+observation divergence over the surviving servers):
+
+* **audit-clean**     contended OCC (zipf 0.9) with the certifier
+                   armed; the run must certify serializable with > 0
+                   audited epochs (liveness of the instrument).
+* **audit-mutation**  the same run with the seeded ``audit_mutate``
+                   fault: OCC's read-set-vs-winner-write-set check is
+                   dropped on a chosen epoch window, so stale-read
+                   losers commit — the certifier must REJECT the run
+                   with a concrete cycle witness (txn tags, edges,
+                   owning nodes) naming an epoch inside the mutated
+                   window and an rw-classified anomaly (G-single/G2).
+
 Every scenario runs from a fixed fault_seed, so failures reproduce.
 
 CLI:  python -m deneva_tpu.harness.chaos
-          [scenario ...|all|elastic|geo|overload|partition] [--quick]
+          [scenario ...|all|elastic|geo|overload|partition|audit]
+          [--quick]
 """
 
 from __future__ import annotations
@@ -119,19 +140,27 @@ def chaos_cfg(**kw) -> Config:
         epoch_batch=128, conflict_buckets=512, synth_table_size=4096,
         max_txn_in_flight=1024, req_per_query=4, max_accesses=4,
         zipf_theta=0.6, warmup_secs=0.5, done_secs=2.0,
+        # full-coverage certification wherever a scenario arms audit:
+        # the standing oracles and the mutation catch must see EVERY
+        # epoch (the default cadence is the overhead-gate sampling rate)
+        audit_cadence=1,
         fault_seed=1234)
     base.update(kw)
     return Config(**base)
 
 
-# scenario name -> config overrides (composable: overrides win)
+# scenario name -> config overrides (composable: overrides win).
+# audit=True arms the serializability certificate as a standing oracle
+# (the isolation audit plane observes, never decides — every other
+# invariant of these scenarios is unchanged by it).
 SCENARIOS: dict[str, dict] = {
-    "lossy-net": dict(fault_drop_prob=0.05, fault_resend_us=150_000.0),
-    "dup-storm": dict(fault_dup_prob=0.30),
-    "jittery-net": dict(fault_delay_jitter_us=20_000.0),
+    "lossy-net": dict(fault_drop_prob=0.05, fault_resend_us=150_000.0,
+                      audit=True),
+    "dup-storm": dict(fault_dup_prob=0.30, audit=True),
+    "jittery-net": dict(fault_delay_jitter_us=20_000.0, audit=True),
     "kill-one-server": dict(
         fault_kill="1:64", logging=True, replica_cnt=1, done_secs=4.0,
-        fault_recovery_timeout_s=300.0),
+        fault_recovery_timeout_s=300.0, audit=True),
     # elastic membership (log dirs on /dev/shm: /tmp is 9p on the CI
     # box and the per-epoch fsync would throttle the timed gate)
     "elastic-grow": dict(
@@ -158,16 +187,19 @@ SCENARIOS: dict[str, dict] = {
     # live-set degradation; a frozen horizon wedges exactly this
     # client's inflight credit and the scenario reports zero commits)
     "geo-region-loss": dict(
+        audit=True,
         node_cnt=3, client_node_cnt=2, epoch_batch=256, elastic=True,
         geo=True, geo_region_cnt=3, geo_quorum=1, geo_read_perc=0.1,
         replica_cnt=1, logging=True, fault_kill="2:64", done_secs=10.0,
         log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
     "geo-asymmetric-wan": dict(
+        audit=True,
         node_cnt=2, epoch_batch=256, elastic=True, geo=True,
         geo_region_cnt=2, geo_quorum=1, geo_read_perc=0.15,
         geo_wan_us="0>1:8000,1>0:30000", replica_cnt=1, logging=True,
         done_secs=4.0, log_dir="/dev/shm/deneva_logs"),
     "geo-replica-lag": dict(
+        audit=True,
         node_cnt=2, epoch_batch=256, elastic=True, geo=True,
         geo_region_cnt=2, geo_quorum=1, geo_read_perc=0.15,
         geo_wan_us="0-1:40000", replica_cnt=1, logging=True,
@@ -185,6 +217,7 @@ SCENARIOS: dict[str, dict] = {
     # asserted so the scenario can never silently pass with repair
     # inert.
     "repair-contention": dict(
+        audit=True,
         cc_alg=CCAlg.OCC, dist_protocol="merged", repair=True,
         zipf_theta=0.9, write_perc=0.9, read_perc=0.1,
         synth_table_size=1024, fault_kill="1:64", logging=True,
@@ -281,10 +314,12 @@ SCENARIOS: dict[str, dict] = {
     # measured 4-5 s on the 2-core CI box — a clamped window would
     # swallow all of it and report zero commits.
     "partition-split": dict(
+        audit=True,
         node_cnt=3, epoch_batch=256, elastic=True, fencing=True,
         logging=True, fault_partition="2-0:3.0,2-1:3.0", done_secs=10.0,
         log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
     "partition-asym": dict(
+        audit=True,
         node_cnt=3, epoch_batch=256, elastic=True, fencing=True,
         logging=True, fault_partition="2>0:3.0,2>1:3.0", done_secs=10.0,
         log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
@@ -293,6 +328,7 @@ SCENARIOS: dict[str, dict] = {
     # only the first gap is silence), so it must clear the floor with
     # margin on a loaded box
     "partition-grayslow": dict(
+        audit=True,
         node_cnt=3, epoch_batch=256, elastic=True, fencing=True,
         logging=True, fault_peer_stall="1:4000:3.0", done_secs=10.0,
         log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
@@ -302,16 +338,36 @@ SCENARIOS: dict[str, dict] = {
     # nobody fenced — the hysteresis contract, plus the REJOIN blob
     # catch-up that makes a healed link's dropped epochs recoverable
     "partition-flap": dict(
+        audit=True,
         node_cnt=3, epoch_batch=256, elastic=True, fencing=True,
         logging=True, fault_partition="2-0:2.0,2-1:2.0",
         fault_partition_flap_s=1.2, fencing_phi=4.0,
         fencing_suspect_s=3.0, done_secs=8.0,
         log_dir="/dev/shm/deneva_logs", fault_recovery_timeout_s=300.0),
+    # isolation audit plane (cc/base.audit_observe + runtime/audit.py +
+    # harness/auditgraph.py): contended OCC under the merged protocol
+    # (the certifier needs the replicated deterministic verdict) on a
+    # small hot table.  audit-clean must CERTIFY serializable with the
+    # instrument demonstrably live; audit-mutation drops OCC's
+    # read-set-vs-winner-write-set check on epochs [48, 56) — stale-
+    # read losers commit and execute, so reciprocal read/write overlaps
+    # at zipf 0.9 form real rw cycles — and the certifier must REJECT
+    # with a cycle witness naming an epoch inside exactly that window
+    # (the anti-inert contract: a certifier that cannot catch a seeded
+    # isolation bug proves nothing as an oracle).
+    "audit-clean": dict(
+        cc_alg=CCAlg.OCC, dist_protocol="merged", audit=True,
+        zipf_theta=0.9, synth_table_size=1024, done_secs=2.0),
+    "audit-mutation": dict(
+        cc_alg=CCAlg.OCC, dist_protocol="merged", audit=True,
+        audit_mutate="occ-read-skip:48:8",
+        zipf_theta=0.9, synth_table_size=1024, done_secs=2.0),
 }
 
 # `elastic` on the CLI expands to the three membership scenarios (the
 # tools/smoke.sh elastic gate); `geo` to the geo-replication trio;
-# `overload` to the admission-control trio
+# `overload` to the admission-control trio; `audit` to the
+# isolation-audit pair
 ELASTIC_SCENARIOS = ("elastic-grow", "elastic-drain",
                      "elastic-kill-reassign")
 GEO_SCENARIOS = ("geo-region-loss", "geo-asymmetric-wan",
@@ -320,6 +376,7 @@ OVERLOAD_SCENARIOS = ("overload-flash", "overload-aggressor",
                       "overload-diurnal")
 PARTITION_SCENARIOS = ("partition-split", "partition-asym",
                        "partition-grayslow", "partition-flap")
+AUDIT_SCENARIOS = ("audit-clean", "audit-mutation")
 
 
 class ChaosViolation(AssertionError):
@@ -343,7 +400,8 @@ def run_scenario(name: str, quick: bool = False,
                        f"(have {sorted(SCENARIOS)})")
     spec = dict(SCENARIOS[name])
     if quick and not name.startswith(("elastic-", "geo-", "overload-",
-                                      "partition-", "monitor-")):
+                                      "partition-", "monitor-",
+                                      "audit-")):
         # elastic scenarios keep their full window: the cutover stall
         # (row stream + boundary sync, 1.4-2.2 s measured on the CI box;
         # ~5 s replay-jit for kill-reassign) would otherwise swallow a
@@ -450,6 +508,11 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
         _check_overload(name, cfg, srv, cls, report)
     if name.startswith("partition-"):
         _check_partition(name, cfg, out, run_id, report)
+    if cfg.audit:
+        # the standing serializability oracle (and, under audit_mutate,
+        # its anti-inert inversion) — last, so the violation report
+        # lands on an otherwise-validated run
+        _check_audit(name, cfg, out, run_id, report)
 
 
 def _check_elastic(name: str, cfg: Config, out: dict, report: dict) -> None:
@@ -950,6 +1013,77 @@ def _check_monitor(cfg: Config, srv: list[dict], cls: list[dict],
              "vector (the router item's input signal is missing)")
 
 
+def _check_audit(name: str, cfg: Config, out: dict, run_id: str,
+                 report: dict) -> None:
+    """Serializability-certificate oracle (the tools/smoke.sh ``audit``
+    gate, and a STANDING oracle on every kill/partition/repair/geo
+    scenario that arms ``audit=true``):
+
+    * the instrument was LIVE: > 0 epochs audited across the surviving
+      servers' sidecars, and the export never overflowed its edge cap
+      (an incomplete certificate proves nothing);
+    * ZERO cross-node observation divergence (merged-mode servers must
+      derive identical edge lists and version-stamp digests — the
+      split-brain cross-check);
+    * without ``audit_mutate``: the cluster-wide Direct Serialization
+      Graph is CYCLE-FREE — the run is certified serializable;
+    * with ``audit_mutate``: the certifier must REJECT the run with a
+      concrete cycle witness naming an epoch INSIDE the mutated window,
+      carrying txn tags + owning nodes, classified as an rw anomaly
+      (G-single/G2-item — the dropped read check admits exactly
+      anti-dependency cycles).
+
+    Only nodes that finished as live servers join the certificate: a
+    fenced/killed-in-place node's trailing observations describe
+    epochs the survivors re-decided after reassignment (its acks were
+    already frozen by the lease), so they are not part of the
+    authoritative history."""
+    from deneva_tpu.harness import auditgraph
+
+    tdir = os.path.join(cfg.log_dir, run_id)
+    live = [s for s in range(cfg.node_cnt) if out[s][0] == "server"]
+    cert = auditgraph.certify(tdir, nodes=live)
+    report["audit_epochs"] = cert["epochs"]
+    report["audit_edges"] = cert["edges_deduped"]
+    report["audit_ok"] = cert["ok"]
+    _require(cert["epochs"] > 0,
+             f"{name}: no epoch was ever audited (is the audit plane "
+             "live?)")
+    _require(cert["complete"],
+             f"{name}: {cert['dropped_epochs']} epoch(s) overflowed "
+             "audit_edges_max — the certificate is incomplete")
+    _require(not cert["divergences"],
+             f"{name}: cross-node audit observations diverged "
+             f"(split-brain signature): {cert['divergences'][:3]}")
+    spec = cfg.audit_mutate_spec()
+    if spec is None:
+        _require(cert["ok"],
+                 f"{name}: serializability certificate REJECTED:\n"
+                 + auditgraph.render(cert))
+        return
+    # anti-inert inversion: the seeded mutation MUST be caught, and
+    # the witness must localize it to the mutated window
+    _, start, count = spec
+    _require(not cert["ok"],
+             f"{name}: mutated epochs [{start}, {start + count}) ran "
+             "but the certifier found no cycle — certifier inert or "
+             "mutation dead")
+    eps = sorted({w["epoch"] for w in cert["cycles"]})
+    report["audit_witness_epochs"] = eps
+    _require(all(start <= e < start + count for e in eps),
+             f"{name}: witness epochs {eps} fall outside the mutated "
+             f"window [{start}, {start + count})")
+    w = cert["cycles"][0]
+    report["audit_anomaly"] = w["anomaly"]
+    _require(w["anomaly"] in ("G-single", "G2-item"),
+             f"{name}: expected an rw-anomaly class from the dropped "
+             f"read check, got {w['anomaly']}")
+    _require(all(t["tag"] is not None and t["node"] is not None
+                 for t in w["txns"]),
+             f"{name}: witness txns missing tag/owner joins: "
+             f"{w['txns']}")
+
+
 def _check_recovery(cfg: Config, out: dict, run_id: str,
                     report: dict) -> None:
     """Safety of the failover path: the killed server recovered by log
@@ -1035,6 +1169,7 @@ def main(argv: list[str]) -> int:
                        else GEO_SCENARIOS if n == "geo"
                        else OVERLOAD_SCENARIOS if n == "overload"
                        else PARTITION_SCENARIOS if n == "partition"
+                       else AUDIT_SCENARIOS if n == "audit"
                        else (n,))]
     rc = 0
     for name in names:

@@ -258,6 +258,27 @@ def parse_metrics(lines) -> list[dict[str, Any]]:
     return rows
 
 
+_AUDIT = re.compile(r"\[audit\] (.*)")
+
+
+def parse_audit(lines) -> list[dict[str, Any]]:
+    """Per-node ``[audit]`` lines (runtime/audit.py via the server
+    summary path) -> [{node, epochs, edges, edge_lanes, dropped,
+    cadence, export_ms}].  The isolation audit plane's health ledger:
+    ``epochs`` proves the certifier's instrument was live (the
+    regression gate's anti-inert check reads the [summary]
+    ``audit_edges_exported`` twin), ``edges``/``edge_lanes`` size the
+    observation stream, ``dropped`` > 0 flags an export-cap overflow
+    (certificate incomplete — raise audit_edges_max).  The CERTIFICATE
+    itself is harness-side (``harness.auditgraph.certify`` over the
+    audit_node*.jsonl sidecars); this line is the per-node export
+    accounting.  Logs predating the audit plane yield [] — and every
+    other parser here ignores ``[audit]`` lines — the same forward/
+    backward-compat contract as ``parse_membership`` through
+    ``parse_metrics`` (tested in tests/test_harness.py)."""
+    return _parse_tagged(lines, _AUDIT)
+
+
 def cfg_header(cfg: Config) -> str:
     """`# cfg key=value` echo lines the runner prepends to each output file
     so parsing never has to re-derive the config from the filename."""

@@ -75,10 +75,14 @@ CRITPATH_TRACK = Track(5, "critpath",
                        frozenset(("crit_admit", "crit_wire",
                                   "crit_device", "crit_retire",
                                   "crit_quorum", "crit_other")))
+# isolation audit plane (runtime/audit.py): the per-pass sidecar-export
+# ledger (observation d2h decode + tag join + JSONL write) — a latency
+# ledger like the admission/fencing spans, on its own declared track
+AUDIT_TRACK = Track(6, "audit", frozenset(("audit",)))
 
 TRACKS: tuple[Track, ...] = (PHASE_TRACK, REPLICATION_TRACK,
                              ADMISSION_TRACK, FENCING_TRACK, TXN_TRACK,
-                             CRITPATH_TRACK)
+                             CRITPATH_TRACK, AUDIT_TRACK)
 
 # span name -> owning track for the [timeline] ledger families
 SPAN_TRACK: dict[str, Track] = {name: t for t in TRACKS

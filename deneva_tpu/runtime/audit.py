@@ -1,0 +1,145 @@
+"""Isolation audit plane: the runtime export half.
+
+The chaos oracles so far check exactly-once accounting and
+digest-vs-replay bit-identity — liveness and determinism, never
+ISOLATION.  A subtly wrong edge derivation in a ``cc/*.py`` backend
+(e.g. OCC silently dropping its read-set-vs-winner-write-set test)
+would commit non-serializable histories and every existing gate would
+stay green.  This module closes that hole: when ``Config.audit`` is
+armed, each server exports the per-epoch dependency observations the
+device derives beside the verdict planes (``cc/base.audit_observe`` —
+ww/wr/rw edge lists between committed txns plus per-bucket version-
+stamp digests) into an ``audit_node*.jsonl`` sidecar through the SAME
+schema module as the flight recorder and the metrics bus
+(runtime/metricschema.py).  ``harness/auditgraph.py`` joins the
+sidecars across nodes and epochs into the cluster-wide Direct
+Serialization Graph and either certifies the run serializable or
+renders a minimal cycle witness (Adya-style G0/G1c/G-single/G2
+classification) — an incident report, not a boolean.
+
+Record shape (one JSON line per exported epoch per node):
+
+    {node, epoch, t_us, commit, edge_cnt, dropped, vdig, rdig,
+     lo, b_loc, edges: [packed...], ebkt: [bucket...],
+     tags: {"rank": tag, ...}}
+
+``edges`` packs ``kind<<28 | src<<14 | dst`` over merged-batch ranks
+(``decode_edge``); ``tags`` maps the edge-endpoint ranks of THIS
+node's admission slice to their packed txn tags, so the union over
+every node's sidecar names each endpoint exactly once (its admitting
+node is the record that carried its tag).  ``vdig``/``rdig`` are the
+stamp-table and read-observation digests every node of a merged
+cluster must reproduce bit-identically — the split-brain cross-check.
+
+With ``audit=false`` (default) nothing here is constructed: no
+sidecar, no ``[audit]`` line, no extra group-jit output, and every
+wire/log byte is bit-identical to the pre-audit runtime (gate registry
+runtime/gates.py; arming it adds NO wire message either — sidecars are
+node-local files the harness joins).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from deneva_tpu.runtime.metricschema import MetricsStream, stream_dir
+from deneva_tpu.stats import tagged_line
+
+EDGE_KINDS = ("ww", "wr", "rw")
+
+
+def decode_edge(e: int) -> tuple[int, int, int]:
+    """Packed edge -> (kind, src_rank, dst_rank)."""
+    return (e >> 28) & 0x3, (e >> 14) & 0x3FFF, e & 0x3FFF
+
+
+def audit_path(cfg, node: int) -> str:
+    return os.path.join(stream_dir(cfg), f"audit_node{node}.jsonl")
+
+
+def audit_line(node: int, fields: dict) -> str:
+    """``[audit]`` per-node summary line (parsed by
+    ``harness.parse.parse_audit`` under the standard ignore-unknown-tags
+    forward/backward-compat contract)."""
+    return tagged_line("audit", {"node": node, **fields})
+
+
+class AuditExporter:
+    """Per-server sidecar writer + accounting for the audit plane.
+
+    Owned by the dispatch thread (exports happen at verdict retirement,
+    the same loop position as the metrics stream).  Recovery appends to
+    the pre-crash sidecar exactly like the command log — records intact
+    to the kill boundary survive the restart.
+    """
+
+    def __init__(self, cfg, node: int, b_loc: int, lo: int,
+                 append: bool = False):
+        self.cfg = cfg
+        self.node = node
+        self.b_loc = b_loc
+        self.lo = lo                      # my slice's merged-batch base
+        self.cadence = max(1, cfg.audit_cadence)
+        self.stream = MetricsStream(audit_path(cfg, node), node,
+                                    append=append)
+        self.epochs_exported = 0
+        self.edges_exported = 0           # capped edge entries written
+        self.edge_lanes = 0               # pre-cap edge-lane total
+        self.dropped = 0
+        self.span_s = 0.0                 # export seconds (timeline span)
+
+    def due(self, epoch: int) -> bool:
+        return epoch % self.cadence == 0
+
+    def export(self, epoch: int, edges_row: np.ndarray,
+               ebkt_row: np.ndarray, cnt: int, dropped: int, vdig: int,
+               rdig: int, commit: int, tags: np.ndarray) -> None:
+        """One epoch's record.  ``edges_row``/``ebkt_row`` are the
+        device's capped export (-1 padded); ``tags`` is this node's
+        admission-slice tag column for the epoch (rank ``lo + i`` ->
+        ``tags[i]``) — only edge-ENDPOINT ranks inside the slice are
+        written, so honest epochs cost one short line."""
+        t0 = time.monotonic()
+        n = min(max(int(cnt), 0), len(edges_row))
+        edges = [int(x) for x in edges_row[:n]]
+        ebkt = [int(x) for x in ebkt_row[:n]]
+        ends: set[int] = set()
+        for e in edges:
+            _k, src, dst = decode_edge(e)
+            ends.add(src)
+            ends.add(dst)
+        tmap = {str(r): int(tags[r - self.lo]) for r in sorted(ends)
+                if self.lo <= r < self.lo + len(tags)}
+        self.stream.emit(epoch, commit=int(commit), edge_cnt=int(cnt),
+                         dropped=int(dropped), vdig=int(vdig),
+                         rdig=int(rdig), lo=self.lo, b_loc=self.b_loc,
+                         edges=edges, ebkt=ebkt, tags=tmap)
+        self.epochs_exported += 1
+        self.edges_exported += n
+        self.edge_lanes += int(cnt)
+        self.dropped += int(dropped)
+        self.span_s += time.monotonic() - t0
+
+    def flush(self) -> None:
+        self.stream.flush()
+
+    def close(self) -> None:
+        self.stream.close()
+
+    # -- reporting -------------------------------------------------------
+    def fields(self) -> dict:
+        return {"epochs": self.epochs_exported,
+                "edges": self.edges_exported,
+                "edge_lanes": self.edge_lanes,
+                "dropped": self.dropped,
+                "cadence": self.cadence,
+                "export_ms": round(self.span_s * 1e3, 3)}
+
+    def summary_into(self, st) -> None:
+        st.set("audit_epochs_exported", float(self.epochs_exported))
+        st.set("audit_edges_exported", float(self.edges_exported))
+        st.set("audit_edges_dropped", float(self.dropped))
+        st.set("audit_export_ms", self.span_s * 1e3)

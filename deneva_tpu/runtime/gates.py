@@ -182,6 +182,27 @@ GATES: dict[str, GateSpec] = {s.name: s for s in (
         use_attrs=("mbus", "magg", "_MB"),
     ),
     GateSpec(
+        "audit",
+        # isolation audit plane (cc/base.audit_observe + runtime/
+        # audit.py + harness/auditgraph.py): on-device dependency
+        # observations -> audit_node*.jsonl sidecars -> cluster-wide
+        # serializability certificate / cycle witness.  audit_cadence /
+        # audit_edges_max / audit_buckets are depth knobs with live
+        # defaults — arming is `audit` (plus the chaos-only
+        # `audit_mutate` fault, which config.validate pins to
+        # audit=true).  `aud` is the server's exporter handle (None
+        # until armed — `self.aud is not None` is the canonical gate);
+        # `_AUD` the lazily-imported module.  The device derivation
+        # functions live in cc/base beside conflict_density, so they
+        # are declared as use_calls rather than via a home prefix.
+        flags=("audit", "audit_mutate"),
+        guards=("audit", "audit_mutate"),
+        home=("deneva_tpu/runtime/audit.py",),
+        use_attrs=("aud", "_AUD"),
+        use_calls=("audit_observe", "audit_init",
+                   "audit_mutate_verdict"),
+    ),
+    GateSpec(
         "fencing",
         # partition & gray-failure tolerance: heartbeat failure
         # detection, fenced slot ownership, quorum reassignment

@@ -96,6 +96,10 @@ OWNER: dict[str, str] = {
     # retire positions, the aggregator feeds from _route and ticks at
     # group boundaries — all dispatch; workers never touch the bus
     "mbus": DISPATCH, "magg": DISPATCH, "_MB": DISPATCH,
+    # isolation audit plane (runtime/audit.py): exports happen at the
+    # _retire positions and the summary path — all dispatch; workers
+    # never touch the exporter or its stream
+    "aud": DISPATCH, "_AUD": DISPATCH,
     # fencing layer (runtime/faildet.py): detector, heartbeat ledgers
     # and fence counters all live on the dispatch thread (_route runs
     # there; workers only READ smap/_FD for the envelope header)

@@ -61,8 +61,8 @@ def _make_epoch_body(cfg: Config, wl, be):
     Deterministic: every server runs this exact function on the identical
     merged batch, so verdicts agree without any vote exchange.
     Returns (body, b_merged) where body maps
-    (db, cc_state, stats, active, ts, query) ->
-    (db, cc_state, stats, done, restart_abort, defer, rep, dens).
+    (db, cc_state, stats, active, ts, query, epoch=None) ->
+    (db, cc_state, stats, done, restart_abort, defer, rep, dens, aud).
     ``rep`` marks txns that committed via transaction repair
     (engine/repair.py — a subset of ``done``; all-false when
     ``cfg.repair`` is off, and the group jit only packs its plane when
@@ -70,7 +70,14 @@ def _make_epoch_body(cfg: Config, wl, be):
     per-partition observed-conflict density (int32[P], the metrics
     bus's per-epoch contention signal) when ``cfg.metrics`` is armed,
     else None — with metrics off the body computes nothing extra and
-    the group jit's outputs are exactly the pre-bus ones.
+    the group jit's outputs are exactly the pre-bus ones.  ``aud`` is
+    the isolation audit plane's per-epoch observation tuple
+    (cc/base.audit_observe: packed edges, edge buckets, counts,
+    digests) when ``cfg.audit`` is armed, else None; armed bodies take
+    ``epoch`` — an observation LABEL (and the audit_mutate window key),
+    never an input to any verdict, and the log replay path feeds the
+    recorded epoch numbers back so replay reproduces the observations
+    bit for bit.
     """
     import jax.numpy as jnp
 
@@ -85,9 +92,11 @@ def _make_epoch_body(cfg: Config, wl, be):
     b = max(1, cfg.epoch_batch // cfg.node_cnt) * cfg.node_cnt
     forwarding = forwarding_applies(be, wl)
 
-    def step(db, cc_state, stats, active, ts, query):
+    def step(db, cc_state, stats, active, ts, query, epoch=None):
         rep = None
+        srounds = None
         dens = None
+        aud_out = None
         rank = jnp.arange(b, dtype=jnp.int32)
         planned = wl.plan(db, query)
         batch = AccessBatch(
@@ -117,6 +126,15 @@ def _make_epoch_body(cfg: Config, wl, be):
             inc = build_conflict_incidence(cfg, be, batch,
                                            batch.order_free)
             verdict, cc_state = be.validate(cfg, cc_state, batch, inc)
+            if cfg.audit_mutate:
+                # seeded edge-derivation fault (the audit plane's
+                # anti-inert knob): flipped losers execute and ack like
+                # any commit — a real isolation violation every server
+                # computes identically (config-keyed) and replay
+                # reproduces (the epoch label rides the log)
+                from deneva_tpu.cc import audit_mutate_verdict
+                verdict = audit_mutate_verdict(cfg, batch, inc, verdict,
+                                               epoch)
             if forced is not None:
                 forced = forced & ~(verdict.abort | verdict.defer)
             exec_commit = verdict.commit if forced is None \
@@ -136,7 +154,7 @@ def _make_epoch_body(cfg: Config, wl, be):
             if cfg.repair and be.repair_rule is not None \
                     and not be.chained:
                 from deneva_tpu.engine.repair import run_repair
-                db, cc_state, verdict, rep = run_repair(
+                db, cc_state, verdict, rep, srounds = run_repair(
                     cfg, wl, be, db, query, batch, inc, verdict,
                     cc_state, stats, exec_commit, forced)
                 exec_commit = exec_commit | rep
@@ -162,7 +180,33 @@ def _make_epoch_body(cfg: Config, wl, be):
         from deneva_tpu.engine.step import count_by_type
         count_by_type(stats, wl, query, commit, abort)
         rep = jnp.zeros_like(done) if rep is None else rep & active
-        return db, cc_state, stats, done, abort & ~done, defer, rep, dens
+        if cfg.audit:
+            # isolation audit (cc/base.audit_observe): dependency
+            # observations of the FINAL committed set — pure
+            # observation, never an input to a verdict or a table
+            # write, so armed-vs-off verdicts/logs stay bit-identical.
+            # Visibility: forwarding = serial-in-order; chained =
+            # levels; repair salvage waves = their sub-round; level-0
+            # sweeps = epoch-start snapshot.
+            from deneva_tpu.cc import AUDIT_KEY, audit_observe
+            order_vis = forwarding
+            if forwarding:
+                lvl = jnp.zeros_like(verdict.level)
+            elif be.chained:
+                lvl = verdict.level
+            else:
+                lvl = srounds if srounds is not None \
+                    else jnp.zeros_like(verdict.level)
+            aud2, edges, ebkt, cnt, drop, vdig, rdig = audit_observe(
+                cfg, batch, commit, verdict.order, lvl, order_vis,
+                db[AUDIT_KEY], epoch)
+            db = dict(db)
+            db[AUDIT_KEY] = aud2
+            stats["audit_edge_cnt"] += cnt.astype(jnp.uint32)
+            stats["audit_drop_cnt"] += drop.astype(jnp.uint32)
+            aud_out = (edges, ebkt, cnt, drop, vdig, rdig)
+        return (db, cc_state, stats, done, abort & ~done, defer, rep,
+                dens, aud_out)
 
     return step, b
 
@@ -176,8 +220,13 @@ def make_dist_step(cfg: Config, wl, be):
 
     @jax.jit
     def step(db, cc_state, stats, epoch, active, ts, query):
-        del epoch    # determinism: the body depends only on its inputs
-        return body(db, cc_state, stats, active, ts, query)
+        # determinism: verdicts depend only on the feed.  The audit
+        # plane consumes the epoch as an observation LABEL (stamp-table
+        # entries + the audit_mutate window key); replay feeds the
+        # recorded epoch numbers back, so replayed observations are
+        # bit-identical too.
+        ep = epoch if cfg.audit else None
+        return body(db, cc_state, stats, active, ts, query, epoch=ep)
 
     return step
 
@@ -228,16 +277,29 @@ def make_dist_group(cfg: Config, wl, be, width: int, n_scalars: int):
 
     def scan_body(carry, xs):
         db, cc_state, stats = carry
-        active, ts, keys, types, scal = xs
+        if cfg.audit:
+            # the audit plane labels each epoch's observations with its
+            # number (stamp tables + the audit_mutate window key): the
+            # host feeds the group's epoch indices as one extra int32[C]
+            # scan input when — and only when — audit is armed
+            active, ts, keys, types, scal, ep = xs
+        else:
+            active, ts, keys, types, scal = xs
+            ep = None
         query = wl.from_wire_dev(keys, types, scal)
-        db, cc_state, stats, done, abort, defer, rep, dens = body(
-            db, cc_state, stats, active, ts, query)
+        db, cc_state, stats, done, abort, defer, rep, dens, aud = body(
+            db, cc_state, stats, active, ts, query, epoch=ep)
         outs = (done[sl], abort[sl], defer[sl], rep[sl])
         if cfg.metrics:
             # per-epoch density plane rides the scan outputs ONLY when
             # the bus is armed — off, the d2h volume is exactly the
             # pre-bus verdict planes
             outs = outs + (dens,)
+        if cfg.audit:
+            # audit observation planes (edges/buckets/counts/digests)
+            # ride the d2h stack only when armed — same off-contract as
+            # the density plane
+            outs = outs + aud
         return (db, cc_state, stats), outs
 
     def pack(m):
@@ -256,21 +318,30 @@ def make_dist_group(cfg: Config, wl, be, width: int, n_scalars: int):
 
     @functools.partial(jax.jit, donate_argnums=donate)
     def group(db, cc_state, stats, active_f, ts_f, keys_f, types_f,
-              scal_f):
+              scal_f, epochs_f=None):
         active = active_f.reshape(C, b)
         ts = ts_f.reshape(C, b)
         keys = keys_f.reshape(C, b, width)
         types = types_f.reshape(C, b, width)
         scal = scal_f.reshape(C, b, n_scalars)
+        xs = (active, ts, keys, types, scal)
+        if cfg.audit:
+            xs = xs + (epochs_f,)
         (db, cc_state, stats), masks = jax.lax.scan(
-            scan_body, (db, cc_state, stats),
-            (active, ts, keys, types, scal))
+            scan_body, (db, cc_state, stats), xs)
         planes = jnp.stack([pack(masks[i]) for i in range(n_planes)])
+        out = (db, cc_state, stats, planes)
         if cfg.metrics:
             # int32[C, P] per-epoch density beside the packed planes
-            # (always the LAST scan output when armed)
-            return db, cc_state, stats, planes, masks[-1]
-        return db, cc_state, stats, planes
+            # (the scan outputs carry the four mask planes at 0..3
+            # whether or not repair packs its plane, so density sits at
+            # the FIXED index 4)
+            out = out + (masks[4],)
+        if cfg.audit:
+            # audit observation stack: ([C, E] edges, [C, E] buckets,
+            # [C] cnt, [C] dropped, [C] vdig, [C] rdig)
+            out = out + (masks[-6:],)
+        return out
 
     return group
 
@@ -681,6 +752,19 @@ class ServerNode:
             if self.me == 0:
                 self.magg = _MB.Aggregator(cfg, self.me,
                                            append=cfg.recover)
+
+        # ---- isolation audit plane (runtime/audit.py — off on a
+        # default config: no exporter, no audit_*.jsonl sidecar, no
+        # [audit] line, and the group jit's outputs are exactly the
+        # pre-audit ones).  Recovery appends to the pre-crash sidecar
+        # like the command log. ----
+        self.aud = None
+        if cfg.audit:
+            from deneva_tpu.runtime import audit as _AUD
+            self._AUD = _AUD
+            self.aud = _AUD.AuditExporter(cfg, self.me, self.b_loc,
+                                          self.me * self.b_loc,
+                                          append=cfg.recover)
 
         # ---- chaos / failover gates (all off on a default config) ------
         # _failover: peers tolerate a dead server and wait for its
@@ -2294,6 +2378,11 @@ class ServerNode:
             # per-epoch density plane [C, P]: same d2h cadence as the
             # verdict planes (the async copy started at dispatch)
             dens = np.asarray(jax.device_get(group["dens_dev"]))
+        auda = None
+        if self.aud is not None and group.get("aud_dev") is not None:
+            # audit observation stack: same d2h cadence as the planes
+            auda = [np.asarray(jax.device_get(a))
+                    for a in group["aud_dev"]]
         lo = self._plane_lo if group["packed"] else 0
         for i, (epoch, block, abort_cnt, birth_ts, dfc) in enumerate(
                 group["eps"]):
@@ -2421,6 +2510,15 @@ class ServerNode:
                         int(df.sum()),
                         int((rep[i, lo:lo + n] & my_commit).sum())
                         if rep is not None else 0)
+            if self.aud is not None and auda is not None \
+                    and self.aud.due(epoch):
+                # isolation audit sidecar: this epoch's dependency
+                # observations + digests, tags joined for the edge
+                # endpoints this node admitted
+                self.aud.export(
+                    epoch, auda[0][i], auda[1][i], int(auda[2][i]),
+                    int(auda[3][i]), int(auda[4][i]), int(auda[5][i]),
+                    commit=int(my_commit.sum()), tags=block.tags)
             restart = ab | df
             if restart.any():
                 idx = np.where(restart)[0]
@@ -2494,6 +2592,10 @@ class ServerNode:
                 np.zeros(C * b, bool), np.zeros(C * b, np.int32),
                 np.zeros(C * b * W, np.int32), np.zeros(C * b * W, np.int8),
                 np.zeros(C * b * S, np.int32)))
+            if self.aud is not None:
+                # audit epoch labels: -1 on the warm call (no epoch;
+                # nothing commits, so no stamp ever records it)
+                warm = warm + (jax.device_put(np.full(C, -1, np.int32)),)
             out = self.group_step(self.db, self.cc_state, self.dev_stats,
                                   *warm)
             # group_step donates its state args: adopt the outputs
@@ -2556,6 +2658,10 @@ class ServerNode:
                     # bus stream intact to the kill boundary; the
                     # recovered aggregator appends (its series resumes)
                     self.magg.close()
+                if self.aud is not None:
+                    # audit sidecar intact to the kill boundary, like
+                    # the command log; the recovered incarnation appends
+                    self.aud.close()
                 if self._elastic:
                     # reassignment (instead of restart) needs every
                     # survivor to stall at the SAME first-missing epoch:
@@ -2778,6 +2884,8 @@ class ServerNode:
                          defer[None, mine])
                 packed = False
                 dens_dev = None     # vote mode: no merged density plane
+                aud_dev = None      # ... and no audit plane (config
+                #                     pins audit to merged/deterministic)
             else:
                 # FLAT explicit async device_put: the raw wire columns
                 # decode on device (wl.from_wire_dev inside the group
@@ -2798,18 +2906,32 @@ class ServerNode:
                     (active_np.reshape(-1), ts32,
                      keys.reshape(-1), types.reshape(-1),
                      scal.reshape(-1)))
+                if self.aud is not None:
+                    # audit epoch labels for this group's scan slices
+                    feed = feed + (jax.device_put(np.arange(
+                        epoch0, epoch0 + C, dtype=np.int32)),)
                 out = self.group_step(self.db, self.cc_state,
                                       self.dev_stats, *feed)
                 self.db, self.cc_state, self.dev_stats = out[:3]
                 masks = out[3]
+                nxt_out = 4
                 if self.mbus is not None:
                     # the bus-armed group jit returns the density plane
                     # beside the packed verdict planes
-                    dens_dev = out[4]
+                    dens_dev = out[nxt_out]
+                    nxt_out += 1
                     if hasattr(dens_dev, "copy_to_host_async"):
                         dens_dev.copy_to_host_async()
                 else:
                     dens_dev = None
+                aud_dev = None
+                if self.aud is not None:
+                    # audit observation stack (edges/buckets/counts/
+                    # digests): start its d2h copies with the planes'
+                    aud_dev = out[nxt_out]
+                    for arr in aud_dev:
+                        if hasattr(arr, "copy_to_host_async"):
+                            arr.copy_to_host_async()
                 packed = True
                 # start the verdict d2h now; retirement K groups later
                 # finds the copy already landed instead of paying the
@@ -2825,7 +2947,7 @@ class ServerNode:
                 self.mbus.crit.lap("device")
             group = {"eps": eps, "masks": masks, "packed": packed,
                      "feed": fs, "wire_futs": wire_futs,
-                     "dens_dev": dens_dev}
+                     "dens_dev": dens_dev, "aud_dev": aud_dev}
             if self._full_planes and packed:
                 # full-plane retirement needs every slice's packed tags
                 # (copied: overlap feed buffers recycle under the group)
@@ -2895,6 +3017,13 @@ class ServerNode:
                     # main track like adm_wait
                     tl.spans.append(("repair", self._rep_span))
                     self._rep_span = 0.0
+                if self.aud is not None and self.aud.span_s:
+                    # audit export accounting (sidecar write + tag
+                    # join): lays out on the declared "audit" track
+                    # (harness/timeline.py tid 6) like the other
+                    # latency ledgers
+                    tl.spans.append(("audit", self.aud.span_s))
+                    self.aud.span_s = 0.0
                 if self._fencing:
                     # fencing spans (suspicion windows, heal gaps, fence
                     # rejections): latency ledgers like the geo spans —
@@ -3058,6 +3187,18 @@ class ServerNode:
             if self.magg is not None:
                 self.magg.summary_into(st)
                 self.magg.close()
+        if self.aud is not None:
+            # isolation audit counters ([summary] satellite: the
+            # anti-inert audit_edges_exported the bench gate reads) +
+            # the [audit] line (parsed by harness.parse.parse_audit);
+            # the device edge counters diff over the measured window
+            # like every other device stat
+            for k in ("audit_edge_cnt", "audit_drop_cnt"):
+                st.set(k, float(final[k] - measured[k]))
+            self.aud.summary_into(st)
+            print(self._AUD.audit_line(self.me, self.aud.fields()),
+                  flush=True)
+            self.aud.close()
         if self._fencing:
             # fencing counters ([summary]) + the [fencing] line (parsed
             # by harness.parse.parse_fencing) + the sidecar the chaos
@@ -3117,6 +3258,9 @@ class ServerNode:
             # idempotent: the summary path already closed it on the
             # normal exit; this covers error unwinds
             self.magg.close()
+        if self.aud is not None:
+            # same idempotent-close posture as the aggregator stream
+            self.aud.close()
         self.tp.close()
 
 

@@ -333,7 +333,15 @@ class TPCCWorkload:
         tunneled chip).  Every initial value is arithmetic on the row
         index, so the whole load is a single XLA program: zero
         host->device bytes, compile + run in seconds at any scale."""
-        return jax.jit(self._build_db)()
+        db = jax.jit(self._build_db)()
+        if self.cfg.audit:
+            # isolation audit stamp tables (cc/base.audit_observe):
+            # loader-installed so every db-construction path threads the
+            # identical pytree; excluded from state_digest (control
+            # plane, like the elastic MEMBER_KEY)
+            from deneva_tpu.cc.base import AUDIT_KEY, audit_init
+            db[AUDIT_KEY] = audit_init(self.cfg)
+        return db
 
     def _build_db(self):
         cfg = self.cfg

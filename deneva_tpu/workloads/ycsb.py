@@ -263,6 +263,14 @@ class YCSBWorkload:
             # this one reads v* directly)
             db[VER_TABLE] = VersionRing.create(
                 f0.shape[0], self.cfg.mvcc_his_len)
+        if self.cfg.audit:
+            # isolation audit stamp tables (cc/base.audit_observe):
+            # installed by the loader so EVERY db-construction path —
+            # engine init, server boot, log replay, follower boot —
+            # threads the identical pytree.  Control plane like
+            # MEMBER_KEY: excluded from state_digest.
+            from deneva_tpu.cc.base import AUDIT_KEY, audit_init
+            db[AUDIT_KEY] = audit_init(self.cfg)
         return db
 
     # -- query generation (ycsb_query.cpp:303-376) ---------------------

@@ -1,0 +1,10 @@
+"""Fixture config: just the audit flags, default OFF (the registry
+drift check cross-parses this module against the REAL audit
+GateSpec)."""
+
+
+class Config:
+    audit: bool = False
+    audit_mutate: str = ""
+    audit_cadence: int = 1
+    node_cnt: int = 1

@@ -1,0 +1,46 @@
+"""Seeded graftlint violations: the REAL ``audit`` GateSpec
+(runtime/gates.py) checked against fixture call sites — an unguarded
+call into the audit home module OR an unguarded use of the declared
+device-derivation use_calls (cc/base's audit_observe family) must fail
+the lint, while the guarded idioms the runtime actually uses
+(``cfg.audit`` at construction, the exporter handle's ``is not None``
+check, ``cfg.audit_mutate`` around the seeded fault) stay silent."""
+
+from deneva_tpu.runtime.audit import AuditExporter, audit_line
+
+
+def audit_observe(cfg, batch):
+    # bare-name stand-in for the cc/base device derivation (use_calls
+    # match by name wherever they appear)
+    return None
+
+
+class ServerFx:
+    def __init__(self, cfg):
+        self.aud = None
+        if cfg.audit:
+            # the runtime idiom: the flag test dominates construction
+            self.aud = AuditExporter(cfg, 0, 1, 0)
+
+    def ok_export(self, epoch):
+        # the exporter object doubles as its own guard
+        if self.aud is not None:
+            self.aud.export(epoch, [], [], 0, 0, 0, 0, 0, [])
+
+    def ok_observe(self, cfg, batch):
+        if cfg.audit:
+            return audit_observe(cfg, batch)
+        return None
+
+    def ok_mutate_guard(self, cfg, batch):
+        # the chaos fault knob is a flag of the same gate
+        if cfg.audit_mutate:
+            return audit_observe(cfg, batch)
+        return None
+
+    def bad_observe(self, cfg, batch):
+        # no dominating audit-flag test on any path to the call
+        return audit_observe(cfg, batch)  # EXPECT[gate-unguarded-use]
+
+    def bad_line(self):
+        return audit_line(0, {})          # EXPECT[gate-unguarded-use]

@@ -270,6 +270,23 @@ def test_chaos_scenario_short(scenario):
     assert all(a > 0 for a in report["client_acked"])
 
 
+def test_audit_mutation_scenario_caught():
+    """The isolation-audit anti-inert contract over a REAL cluster
+    (the tools/smoke.sh ``audit`` gate's mutation half): the seeded
+    occ-read-skip fault commits stale readers on epochs [48, 56) and
+    the serializability certifier must reject the run with rw-anomaly
+    witnesses naming epochs inside exactly that window.  (The clean
+    half — certification of an unmutated run — already stands on every
+    tier-1 short scenario above, whose configs arm audit=true.)"""
+    from deneva_tpu.harness.chaos import run_scenario
+
+    report = run_scenario("audit-mutation", quick=True, quiet=True)
+    assert report["audit_ok"] is False
+    assert report["audit_witness_epochs"]
+    assert all(48 <= e < 56 for e in report["audit_witness_epochs"])
+    assert report["audit_anomaly"] in ("G-single", "G2-item")
+
+
 @pytest.mark.slow
 def test_chaos_kill_one_server_recovers_by_replay():
     """The full failover soak: fault_kill crashes server 1 at an epoch

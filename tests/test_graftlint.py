@@ -205,6 +205,27 @@ def test_metrics_gate_fires_on_unguarded_use():
         "\n".join(f.render() for f in findings)
 
 
+def test_audit_gate_fires_on_unguarded_use():
+    """The REAL ``audit`` GateSpec (runtime/gates.py) catches an
+    unguarded call into runtime/audit.py AND an unguarded call to the
+    declared device-derivation use_calls (cc/base's audit_observe
+    family), while accepting the guarded idioms the runtime uses
+    (``cfg.audit`` at construction, the exporter handle's ``is not
+    None`` check, ``cfg.audit_mutate`` around the seeded fault) — the
+    CI teeth behind the audit plane's default-off bit-identity
+    contract."""
+    from deneva_tpu.runtime.gates import GATES
+
+    root = os.path.join(FIX, "gate_bad_audit")
+    tree = Tree(root, ["."])
+    findings = tree.filter(gateconsistency.check(
+        tree, gates={"audit": GATES["audit"]}, exempt=(),
+        escrow_funcs=(), escrow_home=(),
+        config_module="deneva_tpu/config.py", guarded=(), model={}))
+    assert _got(findings) == _expected(root), \
+        "\n".join(f.render() for f in findings)
+
+
 def test_gate_registry_matches_config():
     """Executable half of gate-registry-drift: every registered flag is
     a real Config field defaulting OFF, every wiremodel gate names a

@@ -357,6 +357,55 @@ def test_parse_repair_forward_backward_compat(tmp_path):
     assert parse_file(str(old_log))["tput"] == 5
 
 
+def test_parse_audit_forward_backward_compat(tmp_path):
+    """[audit] lines (isolation-audit satellite): per-node export
+    accounting; old logs yield [], the new lines perturb no other
+    parser, and the [summary] audit_* fields (incl. the anti-inert
+    audit_edges_exported the regression gate reads) parse through the
+    standard summary path."""
+    from deneva_tpu.harness.parse import (parse_audit, parse_file,
+                                          parse_membership,
+                                          parse_metrics, parse_repair,
+                                          parse_replication)
+    from deneva_tpu.harness.timeline import parse_timeline
+
+    new_log = tmp_path / "audit.out"
+    new_log.write_text(
+        "# cfg node_cnt=2\n"
+        "[audit] node=0 epochs=412 edges=3180 edge_lanes=3991 "
+        "dropped=0 cadence=1 export_ms=41.7\n"
+        "[timeline] node=0 epoch=64 loop=1.0ms audit=0.3ms\n"
+        "[summary] total_runtime=2,tput=1800,txn_cnt=3600,"
+        "total_txn_commit_cnt=3600,audit_edge_cnt=3991,"
+        "audit_drop_cnt=0,audit_edges_exported=3180,"
+        "audit_epochs_exported=412,audit_edges_dropped=0\n")
+    rows = parse_audit(new_log.read_text().splitlines())
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["node"] == 0 and r["epochs"] == 412
+    assert r["edges"] == 3180 and r["dropped"] == 0
+    assert r["export_ms"] == 41.7
+    row = parse_file(str(new_log))
+    assert row["audit_edges_exported"] == 3180
+    assert row["audit_edges_dropped"] == 0
+    # other parsers ignore the new lines entirely
+    text = new_log.read_text().splitlines()
+    assert parse_membership(text) == []
+    assert parse_replication(text) == []
+    assert parse_repair(text) == []
+    assert parse_metrics(text) == []
+    assert len(parse_timeline(text)) == 1
+    # the "audit" timeline span lands on the declared tid-6 track
+    from deneva_tpu.harness.timeline import AUDIT_TRACK, SPAN_TRACK
+    assert SPAN_TRACK["audit"] is AUDIT_TRACK
+    assert AUDIT_TRACK.tid == 6
+    # old log: no audit lines -> [] and unchanged parsing
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_audit(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5
+
+
 def test_parse_fencing_forward_backward_compat(tmp_path):
     """[fencing] lines (partition-tolerance satellite): per-node
     suspicion/fence/heal accounting, including a fenced node's

@@ -124,8 +124,9 @@ def _rig(alg, scripts, rounds=2, cfg_kw=()):
 def _repair(rig, rounds=2):
     cfg, wl, be, db, q, batch, inc, v, st, stats = rig
     cfg = cfg.replace(repair_rounds=rounds)
-    db, st, v2, salvaged = run_repair(cfg, wl, be, db, q, batch, inc, v,
-                                      st, stats, v.commit)
+    db, st, v2, salvaged, _rounds = run_repair(cfg, wl, be, db, q,
+                                               batch, inc, v, st,
+                                               stats, v.commit)
     return db, v2, np.asarray(salvaged), stats
 
 
@@ -211,9 +212,10 @@ def test_timestamp_watermark_loser_restamps_and_salvages():
     # which is strictly above every committed watermark; the scripted
     # rig reuses low ts across "epochs", so supply the base explicitly
     # (20 > the epoch-1 writers' recorded wts)
-    db, st3, v2, salvaged = run_repair(cfg, wl, be, db, q, batch, inc, v,
-                                       st2, stats, v.commit,
-                                       ts_base=jnp.int32(20))
+    db, st3, v2, salvaged, _r = run_repair(cfg, wl, be, db, q, batch,
+                                           inc, v, st2, stats,
+                                           v.commit,
+                                           ts_base=jnp.int32(20))
     assert np.asarray(salvaged)[0], "watermark loser must salvage"
     assert int(stats["rep_frontier_cnt"]) >= 1     # the stale-read lane
     assert not np.asarray(v2.abort)[0]
@@ -226,9 +228,9 @@ def test_timestamp_watermark_loser_restamps_and_salvages():
     # salvage (conservative, never a wrong commit): stamp below the
     # watermark -> still aborted
     stats2 = init_device_stats()
-    _, _, v3, salv2 = run_repair(cfg, wl, be, db, q, batch, inc, v,
-                                 st2, stats2, v.commit,
-                                 ts_base=jnp.int32(2))
+    _, _, v3, salv2, _r2 = run_repair(cfg, wl, be, db, q, batch, inc,
+                                      v, st2, stats2, v.commit,
+                                      ts_base=jnp.int32(2))
     assert not np.asarray(salv2)[0]
     assert np.asarray(v3.abort)[0]
 

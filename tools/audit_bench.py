@@ -1,0 +1,148 @@
+"""Isolation-audit overhead bench: produce the results/audit pairs the
+regression gate checks (the telemetry_bench.py / metricsbus_bench.py
+pattern applied to the serializability certifier).
+
+For each preset, runs the SAME CI-sized open-loop cluster config with
+the audit plane off and armed at the default ``audit_cadence``
+(epoch-sampled certification — the shipping rate; chaos scenarios pin
+cadence=1 for full coverage), alternating arms
+``--repeat`` times, and writes:
+
+  results/audit/<preset>_off.out       median-tput off run
+  results/audit/<preset>_on.out        median-tput armed run
+  results/audit/<preset>_cert.txt      the armed median run's
+                                       serializability certificate
+                                       (harness/auditgraph.py render)
+
+The ``.out`` files carry the standard ``# cfg`` echo + the server-0 and
+client ``[summary]`` lines; ``tools/regression_gate.py check`` then
+enforces armed tput >= 98% of off AND audit_edges_exported > 0
+(anti-inert + anti-regression in one gate — see telemetry_violations
+there).  The preset is contended CALVIN on purpose: the forwarding
+executor's in-batch read forwarding produces real wr/rw edges every
+epoch, so an armed run that exports zero edges is provably inert.
+
+Usage:  python tools/audit_bench.py [--repeat 3]
+            [--out results/audit] [--preset ycsb_zipf09]
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deneva_tpu.config import CCAlg, Config, WorkloadKind  # noqa: E402
+from deneva_tpu.harness.parse import cfg_header  # noqa: E402
+from deneva_tpu.stats import parse_summary  # noqa: E402
+
+LOG_DIR = os.environ.get("AUDITBENCH_DIR", "/dev/shm/deneva_auditbench")
+
+# CI-sized preset (the metricsbus bench's open-loop shape: a pinned
+# offered load makes the pair reproducible to ~±0.1% where saturated
+# closed-loop tput swings ±10% on the contended 2-core box — the gate
+# question becomes "does the armed server HOLD the same offered load").
+PRESETS: dict[str, dict] = {
+    "ycsb_zipf09": dict(
+        workload=WorkloadKind.YCSB, cc_alg=CCAlg.CALVIN,
+        node_cnt=2, client_node_cnt=1, epoch_batch=1024,
+        conflict_buckets=512, synth_table_size=8192,
+        max_txn_in_flight=4096, req_per_query=4, max_accesses=4,
+        zipf_theta=0.9, warmup_secs=1.0, done_secs=4.0,
+        arrival_process="poisson", arrival_rate=45000.0,
+        logging=True, replica_cnt=1, log_dir=LOG_DIR),
+}
+
+
+def _run(cfg: Config, run_id: str) -> tuple[dict[str, dict], str]:
+    from deneva_tpu.runtime.launch import run_cluster
+    out = run_cluster(cfg, platform="cpu", run_id=run_id)
+    return ({f"{kind}{nid}": parse_summary(line)
+             for nid, (kind, line) in out.items() if line},
+            os.path.join(LOG_DIR, run_id))
+
+
+def _write_out(path: str, cfg: Config, rep: dict) -> None:
+    from deneva_tpu.stats import Stats
+    with open(path, "w") as f:
+        f.write(cfg_header(cfg))
+        for tag in ("client2", "server0"):
+            fields = rep.get(tag)
+            if fields is None:
+                continue
+            st = Stats()
+            for k, v in fields.items():
+                st.set(k, v)
+            f.write(st.summary_line() + "\n")
+
+
+def bench_preset(name: str, repeat: int, out_dir: str) -> None:
+    import numpy as np
+
+    base = Config(**PRESETS[name])
+    runs: dict[str, list[dict]] = {"off": [], "on": []}
+    on_dirs: list[str] = []
+    for r in range(repeat):
+        for arm in ("off", "on"):
+            cfg = base if arm == "off" else base.replace(audit=True)
+            rep, rdir = _run(cfg,
+                             f"auditbench_{name}_{arm}_{r}_{os.getpid()}")
+            if arm == "on":
+                on_dirs.append(rdir)
+            tput = rep["server0"]["tput"]
+            print(f"[audit_bench] {name} {arm} run {r}: "
+                  f"tput={tput:.0f}", flush=True)
+            runs[arm].append(rep)
+    os.makedirs(out_dir, exist_ok=True)
+    meds = {}
+    med_idx = {}
+    for arm in ("off", "on"):
+        tputs = [r["server0"]["tput"] for r in runs[arm]]
+        i = int(np.argsort(tputs)[len(tputs) // 2])
+        med_idx[arm] = i
+        meds[arm] = runs[arm][i]["server0"]["tput"]
+        cfg = base if arm == "off" else base.replace(audit=True)
+        _write_out(os.path.join(out_dir, f"{name}_{arm}.out"), cfg,
+                   runs[arm][i])
+    ratio = meds["on"] / max(meds["off"], 1e-9)
+    print(f"[audit_bench] {name}: off={meds['off']:.0f} "
+          f"on={meds['on']:.0f} ratio={ratio:.4f} "
+          f"(median of {repeat}; spread off="
+          f"{statistics.pstdev([r['server0']['tput'] for r in runs['off']]):.0f})",
+          flush=True)
+    # checked-in certificate sample: what the armed median run proved
+    from deneva_tpu.harness import auditgraph
+    cert = auditgraph.certify(on_dirs[med_idx["on"]])
+    with open(os.path.join(out_dir, f"{name}_cert.txt"), "w") as f:
+        f.write(f"# serializability certificate — preset {name}, "
+                f"default audit_cadence, CPU cluster 2s1c\n\n")
+        f.write(auditgraph.render(cert) + "\n")
+    print(f"[audit_bench] {name}: certificate ok={cert['ok']} "
+          f"epochs={cert['epochs']} edges={cert['edges_deduped']}",
+          flush=True)
+
+
+def main(argv: list[str]) -> int:
+    repeat = 3
+    out_dir = "results/audit"
+    names = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--repeat":
+            repeat = int(argv[i + 1]); i += 2
+        elif argv[i] == "--out":
+            out_dir = argv[i + 1]; i += 2
+        elif argv[i] == "--preset":
+            names.append(argv[i + 1]); i += 2
+        else:
+            print(f"unknown arg {argv[i]!r}", file=sys.stderr)
+            return 2
+    for name in (names or list(PRESETS)):
+        bench_preset(name, repeat, out_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -164,6 +164,58 @@ def prom_dump(rows: list[dict]) -> str:
     return "\n".join(out) + "\n"
 
 
+def load_audit_dir(path: str) -> dict[int, list[dict]]:
+    """{node: audit records} of a run directory's isolation-audit
+    sidecars (runtime/audit.py, ``audit=true``); {} when the plane is
+    off or ``path`` is a bare stream file.  The sidecar discovery/
+    parsing contract lives in ONE place — the certifier's loader."""
+    if not os.path.isdir(path):
+        return {}
+    from deneva_tpu.harness.auditgraph import load_audit
+    return load_audit(path)
+
+
+def render_audit(by_node: dict[int, list[dict]]) -> str:
+    """Latest per-node isolation-audit verdict: clean (zero dependency
+    edges so far), edges observed (serializability judged by the
+    offline certifier, harness/auditgraph.py), or export overflow."""
+    out = ["audit (isolation):",
+           f"{'node':>4} {'epoch':>7} {'epochs':>7} {'edges':>7} "
+           f"{'dropped':>8}  verdict"]
+    for node in sorted(by_node):
+        recs = by_node[node]
+        if not recs:
+            continue
+        last = recs[-1]
+        edges = sum(int(r.get("edge_cnt", 0)) for r in recs)
+        dropped = sum(int(r.get("dropped", 0)) for r in recs)
+        verdict = "clean" if edges == 0 else "edges-observed"
+        if dropped:
+            verdict = "export-overflow"
+        out.append(f"{node:>4} {int(last.get('epoch', -1)):>7} "
+                   f"{len(recs):>7} {edges:>7} {dropped:>8}  {verdict}")
+    return "\n".join(out)
+
+
+def prom_audit(by_node: dict[int, list[dict]]) -> str:
+    """Prometheus gauges for the audit plane (appended to prom_dump's
+    exposition when a run directory carries audit sidecars)."""
+    out: list[str] = []
+    for name, help_text, fn in (
+            ("audit_edges_total", "dependency edge lanes observed",
+             lambda recs: sum(int(r.get("edge_cnt", 0)) for r in recs)),
+            ("audit_epochs_total", "epochs exported by the audit plane",
+             len),
+            ("audit_dropped_total", "edges past the export cap",
+             lambda recs: sum(int(r.get("dropped", 0)) for r in recs))):
+        out.append(f"# HELP deneva_{name} {help_text}")
+        out.append(f"# TYPE deneva_{name} gauge")
+        for node in sorted(by_node):
+            out.append(f'deneva_{name}{{node="{node}"}} '
+                       f"{float(fn(by_node[node])):g}")
+    return "\n".join(out) + "\n"
+
+
 def resolve_stream(path: str) -> str:
     """Accept a stream file or a run directory (newest bus stream)."""
     if os.path.isdir(path):
@@ -199,9 +251,16 @@ def main(argv: list[str]) -> int:
     path = resolve_stream(pos[0])
     if "--prom" in argv:
         sys.stdout.write(prom_dump(read_metrics(path)))
+        aud = load_audit_dir(pos[0])
+        if aud:
+            sys.stdout.write(prom_audit(aud))
         return 0
     if "--once" in argv:
         print(render_table(read_metrics(path)))
+        aud = load_audit_dir(pos[0])
+        if aud:
+            print()
+            print(render_audit(aud))
         return 0
     try:
         while True:
@@ -210,6 +269,10 @@ def main(argv: list[str]) -> int:
             print(f"metrics bus  {path}  "
                   f"({len(rows)} records, ^C to quit)\n")
             print(render_table(rows))
+            aud = load_audit_dir(pos[0])
+            if aud:
+                print()
+                print(render_audit(aud))
             sys.stdout.flush()
             time.sleep(interval)
     except KeyboardInterrupt:

@@ -56,9 +56,14 @@ RUNTIME_SLACK_SECS = 2.0
 # anti-inert field (a gate that passes with the instrument dead proves
 # nothing).  tools/telemetry_bench.py writes the telemetry pairs
 # (flight recorder at telemetry_sample=1024); tools/metricsbus_bench.py
-# the metricsbus pairs (live bus at metrics_cadence=1).
+# the metricsbus pairs (live bus at metrics_cadence=1);
+# tools/audit_bench.py the audit pairs (serializability certifier at
+# audit_cadence=1 — its anti-inert field additionally requires
+# audit_edges_dropped == 0, an incomplete certificate being as dead as
+# an inert one).
 TELEMETRY_DIR = "results/telemetry"
 METRICSBUS_DIR = "results/metricsbus"
+AUDIT_DIR = "results/audit"
 TELEMETRY_TOLERANCE = 0.02
 
 
@@ -134,13 +139,15 @@ def _pair_violations(pair_dir: str, label: str, inert_field: str,
 
 def telemetry_violations() -> list[str]:
     """Anti-inert + anti-regression over every committed instrument
-    pair family (flight recorder + metrics bus).  The dirs resolve at
-    call time so tests can repoint them."""
+    pair family (flight recorder + metrics bus + isolation audit).
+    The dirs resolve at call time so tests can repoint them."""
     pairs = (
         # (dir, label, anti-inert field, zero-required field or None)
         (TELEMETRY_DIR, "telemetry", "tel_sampled_cnt",
          "tel_dropped_cnt"),
         (METRICSBUS_DIR, "metricsbus", "mb_frames_sent", None),
+        (AUDIT_DIR, "audit", "audit_edges_exported",
+         "audit_edges_dropped"),
     )
     out: list[str] = []
     for pair_dir, label, inert_field, zero_field in pairs:

@@ -47,6 +47,17 @@
 #                                         names the stalled node, the
 #                                         recovered aggregator resumes
 #                                         the metrics_bus stream)
+#   tools/smoke.sh audit                  isolation-audit gate:
+#                                         audit-off bit-identity tests
+#                                         (no sidecar, pre-audit group
+#                                         arity, armed==off row state)
+#                                         + the audit-clean /
+#                                         audit-mutation chaos pair
+#                                         (contended OCC certifies
+#                                         serializable; the seeded
+#                                         occ-read-skip mutation is
+#                                         REJECTED with a cycle witness
+#                                         naming the mutated epoch)
 #   tools/smoke.sh repair                 transaction-repair gate:
 #                                         repair-contention (zipf-0.9
 #                                         write-heavy OCC with repair on +
@@ -119,6 +130,16 @@ case "$SCEN" in
   repair)
     T="${SMOKE_TIMEOUT_SECS:-${REPAIR_TIMEOUT_SECS:-600}}"
     run "$T" python -m deneva_tpu.harness.chaos repair-contention --quick
+    ;;
+  audit)
+    # off-pin first (fast, loopback + in-process engine), then the
+    # certify-clean / catch-the-mutation chaos pair
+    T="${SMOKE_TIMEOUT_SECS:-${AUDIT_TIMEOUT_SECS:-600}}"
+    run "$T" python -m pytest \
+        "tests/test_audit.py::test_audit_off_group_outputs" \
+        "tests/test_audit.py::test_audit_observation_only_row_state" \
+        -q -p no:cacheprovider
+    run "$T" python -m deneva_tpu.harness.chaos audit --quick
     ;;
   monitor)
     # off-pin first (fast, loopback); then the gray-slow + aggregator-
