@@ -312,7 +312,7 @@ def audit_init(cfg):
 
 
 def audit_observe(cfg, batch: AccessBatch, committed, order, lvl,
-                  order_vis: bool, stamps, epoch):
+                  order_vis: bool, stamps, epoch, cadence=None):
     """Per-epoch committed-txn dependency observations, derived ON
     DEVICE from the planned access sets under the backend's visibility
     rule — the isolation audit plane's measurement half.  Epochs off
@@ -360,10 +360,20 @@ def audit_observe(cfg, batch: AccessBatch, committed, order, lvl,
     cross-check)."""
     import jax.numpy as jnp
 
-    cadence = max(1, cfg.audit_cadence)
-    if cadence == 1:
-        return _audit_observe_impl(cfg, batch, committed, order, lvl,
-                                   order_vis, stamps, epoch)
+    if cadence is None:
+        # static cadence from config (the pre-ctrl path, bit-exact)
+        cad_static = max(1, cfg.audit_cadence)
+        if cad_static == 1:
+            return _audit_observe_impl(cfg, batch, committed, order, lvl,
+                                       order_vis, stamps, epoch)
+        due = jnp.asarray(epoch, jnp.int32) % cad_static == 0
+    else:
+        # traced cadence (the ctrl plane's audit-density knob): the
+        # due predicate is data, so the lax.cond is always compiled —
+        # value cadence==1 makes every epoch due, same observations as
+        # the direct call above
+        cad = jnp.maximum(jnp.asarray(cadence, jnp.int32), 1)
+        due = jnp.asarray(epoch, jnp.int32) % cad == 0
     e_max = cfg.audit_edges_max
 
     def live(_):
@@ -376,7 +386,6 @@ def audit_observe(cfg, batch: AccessBatch, committed, order, lvl,
                 jnp.full((e_max,), -1, jnp.int32), z, z,
                 jnp.zeros((), jnp.uint32), jnp.zeros((), jnp.uint32))
 
-    due = jnp.asarray(epoch, jnp.int32) % cadence == 0
     return jax.lax.cond(due, live, skip, None)
 
 

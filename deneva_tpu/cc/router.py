@@ -1,0 +1,170 @@
+"""Contention-adaptive CC router: per-partition backend + granularity
+knobs for the epoch program (``Config.ctrl``, PR 16 tentpole).
+
+The source paper's core result is a *static* frontier: no single CC
+algorithm wins every contention regime (Harding et al., VLDB 2017
+figs. 6-9).  The router makes the choice dynamic — per partition, per
+epoch boundary — while keeping every contract the epoch programs
+already guarantee:
+
+* **Knobs ride beside the state, not in it.**  ``RouterKnobs`` is a
+  small traced pytree passed as an extra argument to the jitted scan
+  (`engine/step.Engine.jit_run_ctrl`), so changing a knob VALUE between
+  chunks never recompiles and never perturbs the EngineState pytree
+  (checkpoints, digests and the ctrl-off path are untouched).
+
+* **One shared conflict derivation.**  All three candidate backends
+  (NO_WAIT / OCC / TPU_BATCH) are stateless and mask inactive txns
+  through their edge derivations, so a single (optionally coarsened)
+  incidence serves every branch — the property that makes per-partition
+  *mixed* assignment sound: validate each backend's sub-batch against
+  the SAME bucket space and defer the cross-group conflict surface
+  symmetrically (`cross_group_defer`; merging only ever ADDS defers,
+  the usual over-approximation direction).
+
+* **Granularity is incidence-only.**  ``coarsen_keys`` right-shifts the
+  conflict-derivation key per access by its owner partition's
+  ``gshift`` — merging keys can only ADD conflicts (a sound
+  over-approximation, the coarse end of the OCC timestamp-granularity
+  trade; PAPERS: arXiv:1811.04967) — while planning, execution, audit
+  and density owners all keep the exact keys.  ``gshift=0`` reproduces
+  the static incidence bit for bit.
+
+Decision-making lives in `runtime/controller.py`; this module is the
+*mechanism* half (pure device functions + the knob pytree).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deneva_tpu.cc.base import AccessBatch
+from deneva_tpu.config import CCAlg, Config
+
+# branch indices of the routed `lax.switch` (engine/step.py): the three
+# uniform single-backend branches, then the mixed-assignment branch
+CANDIDATES: tuple[CCAlg, ...] = (CCAlg.NO_WAIT, CCAlg.OCC, CCAlg.TPU_BATCH)
+MIXED = len(CANDIDATES)
+
+
+def candidate_index(alg: CCAlg | str) -> int:
+    """Branch index of a candidate backend (raises on a non-candidate —
+    config.validate pins cc_alg to the candidate set under ctrl)."""
+    return CANDIDATES.index(CCAlg(alg))
+
+
+@dataclass
+class RouterKnobs:
+    """One epoch-boundary decision, as traced device operands.
+
+    assign   — int32[P] per-partition backend (index into CANDIDATES)
+    gshift   — int32[P] per-partition incidence-key coarsening (bits)
+    repair_cap — int32[] live repair sub-rounds (<= cfg.repair_rounds;
+                 the statically unrolled rounds past the cap skip via
+                 lax.cond — real compute saved, not just masked)
+    audit_cadence — int32[] live audit cadence (epochs between audited
+                 epochs; density of the witness stream)
+    """
+
+    assign: jax.Array
+    gshift: jax.Array
+    repair_cap: jax.Array
+    audit_cadence: jax.Array
+
+
+jax.tree_util.register_dataclass(
+    RouterKnobs,
+    data_fields=["assign", "gshift", "repair_cap", "audit_cadence"],
+    meta_fields=[])
+
+
+def static_knobs(cfg: Config) -> RouterKnobs:
+    """The knob vector equal to the static config — the governor's
+    fail-safe assignment and the ctrl-off-equivalence pin (routing with
+    these values is value-identical to the unrouted epoch program)."""
+    p = max(cfg.part_cnt, 1)
+    return RouterKnobs(
+        assign=jnp.full((p,), candidate_index(cfg.cc_alg), jnp.int32),
+        gshift=jnp.zeros((p,), jnp.int32),
+        repair_cap=jnp.asarray(cfg.repair_rounds, jnp.int32),
+        audit_cadence=jnp.asarray(max(1, cfg.audit_cadence), jnp.int32))
+
+
+def knobs_from_decision(cfg: Config, assign, gshift, repair_cap,
+                        audit_cadence) -> RouterKnobs:
+    """Host-side decision -> device knob pytree (the controller's
+    actuation boundary; plain lists/ints in, traced operands out)."""
+    return RouterKnobs(
+        assign=jnp.asarray(assign, jnp.int32),
+        gshift=jnp.asarray(gshift, jnp.int32),
+        repair_cap=jnp.asarray(repair_cap, jnp.int32),
+        audit_cadence=jnp.asarray(max(1, int(audit_cadence)), jnp.int32))
+
+
+def coarsen_keys(batch: AccessBatch, owner, gshift) -> AccessBatch:
+    """Conflict-derivation view of the batch with per-access keys
+    coarsened by the owner partition's ``gshift`` bits.  Only the
+    incidence builder and the validates consume this view; execution,
+    audit and repair re-reads keep the exact-key batch.  Soundness:
+    two keys that collide after the shift simply share a conflict
+    bucket — the same over-approximation a narrower conflict_buckets
+    hash already makes — so coarsening can only ADD conflict edges,
+    never hide one.  ``gshift=0`` is the identity (bit-identical
+    incidence)."""
+    sh = jnp.take(gshift, jnp.clip(owner, 0, gshift.shape[0] - 1))
+    return dataclasses.replace(
+        batch, keys=jax.lax.shift_right_logical(batch.keys, sh))
+
+
+def txn_backend(knobs: RouterKnobs, owner) -> jax.Array:
+    """int32[B] backend index per txn: its HOME partition's assignment
+    (the partition of its first planned access — the same anchor the
+    VOTE protocol routes coordinators on)."""
+    home = owner[:, 0]
+    return jnp.take(knobs.assign,
+                    jnp.clip(home, 0, knobs.assign.shape[0] - 1))
+
+
+def cross_group_defer(inc, batch: AccessBatch, group) -> jax.Array:
+    """bool[B] txns whose conflict surface crosses backend groups —
+    deferred SYMMETRICALLY (both sides) in mixed-assignment epochs, so
+    each backend validates a sub-batch whose conflicts are wholly its
+    own and the merged committed set needs no cross-group ordering.
+
+    Derivation from the family-1 incidence column masses: a txn
+    conflicts across groups iff one of its access buckets is written by
+    another group (``u · other_w``) or one of its written buckets is
+    touched by another group (``w · other_u``).  Column masses
+    accumulate in f32 (bf16 incidence holds exact small counts; the
+    einsum keeps the [B,K] operand in bf16 and only the [K] masses in
+    f32).  Bucket-space over-approximation as everywhere: a collision
+    can only ADD a defer, never hide a real cross-group conflict."""
+    u1 = inc.u1
+    w1 = inc.w1
+    act = batch.active.astype(jnp.float32)
+    n_groups = MIXED
+    conf = jnp.zeros(batch.active.shape, jnp.float32)
+    # total column masses once, per-group masses by masked einsum
+    tot_w = jnp.einsum("bk,b->k", w1, act,
+                       preferred_element_type=jnp.float32)
+    tot_u = jnp.einsum("bk,b->k", u1, act,
+                       preferred_element_type=jnp.float32)
+    for g in range(n_groups):
+        m = (act * (group == g)).astype(jnp.float32)
+        oth_w = tot_w - jnp.einsum("bk,b->k", w1, m,
+                                   preferred_element_type=jnp.float32)
+        oth_u = tot_u - jnp.einsum("bk,b->k", u1, m,
+                                   preferred_element_type=jnp.float32)
+        # my accesses vs other groups' writes + my writes vs other
+        # groups' accesses (0.5 threshold absorbs bf16 rounding, same
+        # margin as cc/base.conflict_density)
+        c_g = (jnp.einsum("bk,k->b", u1, oth_w,
+                          preferred_element_type=jnp.float32)
+               + jnp.einsum("bk,k->b", w1, oth_u,
+                            preferred_element_type=jnp.float32))
+        conf = jnp.where(group == g, c_g, conf)
+    return batch.active & (conf > 0.5)

@@ -573,6 +573,16 @@ class Config:
     #                                    > 0 for the flash process)
     arrival_flash_factor: float = 10.0  # flash: rate multiplier inside
     #                                     the burst window
+    zipf_shift: str = ""           # mid-run contention shift "THETA:AT_S":
+    #                                the client pre-generates a SECOND
+    #                                seeded query ring at zipf theta=THETA
+    #                                and swaps to it AT_S seconds after its
+    #                                run start — the load-shift stimulus
+    #                                the ctrl chaos scenario drives (zipf
+    #                                0 -> 0.9 mid-run).  "" (default) =
+    #                                off: no second ring is ever built and
+    #                                the send path is untouched.  YCSB
+    #                                only (theta is a YCSB knob).
     tenant_cnt: int = 1            # tenants sharing the cluster; each
     #                                query carries its tenant id in tag
     #                                bits 24..31 (<= 256 tenants), so the
@@ -748,6 +758,68 @@ class Config:
     #                                with a cycle witness naming an epoch
     #                                in the window.  Test/chaos use only.
 
+    # ---- self-driving control plane (contention-adaptive CC router +
+    # closed-loop degradation governors; runtime/controller.py +
+    # cc/router.py).  Default OFF: with ctrl=False no controller is ever
+    # constructed, the engine compiles the exact pre-router epoch
+    # program, no [ctrl] line prints, and every log/wire/digest byte is
+    # bit-identical to the pre-ctrl runtime (the same contract as
+    # chaos/elastic/geo/overload/repair/fencing/telemetry/metrics/
+    # audit). ----
+    ctrl: bool = False             # arm the control plane: a
+    #                                deterministic feedback controller
+    #                                consumes epoch e-1's per-partition
+    #                                conflict density (cc/base.
+    #                                conflict_density via the metrics
+    #                                plane) plus the repair/admission/
+    #                                audit counters and sets, at epoch
+    #                                boundaries: per-partition CC backend
+    #                                (NO_WAIT/OCC/TPU_BATCH) + conflict-
+    #                                bucket granularity (in-process
+    #                                engine), repair-round budget, audit
+    #                                cadence, and admission quota scale
+    #                                (cluster servers).  Every decision
+    #                                is recorded as a [ctrl] line so
+    #                                replay reproduces the sequence
+    #                                bit-for-bit, and a fail-safe
+    #                                governor reverts every knob to the
+    #                                static config when signals go stale
+    #                                (aggregator death / partition /
+    #                                fenced node) and re-engages on heal.
+    ctrl_lo: float = 0.02          # hysteresis band floor: per-epoch
+    #                                contended access lanes per batch row
+    #                                below which a partition classes as
+    #                                SPARSE (depth knob, live default)
+    ctrl_hi: float = 0.20          # band ceiling: lanes per row above
+    #                                which a partition classes as HOT;
+    #                                between lo and hi the class HOLDS
+    #                                (the hysteresis dead band)
+    ctrl_confirm: int = 2          # consecutive boundary ticks a new
+    #                                class must persist before any knob
+    #                                moves (oscillation damper #1)
+    ctrl_cooldown: int = 4         # boundary ticks a knob stays put
+    #                                after it moved (oscillation damper
+    #                                #2; per knob, not global)
+    ctrl_stale_s: float = 2.0      # governor staleness bound: a
+    #                                boundary gap (or density silence)
+    #                                beyond this wall-clock budget trips
+    #                                the fail-safe revert to the static
+    #                                config
+    ctrl_heal: int = 3             # consecutive healthy ticks before a
+    #                                tripped governor re-engages the
+    #                                adaptive knobs
+    ctrl_gshift: int = 2           # conflict-granularity coarsening for
+    #                                SPARSE partitions: incidence keys
+    #                                shift right this many bits (merging
+    #                                keys only ADDS conflicts — a sound
+    #                                over-approximation that shrinks the
+    #                                false-sharing surface the OCC-
+    #                                granularity paper prices); 0 =
+    #                                granularity knob inert
+    ctrl_scale_max: int = 4        # max admission quota-scale steps the
+    #                                cluster governor may shed (effective
+    #                                quota = tenant_quota * 0.8^step)
+
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
     checkpoint_path: str = ""      # "" = checkpointing off
@@ -892,6 +964,27 @@ class Config:
         _check(start >= 0 and count >= 1,
                "audit_mutate needs START >= 0 and COUNT >= 1")
         return parts[0], start, count
+
+    def zipf_shift_spec(self) -> tuple[float, float] | None:
+        """Parse zipf_shift 'THETA:AT_S' into (theta, at_s); None when
+        unset."""
+        if not self.zipf_shift:
+            return None
+        parts = self.zipf_shift.split(":")
+        if len(parts) != 2:
+            raise ValueError(
+                f"config: zipf_shift {self.zipf_shift!r} must be "
+                "'THETA:AT_S' (target zipf theta, shift time in seconds "
+                "after run start)")
+        try:
+            theta, at_s = float(parts[0]), float(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"config: zipf_shift {self.zipf_shift!r}: THETA/AT_S "
+                "must be numbers")
+        _check(0.0 <= theta < 2.0 and at_s > 0,
+               "zipf_shift needs THETA in [0, 2) and AT_S > 0")
+        return theta, at_s
 
     def elastic_plan_spec(self) -> tuple[str, int, int] | None:
         """Parse elastic_plan 'grow|drain:node:epoch' (None when unset)."""
@@ -1154,6 +1247,11 @@ class Config:
         else:
             _check(self.arrival_rate == 0.0,
                    "arrival_rate needs an arrival_process")
+        if self.zipf_shift:
+            self.zipf_shift_spec()      # raises on a malformed spec
+            _check(self.workload == WorkloadKind.YCSB,
+                   "zipf_shift shifts the YCSB zipf theta mid-run; other "
+                   "workloads have no theta to shift")
         _check(1 <= self.tenant_cnt <= 256,
                "tenant_cnt must be in [1, 256] (tenant ids ride tag "
                "bits 24..31)")
@@ -1273,6 +1371,50 @@ class Config:
                        "repair sub-rounds are part of the replicated "
                        "deterministic verdict, which the VOTE protocol's "
                        "partitioned local validation cannot express")
+        # ---- control plane gating (same discipline: the default takes
+        # the pre-ctrl paths exactly; lo/hi/confirm/cooldown/stale/heal/
+        # gshift/scale_max are depth knobs with live defaults) ----
+        _check(0.0 <= self.ctrl_lo < self.ctrl_hi,
+               "ctrl hysteresis band needs 0 <= ctrl_lo < ctrl_hi")
+        _check(self.ctrl_confirm >= 1 and self.ctrl_cooldown >= 0
+               and self.ctrl_heal >= 1,
+               "ctrl_confirm/ctrl_heal must be >= 1, ctrl_cooldown >= 0")
+        _check(self.ctrl_stale_s > 0, "ctrl_stale_s must be > 0")
+        _check(0 <= self.ctrl_gshift <= 16,
+               "ctrl_gshift must be in [0, 16] (key bits to coarsen)")
+        _check(0 <= self.ctrl_scale_max <= 16,
+               "ctrl_scale_max must be in [0, 16] quota-scale steps")
+        if self.ctrl:
+            _check(self.metrics,
+                   "ctrl consumes the conflict-density signal: needs "
+                   "--metrics=true (the PR 14 observability plane)")
+            _check(self.mode == Mode.NORMAL,
+                   "ctrl adapts executed-state knobs; degraded modes "
+                   "(SIMPLE/NOCC/QRY_ONLY) have nothing to adapt")
+            _check(self.cc_alg in (CCAlg.NO_WAIT, CCAlg.OCC,
+                                   CCAlg.TPU_BATCH),
+                   "ctrl routes between NO_WAIT/OCC/TPU_BATCH; the "
+                   "static cc_alg must be one of the three candidates "
+                   "(it is the governor's fail-safe assignment)")
+            _check(self.device_parts == 1,
+                   "the ctrl router's branched epoch program is "
+                   "single-device (multi-chip plans are built per-shard "
+                   "inside shard_map)")
+            _check(not self.ycsb_abort_mode,
+                   "ctrl does not compose with the ycsb_abort_mode "
+                   "sentinel (the forced-abort mask is backend-path "
+                   "specific)")
+            _check(not self.audit_mutate,
+                   "ctrl does not compose with audit_mutate (the "
+                   "seeded fault targets the static OCC path)")
+            _check(not self.escrow_order_free,
+                   "ctrl does not compose with escrow ordering "
+                   "exemptions yet (the router's cross-backend batch "
+                   "carries one shared conflict derivation)")
+            if self.node_cnt > 1:
+                _check(self.admission,
+                       "cluster ctrl actuates admission quota scaling: "
+                       "needs --admission=true")
         if self.fencing and self.fault_peer_stall:
             # the gray-slow node ends up fenced and retired in place —
             # same coordinator constraint as the elastic kill below

@@ -76,6 +76,25 @@ def run_simulation(cfg: Config, chunk: int = 50,
     else:
         run_n = eng.jit_run
 
+    ctl = None
+    if cfg.ctrl:
+        # self-driving control plane (runtime/controller.py): the
+        # routed scan replaces jit_run; each chunk boundary folds the
+        # device counter deltas into one deterministic decision tick
+        # and re-arms the knob pytree for the NEXT chunk (values only —
+        # the compile is shared).  config.validate pins ctrl to the
+        # single-device metrics-on shape, so this arm never races the
+        # multi-chip placement above.
+        from deneva_tpu.cc.router import knobs_from_decision, static_knobs
+        from deneva_tpu.runtime.controller import (Controller, CtrlSignals,
+                                                   ctrl_line)
+        ctl = Controller(cfg)
+        knobs = [static_knobs(cfg)]
+        ctrl_prev = [None]          # baseline counter snapshot
+
+        def run_n(state, n):
+            return eng.jit_run_ctrl(state, knobs[0], n)
+
     ckpt_bound = cfg.checkpoint_every_epochs \
         if cfg.checkpoint_path and cfg.checkpoint_every_epochs else 0
     ckpt_due = [cfg.checkpoint_every_epochs]
@@ -116,12 +135,42 @@ def run_simulation(cfg: Config, chunk: int = 50,
     chunk_log: list[tuple[int, float, np.ndarray]] = []
     last_t = [time.monotonic()]
 
+    def _ctrl_tick(state):
+        """One controller decision per chunk boundary: diff the device
+        counters against the previous tick's snapshot, decide, re-arm.
+        The first call only establishes the baseline (the pre-baseline
+        chunks run on `static_knobs`, i.e. the unrouted values)."""
+        dens, fb, sv, wit = jax.device_get(
+            (state.stats["conflict_density"],
+             state.stats["rep_fallback_cnt"],
+             state.stats["rep_salvaged_cnt"],
+             state.stats["audit_edge_cnt"]))
+        now = time.monotonic()
+        cur = (np.asarray(dens).astype(np.int64), int(fb), int(sv),
+               int(wit), epochs_total[0], now)
+        prev, ctrl_prev[0] = ctrl_prev[0], cur
+        if prev is None:
+            return
+        sig = CtrlSignals(
+            epoch=epochs_total[0], epochs=cur[4] - prev[4],
+            dens=[int(x) for x in cur[0] - prev[0]],
+            fallback=cur[1] - prev[1], salvaged=cur[2] - prev[2],
+            witnesses=cur[3] - prev[3], breaches=0,
+            gap_us=int((now - prev[5]) * 1e6))
+        dec = ctl.decide(sig)
+        knobs[0] = knobs_from_decision(cfg, dec.assign, dec.gshift,
+                                       dec.repair_cap, dec.audit_cadence)
+        if not quiet:
+            print(ctrl_line(0, sig, dec), flush=True)
+
     def _after_chunk(state):
         """Shared per-chunk bookkeeping: pacing sync + wrap guard +
         overflow fail-fast + progress + checkpoint cadence."""
         _, head, hist, ovf = _sync(state)
         _guard_seq(head)
         _guard_overflow(ovf)
+        if ctl is not None:
+            _ctrl_tick(state)
         now = time.monotonic()
         chunk_log.append((chunk, now - last_t[0], hist))
         epochs_total[0] += chunk
@@ -246,6 +295,13 @@ def run_simulation(cfg: Config, chunk: int = 50,
         # only when armed so the default summary line is byte-identical.
         for k in ("audit_edge_cnt", "audit_drop_cnt"):
             st.set(k, float(after[k] - before[k]))
+    if cfg.ctrl:
+        # control plane ([summary] satellite): decision ticks taken and
+        # governor trips over the whole run (the per-tick record is the
+        # [ctrl] line stream).  Emitted only when armed so the default
+        # summary line is byte-identical.
+        st.set("ctrl_decisions", float(ctl.seq))
+        st.set("ctrl_trips", float(ctl.stale_trips))
     for i, nm in enumerate(getattr(wl, "txn_type_names", ())):
         for fam in ("commit", "abort"):
             key = f"{fam}_by_type"

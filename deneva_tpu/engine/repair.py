@@ -98,7 +98,8 @@ def repair_ts(batch, ts_base=None):
 
 
 def run_repair(cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
-               stats, exec_commit, forced=None, ts_base=None):
+               stats, exec_commit, forced=None, ts_base=None,
+               rounds_cap=None):
     """Run ``cfg.repair_rounds`` fused repair sub-rounds over the epoch's
     losers, inside the SAME jitted epoch program as the main round.
 
@@ -120,7 +121,16 @@ def run_repair(cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
     read lanes observed across sub-rounds.
 
     ``forced`` (the ycsb_abort_mode sentinel) txns are logical aborts —
-    final answers, never salvaged."""
+    final answers, never salvaged.
+
+    ``rounds_cap`` (the ctrl plane's repair-budget knob, int32 traced
+    scalar): statically-unrolled rounds at index >= cap skip their
+    whole body via ``lax.cond`` — real compute saved at low fallback
+    rates, not just masked lanes.  None (default) compiles the exact
+    pre-ctrl graph; cap == cfg.repair_rounds is value-identical to it
+    (every cond takes the live branch)."""
+    import jax
+
     losers = verdict.abort & batch.active
     if forced is not None:
         losers = losers & ~forced
@@ -128,11 +138,14 @@ def run_repair(cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
     salvaged = jnp.zeros_like(losers)
     rounds = jnp.zeros_like(batch.rank)
     fresh = repair_ts(batch, ts_base)
-    for rnd in range(cfg.repair_rounds):
+    frontier_cnt = stats["rep_frontier_cnt"]
+
+    def one_round(rnd, carry):
+        db, cc_state, committed, losers, salvaged, rounds, fcnt, \
+            stats_r = carry
         frontier = be.repair_rule(cfg, cc_state, batch, inc, committed,
                                   losers)
-        stats["rep_frontier_cnt"] = stats["rep_frontier_cnt"] \
-            + frontier.sum(dtype=jnp.uint32)
+        fcnt = fcnt + frontier.sum(dtype=jnp.uint32)
         rb = dataclasses.replace(batch, active=losers)
         if be.fresh_ts_on_restart:
             # restamp like the retry path would — but NOW, not an epoch
@@ -146,13 +159,33 @@ def run_repair(cfg, wl, be, db, queries, batch, inc, verdict, cc_state,
         # workload's pure re-execution closure against CURRENT state
         # (which includes every prior wave's writes — the chained
         # sub-round dataflow)
-        db = wl.re_execute(db, queries, rep, rv.order, stats)
+        stats_r = dict(stats_r)
+        db = wl.re_execute(db, queries, rep, rv.order, stats_r)
         salvaged = salvaged | rep
         rounds = jnp.where(rep, jnp.int32(rnd + 1), rounds)
         committed = committed | rep
         # the sub-round's own aborts/defers (still-conflicting losers)
         # chain into the next pass; leftovers past the budget fall back
         losers = losers & ~rep
+        return (db, cc_state, committed, losers, salvaged, rounds,
+                fcnt, stats_r)
+
+    carry = (db, cc_state, committed, losers, salvaged, rounds,
+             frontier_cnt, stats)
+    for rnd in range(cfg.repair_rounds):
+        if rounds_cap is None:
+            carry = one_round(rnd, carry)
+        else:
+            carry = jax.lax.cond(
+                jnp.int32(rnd) < rounds_cap,
+                lambda c, r=rnd: one_round(r, c), lambda c: c, carry)
+    (db, cc_state, committed, losers, salvaged, rounds, frontier_cnt,
+     stats_out) = carry
+    # write back through the CALLER'S dict (run_repair's contract is
+    # in-place stats mutation, like wl.execute's)
+    for k, v in stats_out.items():
+        stats[k] = v
+    stats["rep_frontier_cnt"] = frontier_cnt
     stats["rep_salvaged_cnt"] = stats["rep_salvaged_cnt"] \
         + salvaged.sum(dtype=jnp.uint32)
     stats["rep_fallback_cnt"] = stats["rep_fallback_cnt"] \

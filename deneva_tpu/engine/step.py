@@ -187,7 +187,14 @@ class Engine:
                                     max(cfg.part_cnt, 1)))
 
     # ------------------------------------------------------------------
-    def step(self, state: EngineState) -> EngineState:
+    def step(self, state: EngineState, knobs=None) -> EngineState:
+        if knobs is not None:
+            # contention-adaptive router (Config.ctrl, cc/router.py):
+            # the controller's per-epoch knob pytree selects the CC
+            # branch + incidence granularity per partition.  knobs=None
+            # (the default, and the only path when ctrl is off) is this
+            # exact pre-ctrl body, untouched.
+            return self._routed_step(state, knobs)
         cfg, wl, be = self.cfg, self.workload, self.backend
         rng, gen_key = jax.random.split(state.rng)
         stats = dict(state.stats)
@@ -423,6 +430,263 @@ class Engine:
                            epoch=state.epoch + 1, stats=stats)
 
     # ------------------------------------------------------------------
+    def _routed_step(self, state: EngineState, knobs) -> EngineState:
+        """One epoch under the contention-adaptive router (PR 16
+        tentpole; only reachable through ``step(state, knobs)`` with a
+        non-None ``RouterKnobs``, which config.validate arms only under
+        ``ctrl`` — metrics on, Mode.NORMAL, single device, candidate
+        cc_alg, no forced-abort/audit-mutate/escrow special paths).
+
+        Sections 1-3 (admit/select/plan) and section 6 (pool update +
+        counters) are the static step's, shared OUTSIDE the routed
+        switch.  Section 4-5 becomes a 4-way ``lax.switch``: one branch
+        per uniform candidate backend — each replicating the static
+        step's exact validate/execute/repair/audit dataflow for that
+        backend — plus a mixed-assignment branch that validates each
+        backend's sub-batch against the shared (coarsened) incidence
+        and defers the cross-group conflict surface symmetrically
+        (`cc/router.cross_group_defer`).  With ``static_knobs(cfg)``
+        every epoch takes the uniform branch of ``cfg.cc_alg`` with
+        gshift=0 / cap=repair_rounds / cadence=cfg.audit_cadence, and
+        the outputs are value-identical to the unrouted step (pinned by
+        tests/test_ctrl.py).
+
+        Branch contract: each returns ``(db, stats, exec_commit,
+        release, abort, defer)`` with identical pytree structure (every
+        stats key pre-exists in `init_device_stats`), so the switch is
+        shape-stable and knob VALUES never recompile.
+        """
+        from deneva_tpu.cc import Verdict
+        from deneva_tpu.cc.router import (CANDIDATES, MIXED, coarsen_keys,
+                                          cross_group_defer, txn_backend)
+        cfg, wl = self.cfg, self.workload
+        rng, gen_key = jax.random.split(state.rng)
+        stats = dict(state.stats)
+
+        # 1. admit fresh queries (identical to the static step)
+        newq = wl.generate(gen_key, self.pool.g)
+        pool, admitted = self.pool.refill(state.pool, newq, state.epoch)
+        stats["generated_cnt"] += jnp.uint32(self.pool.g)
+        stats["admitted_cnt"] += admitted.astype(jnp.uint32)
+
+        # 2. select epoch batch
+        slots, active, queries = self.pool.select(pool, state.epoch)
+        sel = (lambda v: v) if self.pool.full_pool \
+            else (lambda v: jnp.take(v, slots))
+
+        # 3. plan RW-sets (exact keys; the router only ever coarsens
+        # the conflict-derivation VIEW below)
+        planned = wl.plan(state.db, queries)
+        batch = AccessBatch(
+            table_ids=planned["table_ids"], keys=planned["keys"],
+            is_read=planned["is_read"], is_write=planned["is_write"],
+            valid=planned["valid"],
+            ts=sel(pool.ts), rank=sel(pool.seq),
+            active=active,
+            order_free=gate_order_free(cfg, self.backend,
+                                       planned.get("order_free")))
+
+        # router views: owner partitions anchor both the per-partition
+        # knob lookups and the density fold (same fallback hash as the
+        # static metrics block); cbatch carries the per-partition
+        # coarsened conflict keys (gshift=0 -> bit-identical to batch)
+        owner = planned.get("owner",
+                            batch.keys % jnp.int32(max(cfg.part_cnt, 1)))
+        cbatch = coarsen_keys(batch, owner, knobs.gshift)
+        group = txn_backend(knobs, owner)
+        backends = [get_backend(a) for a in CANDIDATES]
+
+        def density_into(st, inc):
+            st["conflict_density"] = st["conflict_density"] + \
+                conflict_density(cfg, cbatch, owner, inc).astype(jnp.uint32)
+
+        def audit_into(db, st, exec_commit, order, lvl, order_vis):
+            # static step's 5c with the cadence knob as a traced operand
+            if not cfg.audit:
+                return db, st
+            from deneva_tpu.cc import AUDIT_KEY, audit_observe
+            aud2, _e, _bk, cnt, drop, _vd, _rd = audit_observe(
+                cfg, batch, exec_commit & active, order, lvl, order_vis,
+                db[AUDIT_KEY], state.epoch, cadence=knobs.audit_cadence)
+            db = dict(db)
+            db[AUDIT_KEY] = aud2
+            st["audit_edge_cnt"] += cnt.astype(jnp.uint32)
+            st["audit_drop_cnt"] += drop.astype(jnp.uint32)
+            return db, st
+
+        def budget_merge(verdict, eligible=None):
+            # static step's defer budget (liveness backstop); `eligible`
+            # narrows it in the mixed branch
+            if cfg.defer_rounds_max <= 0:
+                return verdict
+            stuck = verdict.defer & active \
+                & (sel(pool.defer_cnt) >= jnp.int32(cfg.defer_rounds_max))
+            if eligible is not None:
+                stuck = stuck & eligible
+            return dataclasses.replace(
+                verdict, abort=verdict.abort | stuck,
+                defer=verdict.defer & ~stuck)
+
+        def sweep_branch(be_s):
+            # uniform NO_WAIT / OCC epoch — the static step's sweep path
+            # over the coarsened conflict view
+            def body(_):
+                st = dict(stats)
+                inc = build_conflict_incidence(cfg, be_s, cbatch,
+                                               cbatch.order_free)
+                verdict, _cc = be_s.validate(cfg, state.cc_state, cbatch,
+                                             inc)
+                density_into(st, inc)
+                verdict = budget_merge(verdict)
+                exec_commit = verdict.commit
+                db = wl.execute(state.db, queries, exec_commit,
+                                verdict.order, st)
+                srounds = None
+                if cfg.repair and be_s.repair_rule is not None:
+                    from deneva_tpu.engine.repair import run_repair
+                    db, _cc, verdict, salvaged, srounds = run_repair(
+                        cfg, wl, be_s, db, queries, cbatch, inc, verdict,
+                        state.cc_state, st, exec_commit, None,
+                        ts_base=pool.next_seq - jnp.int32(self.pool.b),
+                        rounds_cap=knobs.repair_cap)
+                    exec_commit = exec_commit | salvaged
+                lvl = srounds if srounds is not None \
+                    else jnp.zeros_like(verdict.level)
+                db, st = audit_into(db, st, exec_commit, verdict.order,
+                                    lvl, False)
+                return (db, st, exec_commit, exec_commit, verdict.abort,
+                        verdict.defer)
+            return body
+
+        def tb_branch():
+            # uniform TPU_BATCH epoch: exactly the static step's path
+            # for this backend — forwarding executor when the workload
+            # is blind-write (density via the scatter-add path, inc
+            # never built), chained level waves otherwise
+            tb = backends[-1]
+            if forwarding_applies(tb, wl):
+                def body(_):
+                    st = dict(stats)
+                    verdict, fwd = forward_verdict(batch)
+                    density_into(st, None)
+                    db = wl.execute(state.db, queries, None,
+                                    verdict.order, st, fwd_rank=fwd)
+                    db, st = audit_into(db, st, verdict.commit,
+                                        verdict.order,
+                                        jnp.zeros_like(verdict.level),
+                                        True)
+                    return (db, st, verdict.commit, verdict.commit,
+                            verdict.abort, verdict.defer)
+            else:
+                def body(_):
+                    st = dict(stats)
+                    inc = build_conflict_incidence(cfg, tb, cbatch,
+                                                   cbatch.order_free)
+                    verdict, _cc = tb.validate(cfg, state.cc_state,
+                                               cbatch, inc)
+                    density_into(st, inc)
+                    db, st = _run_levels(cfg, wl, state.db, queries,
+                                         verdict.commit, verdict, st)
+                    db, st = audit_into(db, st, verdict.commit,
+                                        verdict.order, verdict.level,
+                                        False)
+                    return (db, st, verdict.commit, verdict.commit,
+                            verdict.abort, verdict.defer)
+            return body
+
+        def mixed_branch(_):
+            # mixed assignment: one shared coarse incidence; each
+            # backend validates its own sub-batch with the cross-group
+            # conflict surface deferred symmetrically, so the merged
+            # committed set needs no cross-group ordering.  Sweep
+            # winners commit at level 0 beside TPU_BATCH's level-0 wave
+            # (the union stays write-conflict-free: each group's wave
+            # is conflict-free by its own verdict invariant and every
+            # cross-group conflicting txn was deferred).  Repair is
+            # skipped in mixed epochs (its frontier algebra is
+            # per-backend; the next uniform epoch resumes it).
+            st = dict(stats)
+            inc = build_conflict_incidence(cfg, backends[0], cbatch,
+                                           cbatch.order_free)
+            crossdef = cross_group_defer(inc, cbatch, group)
+            commit = jnp.zeros_like(active)
+            abort = jnp.zeros_like(active)
+            defer = crossdef
+            level = jnp.zeros_like(batch.rank)
+            for g, be_g in enumerate(backends):
+                m = active & (group == g) & ~crossdef
+                sb = dataclasses.replace(cbatch, active=m)
+                v_g, _cc = be_g.validate(cfg, state.cc_state, sb, inc)
+                commit = commit | (v_g.commit & m)
+                abort = abort | (v_g.abort & m)
+                defer = defer | (v_g.defer & m)
+                if be_g.chained:
+                    level = jnp.where(m, v_g.level, level)
+            density_into(st, inc)
+            # budget covers sweep-group txns and cross-group defers;
+            # TPU_BATCH's internal defers resolve by construction
+            # (static step's chained exemption)
+            verdict = budget_merge(
+                Verdict(commit=commit, abort=abort, defer=defer,
+                        order=batch.rank, level=level),
+                eligible=(group != len(backends) - 1) | crossdef)
+            db, st = _run_levels(cfg, wl, state.db, queries,
+                                 verdict.commit, verdict, st)
+            db, st = audit_into(db, st, verdict.commit, verdict.order,
+                                verdict.level, False)
+            return (db, st, verdict.commit, verdict.commit,
+                    verdict.abort, verdict.defer)
+
+        # 4+5. routed validate/execute/repair/audit: uniform epochs take
+        # their backend's exact static branch; disagreement routes to
+        # the mixed branch
+        uniform = (knobs.assign == knobs.assign[0]).all()
+        idx = jnp.where(uniform, knobs.assign[0], jnp.int32(MIXED))
+        db, stats, exec_commit, release, aborts, defers = jax.lax.switch(
+            idx, [sweep_branch(backends[0]), sweep_branch(backends[1]),
+                  tb_branch(), mixed_branch], None)
+
+        # 6. update pool + counters (identical to the static step with
+        # forced=None; every candidate restamps aborts with fresh ts)
+        pre_abort_cnt = sel(pool.abort_cnt)
+        pool = self.pool.update(pool, slots, active, release, aborts,
+                                state.epoch, True)
+        ncommit = (exec_commit & active).sum(dtype=jnp.uint32)
+        stats["total_txn_commit_cnt"] += ncommit
+        stats["total_txn_abort_cnt"] += (aborts & active).sum(
+            dtype=jnp.uint32)
+        stats["unique_txn_abort_cnt"] += (
+            aborts & active & (pre_abort_cnt == 0)).sum(dtype=jnp.uint32)
+        count_by_type(stats, wl, queries, exec_commit & active,
+                      aborts & active)
+        stats["defer_cnt"] += (defers & active).sum(dtype=jnp.uint32)
+        committed = exec_commit & active
+        lat = jnp.clip(state.epoch - sel(pool.entry_epoch),
+                       0, LAT_BUCKETS - 1)
+        onehot = (lat[:, None] == jnp.arange(LAT_BUCKETS, dtype=jnp.int32)) \
+            & committed[:, None]
+        ttype = wl.txn_type_of(queries) if len(
+            getattr(wl, "txn_type_names", ("txn",))) > 1 else None
+        rows = []
+        for t in range(stats["latency_hist"].shape[0]):
+            m = onehot if ttype is None \
+                else onehot & (ttype == t)[:, None]
+            rows.append(m.sum(axis=0, dtype=jnp.uint32))
+        stats["latency_hist"] = stats["latency_hist"] + jnp.stack(rows)
+        rb = jnp.arange(RETRY_BUCKETS, dtype=jnp.int32)
+        retries = jnp.clip(pre_abort_cnt, 0, RETRY_BUCKETS - 1)
+        waits = jnp.clip(sel(pool.defer_cnt), 0, RETRY_BUCKETS - 1)
+        stats["retry_hist"] = stats["retry_hist"] + (
+            (retries[:, None] == rb) & committed[:, None]).sum(
+            axis=0, dtype=jnp.uint32)
+        stats["wait_hist"] = stats["wait_hist"] + (
+            (waits[:, None] == rb) & committed[:, None]).sum(
+            axis=0, dtype=jnp.uint32)
+
+        return EngineState(db=db, cc_state=state.cc_state, pool=pool,
+                           rng=rng, epoch=state.epoch + 1, stats=stats)
+
+    # ------------------------------------------------------------------
     @functools.cached_property
     def jit_step(self):
         return jax.jit(self.step, donate_argnums=0)
@@ -435,4 +699,17 @@ class Engine:
         def run(state: EngineState, n: int) -> EngineState:
             return jax.lax.scan(lambda s, _: (self.step(s), None), state,
                                 None, length=n)[0]
+        return run
+
+    @functools.cached_property
+    def jit_run_ctrl(self):
+        """Routed scan: ``n`` epochs under ONE knob decision (the
+        controller decides at chunk boundaries; knobs are traced
+        operands, so re-arming with new VALUES reuses the compile)."""
+
+        @functools.partial(jax.jit, static_argnums=2, donate_argnums=0)
+        def run(state: EngineState, knobs, n: int) -> EngineState:
+            return jax.lax.scan(
+                lambda s, _: (self.step(s, knobs), None), state,
+                None, length=n)[0]
         return run

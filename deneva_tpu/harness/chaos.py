@@ -355,6 +355,37 @@ SCENARIOS: dict[str, dict] = {
     # with a cycle witness naming an epoch inside exactly that window
     # (the anti-inert contract: a certifier that cannot catch a seeded
     # isolation bug proves nothing as an oracle).
+    # self-driving control plane under load shift + signal loss
+    # (runtime/controller.py): ctrl armed on a merged-OCC cluster with
+    # admission + metrics + the audit certificate standing, driven by
+    # the three stimuli of the tentpole contract at once — a mid-run
+    # zipf hotness shift (0 -> 0.9 at t=2.5 s, the client's staged
+    # second ring), an open-loop flash crowd cresting over the
+    # admission bound, and a fault_kill of node 0 (the metrics
+    # aggregator AND a merged-protocol voter: group progress stalls
+    # cluster-wide while it replays, which is exactly the stale-signal
+    # shape the governor must catch).  The invariants this buys: the
+    # controller DECIDED (armed rows on every surviving server), the
+    # governor TRIPPED to static on the stall and RE-ENGAGED after the
+    # heal streak, every node's decision stream replays bit-for-bit
+    # from its recorded signals (replay_decisions == []), and the
+    # standing oracles hold across all of it — exactly-once accounting,
+    # digest-vs-replay recovery, serializability certificate green.
+    "ctrl-shift-degrade": dict(
+        audit=True,
+        cc_alg=CCAlg.OCC, dist_protocol="merged",
+        ctrl=True, escrow_order_free=False, metrics=True,
+        admission=True, max_txn_in_flight=16384,
+        admission_queue_max=1024, admission_slo_ms=200.0,
+        tenant_quota=2500.0, tenant_burst_s=0.25,
+        arrival_process="flash", arrival_rate=3000.0,
+        arrival_flash_at_s=2.5, arrival_flash_secs=1.5,
+        arrival_flash_factor=6.0,
+        zipf_theta=0.0, zipf_shift="0.9:2.5",
+        synth_table_size=1024,
+        fault_kill="0:64", logging=True, replica_cnt=1,
+        done_secs=10.0, log_dir="/dev/shm/deneva_logs",
+        fault_recovery_timeout_s=300.0),
     "audit-clean": dict(
         cc_alg=CCAlg.OCC, dist_protocol="merged", audit=True,
         zipf_theta=0.9, synth_table_size=1024, done_secs=2.0),
@@ -377,6 +408,7 @@ OVERLOAD_SCENARIOS = ("overload-flash", "overload-aggressor",
 PARTITION_SCENARIOS = ("partition-split", "partition-asym",
                        "partition-grayslow", "partition-flap")
 AUDIT_SCENARIOS = ("audit-clean", "audit-mutation")
+CTRL_SCENARIOS = ("ctrl-shift-degrade",)
 
 
 class ChaosViolation(AssertionError):
@@ -401,7 +433,7 @@ def run_scenario(name: str, quick: bool = False,
     spec = dict(SCENARIOS[name])
     if quick and not name.startswith(("elastic-", "geo-", "overload-",
                                       "partition-", "monitor-",
-                                      "audit-")):
+                                      "audit-", "ctrl-")):
         # elastic scenarios keep their full window: the cutover stall
         # (row stream + boundary sync, 1.4-2.2 s measured on the CI box;
         # ~5 s replay-jit for kill-reassign) would otherwise swallow a
@@ -451,7 +483,8 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
                  f"{name}: more unique acks ({c['txn_cnt']}) than unique "
                  f"sends ({c['sent_cnt']}) — a tag was acked twice")
     if name not in ("kill-one-server", "repair-contention",
-                    "trace-kill", "monitor-grayslow"):
+                    "trace-kill", "monitor-grayslow",
+                    "ctrl-shift-degrade"):
         # deterministic replicated validation must survive the faults
         # (and any membership cutover): identical [summary] commit
         # counts on every reporting server — except where a server was
@@ -500,6 +533,11 @@ def _check_invariants(name: str, cfg: Config, out: dict, run_id: str,
         # the killed node), then the bus/watchdog audit on top
         _check_recovery(cfg, out, run_id, report)
         _check_monitor(cfg, srv, cls, run_id, report)
+    if name.startswith("ctrl-"):
+        # the crash/recovery oracle first (node 0 = the aggregator is
+        # the killed node), then the controller's own invariants
+        _check_recovery(cfg, out, run_id, report)
+        _check_ctrl(name, cfg, out, run_id, report)
     if name.startswith("elastic-"):
         _check_elastic(name, cfg, out, report)
     if name.startswith("geo-"):
@@ -1084,6 +1122,80 @@ def _check_audit(name: str, cfg: Config, out: dict, run_id: str,
              f"{w['txns']}")
 
 
+def _check_ctrl(name: str, cfg: Config, out: dict, run_id: str,
+                report: dict) -> None:
+    """Control-plane oracle (the tools/smoke.sh ``ctrl`` gate):
+
+    * the controller was LIVE: > 0 recorded decisions on every
+      surviving server's ``ctrl_node*.log`` sidecar, with armed rows
+      (anti-inert — a scenario that passes with the plane idle proves
+      nothing);
+    * the fail-safe governor TRIPPED on the signal stall (node 0's
+      kill/replay freezes merged group progress past ``ctrl_stale_s``,
+      so the survivor's next boundary tick reads stale) and RE-ENGAGED:
+      an armed row follows a static row in the same node's stream;
+    * decision determinism: every incarnation's decision stream replays
+      BIT-FOR-BIT from its own recorded signals (`replay_decisions`
+      over the parse_ctrl rows — a killed node's recovered process
+      starts a fresh controller, so its stream splits at seq=1 exactly
+      like the command log's resume boundary).
+    """
+    from deneva_tpu.harness.parse import parse_ctrl
+    from deneva_tpu.runtime.controller import replay_decisions
+
+    tdir = os.path.join(cfg.log_dir, run_id)
+    live = [s for s in range(cfg.node_cnt) if out[s][0] == "server"]
+    armed = 0
+    trips = 0
+    reengaged = False
+    decisions = []
+    for s in live:
+        path = os.path.join(tdir, f"ctrl_node{s}.log")
+        _require(os.path.exists(path),
+                 f"{name}: ctrl decision sidecar missing at {path}")
+        with open(path) as f:
+            rows = parse_ctrl(f)
+        _require(len(rows) > 0,
+                 f"{name}: node {s} never recorded a decision (is the "
+                 "controller live?)")
+        decisions.append(len(rows))
+        node_cfg = cfg.replace(node_id=s, part_cnt=cfg.node_cnt)
+        # split at seq resets: each process incarnation runs its own
+        # fresh deterministic controller over its own signal stream
+        segs: list[list[dict]] = []
+        for r in rows:
+            if int(r.get("seq", 0)) == 1 or not segs:
+                segs.append([])
+            segs[-1].append(r)
+        for seg in segs:
+            bad = replay_decisions(node_cfg, seg)
+            _require(not bad,
+                     f"{name}: node {s} decision stream is not "
+                     f"replay-reproducible: " + "; ".join(bad[:5]))
+        armed += sum(1 for r in rows if r.get("gov") == "armed")
+        trips = max(trips, max(int(r.get("trips", 0)) for r in rows))
+        seen_static = False
+        for r in rows:
+            if r.get("gov") == "static":
+                seen_static = True
+            elif seen_static and r.get("gov") == "armed":
+                reengaged = True
+    report["ctrl_decisions"] = decisions
+    report["ctrl_armed_rows"] = armed
+    report["ctrl_trips"] = trips
+    report["ctrl_reengaged"] = reengaged
+    _require(armed > 0,
+             f"{name}: no armed decision was ever recorded — the "
+             "adaptive plane never engaged")
+    _require(trips > 0,
+             f"{name}: the governor never tripped to static — the "
+             "signal-loss fallback is unproven (did the stall clear "
+             "ctrl_stale_s?)")
+    _require(reengaged,
+             f"{name}: the governor never re-engaged after its trip "
+             "(heal streak never cleared inside the window)")
+
+
 def _check_recovery(cfg: Config, out: dict, run_id: str,
                     report: dict) -> None:
     """Safety of the failover path: the killed server recovered by log
@@ -1170,6 +1282,7 @@ def main(argv: list[str]) -> int:
                        else OVERLOAD_SCENARIOS if n == "overload"
                        else PARTITION_SCENARIOS if n == "partition"
                        else AUDIT_SCENARIOS if n == "audit"
+                       else CTRL_SCENARIOS if n == "ctrl"
                        else (n,))]
     rc = 0
     for name in names:

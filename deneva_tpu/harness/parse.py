@@ -279,6 +279,27 @@ def parse_audit(lines) -> list[dict[str, Any]]:
     return _parse_tagged(lines, _AUDIT)
 
 
+_CTRL = re.compile(r"\[ctrl\] (.*)")
+
+
+def parse_ctrl(lines) -> list[dict[str, Any]]:
+    """Per-node ``[ctrl]`` decision lines (runtime/controller.ctrl_line)
+    -> [{node, seq, epoch, epochs, dens, fb, sv, wit, slo, gap_us, gov,
+    heal, trips, assign, gshift, cap, cad, qidx}].  One row per
+    controller boundary tick, carrying BOTH the recorded signals
+    (``dens``/``assign``/``gshift`` are colon-joined per-partition int
+    strings — `_auto` keeps them as strings, split on ':' to consume)
+    and the decision, which is the decision-replay contract's whole
+    input: `runtime.controller.replay_decisions` re-derives the
+    decision stream from these rows and diffs it field-for-field.
+    Rows come back in emit order (seq order per node).  Logs predating
+    the control plane yield [] — and every other parser here ignores
+    ``[ctrl]`` lines — the same forward/backward-compat contract as
+    ``parse_membership`` through ``parse_audit`` (tested in
+    tests/test_harness.py)."""
+    return _parse_tagged(lines, _CTRL)
+
+
 def cfg_header(cfg: Config) -> str:
     """`# cfg key=value` echo lines the runner prepends to each output file
     so parsing never has to re-derive the config from the filename."""

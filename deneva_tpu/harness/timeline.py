@@ -79,10 +79,15 @@ CRITPATH_TRACK = Track(5, "critpath",
 # ledger (observation d2h decode + tag join + JSONL write) — a latency
 # ledger like the admission/fencing spans, on its own declared track
 AUDIT_TRACK = Track(6, "audit", frozenset(("audit",)))
+# feedback control plane (runtime/controller.py): one span per group
+# boundary covering the decide + actuate tick — a latency ledger like
+# the audit sidecar span, on its own declared track so controller
+# overhead is visible as a track instead of folding into the phase clock
+CTRL_TRACK = Track(7, "ctrl", frozenset(("ctrl",)))
 
 TRACKS: tuple[Track, ...] = (PHASE_TRACK, REPLICATION_TRACK,
                              ADMISSION_TRACK, FENCING_TRACK, TXN_TRACK,
-                             CRITPATH_TRACK, AUDIT_TRACK)
+                             CRITPATH_TRACK, AUDIT_TRACK, CTRL_TRACK)
 
 # span name -> owning track for the [timeline] ledger families
 SPAN_TRACK: dict[str, Track] = {name: t for t in TRACKS

@@ -97,6 +97,11 @@ class AdmissionController:
         self.T = max(1, cfg.tenant_cnt)
         self.quota = float(cfg.tenant_quota)           # tokens / second
         self.burst = max(self.quota * cfg.tenant_burst_s, 1.0)
+        # ctrl quota-scale multiplier (runtime/controller.quota_scale):
+        # scales the effective refill rate + burst ceiling.  EXACTLY 1.0
+        # when idle — multiplying by 1.0 is bit-exact on every float, so
+        # an unarmed/healed controller never perturbs token arithmetic.
+        self.scale = 1.0
         self.tokens = np.full(self.T, self.burst, np.float64)
         self._last_us = now_us
         self.queue_max = int(cfg.admission_queue_max)
@@ -118,13 +123,20 @@ class AdmissionController:
         self.delay_ms = StatsArr()       # cumulative, weighted (ms)
 
     # -- token buckets ---------------------------------------------------
+    def set_scale(self, scale: float) -> None:
+        """Controller actuation point: scale the effective quota (refill
+        rate, burst ceiling, retry hints) without touching the per-tenant
+        token stock — a scale-down takes effect at the next refill clamp,
+        a scale-up immediately widens the ceiling."""
+        self.scale = float(scale)
+
     def _refill(self, now_us: int) -> None:
         if self.quota <= 0:
             return
         dt = max(now_us - self._last_us, 0) * 1e-6
         self._last_us = now_us
-        np.minimum(self.tokens + self.quota * dt, self.burst,
-                   out=self.tokens)
+        np.minimum(self.tokens + self.quota * self.scale * dt,
+                   self.burst * self.scale, out=self.tokens)
 
     # -- the admission decision ------------------------------------------
     def admit(self, tags: np.ndarray, now_us: int
@@ -152,7 +164,7 @@ class AdmissionController:
                 # bucket pegged near full) — under a breached SLO its
                 # whole batch sheds, refill trickle included, so
                 # in-quota tenants keep their latency
-                agg = self.tokens < 0.5 * self.burst
+                agg = self.tokens < 0.5 * self.burst * self.scale
                 shed_rows = agg[ten]
                 reason[shed_rows] = R_SLO
             pos = _cumcount(ten, self.T)
@@ -160,7 +172,8 @@ class AdmissionController:
             reason[over] = R_QUOTA
             # retry hint: refill time of each row's token deficit
             deficit = (pos - grant[ten] + 1).clip(min=1)
-            hint = (deficit * 1e6 / self.quota).astype(np.int64)
+            hint = (deficit * 1e6 / (self.quota * self.scale)
+                    ).astype(np.int64)
             nq = reason != R_ADMIT
             retry[nq] = np.maximum(hint[nq], int(self.retry_us))
         # capacity: admitted rows past the queue bound NACK in arrival

@@ -236,6 +236,28 @@ class ClientNode:
             self.ring_types.append(
                 np.asarray(self.wl.txn_type_of(q), np.uint8))
         self.ring_pos = 0
+        # mid-run contention shift (Config.zipf_shift, the ctrl chaos
+        # scenario's load-shift half): a SECOND seeded ring drawn at the
+        # shifted theta, swapped in wholesale AT_S seconds after run
+        # start — tags, tenants, pacing and every repair path are ring-
+        # agnostic, so only the key skew of freshly issued queries
+        # changes.  Empty spec (default) builds nothing.
+        self._shift = None
+        if cfg.zipf_shift:
+            from deneva_tpu.workloads import get_workload as _gw
+            theta2, at_s = cfg.zipf_shift_spec()
+            wl2 = _gw(cfg.replace(zipf_theta=theta2))
+            rng2 = jax.random.PRNGKey(cfg.seed + 7919 * cfg.node_id + 1)
+            ring2: list[wire.QueryBlock] = []
+            types2: list[np.ndarray] = []
+            for i in range(n_pregen):
+                q = wl2.generate(jax.random.fold_in(rng2, i), self.chunk)
+                keys, types, scalars = wl2.to_wire(q)
+                ring2.append(wire.QueryBlock(
+                    keys=keys, types=types, scalars=scalars,
+                    tags=np.zeros(self.chunk, np.int64)))
+                types2.append(np.asarray(wl2.txn_type_of(q), np.uint8))
+            self._shift = (float(at_s), ring2, types2)
         # per-txn-type latency families (reference per-kind StatsArr,
         # VERDICT r3 next #6): remember each tag's txn type so CL_RSP
         # latency samples can feed {type}_latency percentiles
@@ -617,6 +639,15 @@ class ClientNode:
         sent_total = 0
         iota = np.arange(self.chunk, dtype=np.int64)   # reusable tag base
         while not self.stop:
+            if self._shift is not None \
+                    and time.monotonic() - t_start >= self._shift[0]:
+                # contention shift: swap the whole pre-generated ring;
+                # in-flight tags, backoff ledgers and resend queues keep
+                # their original rows (a tag's identity is the tag)
+                _, self.ring, self.ring_types = self._shift
+                self._shift = None
+                print(f"[client] node={self.me} zipf_shift engaged",
+                      flush=True)
             progressed = False
             # open-loop arrivals: the seeded schedule, not acks, drives
             # the send budget — a stalled server grows the backlog

@@ -203,6 +203,36 @@ GATES: dict[str, GateSpec] = {s.name: s for s in (
                    "audit_mutate_verdict"),
     ),
     GateSpec(
+        "ctrl",
+        # feedback control plane (runtime/controller.py + cc/router.py):
+        # epoch-boundary decisions over lagged conflict-density /
+        # fallback / witness / SLO-breach signals actuating per-partition
+        # backend routing + watermark granularity (RouterKnobs into the
+        # routed engine step), repair-round caps, audit cadence and
+        # admission quota scale.  ctrl_lo/ctrl_hi/ctrl_confirm/
+        # ctrl_cooldown/ctrl_stale_s/ctrl_heal/ctrl_gshift/
+        # ctrl_scale_max are depth knobs with live defaults — arming is
+        # `ctrl` alone.  zipf_shift is the companion load-shape flag
+        # (client-side mid-run hotness shift, the stimulus the sweep and
+        # chaos scenario drive the controller with); its parser
+        # zipf_shift_spec is pure (None when unarmed), like
+        # fault_kill_spec.  `ctl` is the controller handle on driver and
+        # server (None until armed — `self.ctl is not None` is the
+        # canonical gate); `knobs` is the traced RouterKnobs operand
+        # (None = static step, `if knobs is not None` routes); `_shift`
+        # the client's staged post-shift ring.
+        flags=("ctrl", "zipf_shift"),
+        guards=("ctrl", "_ctrl", "ctl", "knobs", "zipf_shift",
+                "zipf_shift_spec", "_shift"),
+        home=("deneva_tpu/runtime/controller.py",
+              "deneva_tpu/cc/router.py"),
+        use_attrs=("ctl", "_shift"),
+        # mixed_branch is handed to lax.switch by REFERENCE inside the
+        # routed step (no resolvable call site for the checker); the
+        # routed step itself is only reachable under `knobs is not None`
+        context=("mixed_branch",),
+    ),
+    GateSpec(
         "fencing",
         # partition & gray-failure tolerance: heartbeat failure
         # detection, fenced slot ownership, quorum reassignment

@@ -119,11 +119,12 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
         endpoints = tcp_endpoints(n_all, base_port=base)
     else:
         endpoints = ipc_endpoints(n_all, run_id)
-    if cfg.logging or cfg.telemetry or cfg.metrics or cfg.audit:
+    if cfg.logging or cfg.telemetry or cfg.metrics or cfg.audit or cfg.ctrl:
         # namespace log files per run like the IPC endpoints, or two
         # concurrent clusters would truncate each other's logs; the
-        # telemetry sidecars, the metrics-bus stream and the audit
-        # sidecars live in the same per-run directory
+        # telemetry sidecars, the metrics-bus stream, the audit
+        # sidecars and the ctrl decision records live in the same
+        # per-run directory
         cfg = cfg.replace(log_dir=os.path.join(cfg.log_dir, run_id))
     if timeout_s is None:
         # generous: every node jit-compiles its epoch step before the

@@ -76,12 +76,19 @@ ROLE_NAMES = ("server", "client")
 #                               the LAST critical-path window's stage
 #                               decomposition (CritLedger; sums to
 #                               wall_ms by construction)
+#   ctrl_gov/ctrl_qidx/ctrl_trips
+#                               feedback-controller governor state
+#                               (1=armed), admission quota-scale rung,
+#                               cumulative stale trips (ctrl=true only;
+#                               appended at the tail so older decoders
+#                               keep their known prefix)
 FRAME_FIELDS = (
     "commit", "abort", "defer", "salvage", "shed",
     "pending", "retry_depth", "held_rsp", "adm_depth", "quorum_ms",
     "resend", "backoff", "backlog",
     "admit_ms", "wire_ms", "device_ms", "retire_ms", "other_ms",
     "wall_ms",
+    "ctrl_gov", "ctrl_qidx", "ctrl_trips",
 )
 
 _FHDR = struct.Struct("<hBBqqHH")   # node, role, version, epoch, t_us,

@@ -100,6 +100,14 @@ OWNER: dict[str, str] = {
     # _retire positions and the summary path — all dispatch; workers
     # never touch the exporter or its stream
     "aud": DISPATCH, "_AUD": DISPATCH,
+    # feedback control plane (runtime/controller.py): signal
+    # accumulation at the _retire positions, the decide/actuate tick at
+    # the group boundary in run() — all dispatch; workers never touch
+    # the controller or its accumulators
+    "ctl": DISPATCH, "_ctrl_ep": DISPATCH, "_ctrl_dens": DISPATCH,
+    "_ctrl_sv": DISPATCH, "_ctrl_wit": DISPATCH, "_ctrl_t": DISPATCH,
+    "_ctrl_breach0": DISPATCH, "_ctrl_span": DISPATCH,
+    "_ctrl_log": DISPATCH, "_ctrl_primed": DISPATCH,
     # fencing layer (runtime/faildet.py): detector, heartbeat ledgers
     # and fence counters all live on the dispatch thread (_route runs
     # there; workers only READ smap/_FD for the envelope header)

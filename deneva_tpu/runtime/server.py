@@ -766,6 +766,39 @@ class ServerNode:
                                           self.me * self.b_loc,
                                           append=cfg.recover)
 
+        # ---- self-driving control plane (runtime/controller.py — off
+        # on a default config: no controller object, no [ctrl] line, no
+        # quota actuation; config.validate pins ctrl to metrics-on, so
+        # the density plane below always feeds it).  Cluster actuation
+        # is the admission quota scale; the backend/granularity knobs
+        # are the in-process engine's (engine/driver.py).  Signals are
+        # this node's OWN retired-group deltas — a dead aggregator /
+        # partitioned peer stalls group progress, which the governor
+        # reads as staleness (epochs=0 or gap > ctrl_stale_s) and
+        # reverts to static until the heal streak clears. ----
+        self.ctl = None
+        if cfg.ctrl:
+            from deneva_tpu.runtime.controller import Controller
+            self.ctl = Controller(cfg)
+            # accumulators between boundary ticks: [epochs, dens[P],
+            # salvaged, witnesses], last-tick wall ns and breach base
+            self._ctrl_ep = 0
+            self._ctrl_dens = np.zeros(max(cfg.part_cnt, 1), np.int64)
+            self._ctrl_sv = 0
+            self._ctrl_wit = 0
+            self._ctrl_t = time.monotonic()
+            self._ctrl_breach0 = 0
+            self._ctrl_span = 0.0
+            self._ctrl_primed = False
+            # decision-record sidecar (the [ctrl] lines, one per tick):
+            # the chaos oracle replays these through replay_decisions,
+            # so they must survive the process like the audit sidecars
+            # do — recovery appends to the pre-crash file
+            os.makedirs(cfg.log_dir, exist_ok=True)
+            self._ctrl_log = open(
+                os.path.join(cfg.log_dir, f"ctrl_node{self.me}.log"),
+                "a" if cfg.recover else "w")
+
         # ---- chaos / failover gates (all off on a default config) ------
         # _failover: peers tolerate a dead server and wait for its
         # recovered incarnation instead of raising; acks gate on whole-
@@ -2334,6 +2367,14 @@ class ServerNode:
             pending=len(self.pending), retry_depth=len(self.retry.items),
             held_rsp=len(self._held_rsp),
             adm_depth=self.adm.depth if self.adm is not None else 0)
+        if self.ctl is not None:
+            # controller state rides the frame (the monitor panel's
+            # input).  gov encodes 0=off / 1=static / 2=armed: the
+            # schema zero-fills unset fields, so a ctrl-off frame reads
+            # gov=0 and the monitor panel stays hidden
+            counters["ctrl_gov"] = 2 if self.ctl.gov == "armed" else 1
+            counters["ctrl_qidx"] = self.ctl.quota_idx
+            counters["ctrl_trips"] = self.ctl.stale_trips
         parts, rec = self.mbus.frame(epoch, counters, dens_row)
         agg = self._mb_agg()
         if agg == self.me:
@@ -2343,6 +2384,60 @@ class ServerNode:
             self.magg.feed(rec)
         else:
             self.tp.sendv(agg, "METRICS", parts)
+
+    # -- control plane: boundary tick -------------------------------------
+    def _ctrl_tick(self, group_end: int, tl) -> None:
+        """One controller decision per group boundary: fold the retire
+        loop's accumulated signals into a `CtrlSignals`, decide, actuate
+        the admission quota scale, and emit the ``[ctrl]`` record (the
+        replay contract's whole input).  A stalled pipeline (dead
+        aggregator node, partition, fenced peer — nothing retired, or
+        the boundary gap blew past ``ctrl_stale_s``) reads as unhealthy
+        and the governor reverts to the static config until the heal
+        streak clears."""
+        from deneva_tpu.runtime.controller import (CtrlSignals, ctrl_line,
+                                                   quota_scale)
+        t0 = time.monotonic()
+        if not self._ctrl_primed:
+            # baseline tick: the first group boundary lands right after
+            # jit compile — a multi-second gap that says nothing about
+            # signal health.  Stamp the clock/accumulator baseline and
+            # decide nothing (the driver's _ctrl_tick does the same).
+            self._ctrl_primed = True
+            self._ctrl_t = t0
+            self._ctrl_ep = 0
+            self._ctrl_dens[:] = 0
+            self._ctrl_sv = 0
+            self._ctrl_wit = 0
+            if self.adm is not None:
+                self._ctrl_breach0 = self.adm.breach_groups
+            return
+        gap_us = int((t0 - self._ctrl_t) * 1e6)
+        self._ctrl_t = t0
+        breaches = 0
+        if self.adm is not None:
+            b = self.adm.breach_groups
+            breaches = b - self._ctrl_breach0
+            self._ctrl_breach0 = b
+        sig = CtrlSignals(
+            epoch=int(group_end), epochs=self._ctrl_ep,
+            dens=[int(x) for x in self._ctrl_dens],
+            fallback=0, salvaged=self._ctrl_sv,
+            witnesses=self._ctrl_wit, breaches=breaches, gap_us=gap_us)
+        self._ctrl_ep = 0
+        self._ctrl_dens[:] = 0
+        self._ctrl_sv = 0
+        self._ctrl_wit = 0
+        dec = self.ctl.decide(sig)
+        if self.adm is not None:
+            self.adm.set_scale(quota_scale(dec.quota_idx))
+        line = ctrl_line(self.me, sig, dec)
+        print(line, flush=True)
+        self._ctrl_log.write(line + "\n")
+        self._ctrl_log.flush()
+        if tl:
+            # decision-tick latency ledger on the declared "ctrl" track
+            tl.spans.append(("ctrl", time.monotonic() - t0))
 
     # -- verdict retirement (the back half of an epoch) ------------------
     def _retire(self, group: dict, tl) -> None:
@@ -2519,6 +2614,18 @@ class ServerNode:
                     epoch, auda[0][i], auda[1][i], int(auda[2][i]),
                     int(auda[3][i]), int(auda[4][i]), int(auda[5][i]),
                     commit=int(my_commit.sum()), tags=block.tags)
+            if self.ctl is not None:
+                # control-plane signal accumulation (consumed at the
+                # group-boundary tick, _ctrl_tick): per-epoch density
+                # row, salvage plane, audit witness count
+                self._ctrl_ep += 1
+                if dens is not None:
+                    self._ctrl_dens += dens[i].astype(np.int64)
+                if rep is not None:
+                    self._ctrl_sv += int((rep[i, lo:lo + n]
+                                          & my_commit).sum())
+                if auda is not None:
+                    self._ctrl_wit += int(auda[2][i])
             restart = ab | df
             if restart.any():
                 idx = np.where(restart)[0]
@@ -3009,6 +3116,10 @@ class ServerNode:
                 adm_ms = self.adm.on_group()
                 if tl and adm_ms > 0:
                     tl.spans.append(("adm_wait", adm_ms / 1e3))
+            if self.ctl is not None:
+                # control-plane boundary tick AFTER the SLO tick, so the
+                # breach delta it consumes includes this very group
+                self._ctrl_tick(group_end, tl)
             if tl:
                 if self._repair and self._rep_span:
                     # retire-side salvage accounting (the repair compute
@@ -3169,6 +3280,14 @@ class ServerNode:
             self.adm.summary_into(st)
             for line in self.adm.admission_lines(self.me):
                 print(line, flush=True)
+        if self.ctl is not None:
+            # control-plane counters ([summary] satellite; the per-tick
+            # record is the [ctrl] line stream parsed by
+            # harness.parse.parse_ctrl).  Emitted only when armed so
+            # the default summary line is byte-identical.
+            st.set("ctrl_decisions", float(self.ctl.seq))
+            st.set("ctrl_trips", float(self.ctl.stale_trips))
+            st.set("ctrl_qidx", float(self.ctl.quota_idx))
         if self.tel is not None:
             # flight-recorder counters ([summary]) + the [telemetry]
             # line (parsed by harness.parse.parse_telemetry); the final
@@ -3261,6 +3380,8 @@ class ServerNode:
         if self.aud is not None:
             # same idempotent-close posture as the aggregator stream
             self.aud.close()
+        if self.ctl is not None:
+            self._ctrl_log.close()
         self.tp.close()
 
 

@@ -1,0 +1,15 @@
+"""Fixture stand-in for the router half of the ctrl home (RouterKnobs
+construction + key coarsening).  Exempt like the controller module —
+the routed step only reaches it once armed."""
+
+
+def static_knobs(cfg):
+    return None
+
+
+def knobs_from_decision(cfg, assign, gshift, repair_cap, audit_cadence):
+    return None
+
+
+def coarsen_keys(batch, owner, gshift):
+    return batch

@@ -1,0 +1,10 @@
+"""Fixture config: just the ctrl flags, default OFF (the registry
+drift check cross-parses this module against the REAL ctrl
+GateSpec)."""
+
+
+class Config:
+    ctrl: bool = False
+    zipf_shift: str = ""
+    ctrl_lo: float = 0.02
+    node_cnt: int = 1

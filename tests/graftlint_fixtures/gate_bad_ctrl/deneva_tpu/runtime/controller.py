@@ -1,0 +1,20 @@
+"""Fixture stand-in for the feedback control plane's home module
+(never imported at runtime; the checker resolves calls against its
+dotted path).  Code HERE is exempt — it only runs once the gate armed
+it."""
+
+
+class Controller:
+    def __init__(self, cfg):
+        self.seq = 0
+
+    def decide(self, sig):
+        return None
+
+
+def quota_scale(idx):
+    return 0.8 ** idx
+
+
+def ctrl_line(node, sig, dec):
+    return "[ctrl]"

@@ -1,0 +1,50 @@
+"""Seeded graftlint violations: the REAL ``ctrl`` GateSpec
+(runtime/gates.py) checked against fixture call sites — an unguarded
+call into either ctrl home module (runtime/controller.py or
+cc/router.py) or an unguarded deep use of the controller handle must
+fail the lint, while the guarded idioms the runtime actually uses
+(``cfg.ctrl`` at construction, the handle's ``is not None`` check, the
+engine's ``knobs is not None`` routing test, ``cfg.zipf_shift`` around
+the client's staged ring) stay silent."""
+
+from deneva_tpu.cc.router import coarsen_keys, static_knobs
+from deneva_tpu.runtime.controller import (Controller, ctrl_line,
+                                           quota_scale)
+
+
+class ServerFx:
+    def __init__(self, cfg):
+        self.ctl = None
+        if cfg.ctrl:
+            # the runtime idiom: the flag test dominates construction
+            self.ctl = Controller(cfg)
+
+    def ok_tick(self, sig):
+        # the controller handle doubles as its own guard
+        if self.ctl is not None:
+            dec = self.ctl.decide(sig)
+            return quota_scale(0)
+        return 1.0
+
+    def ok_routed(self, batch, owner, knobs):
+        # the engine idiom: the traced RouterKnobs operand gates the
+        # routed step (`step(state, knobs=None)` dispatches on it)
+        if knobs is not None:
+            return coarsen_keys(batch, owner, knobs)
+        return batch
+
+    def ok_shift(self, cfg):
+        # the companion load-shape flag gates the client's staged ring
+        if cfg.zipf_shift:
+            return static_knobs(cfg)
+        return None
+
+    def bad_decide(self, sig):
+        # no dominating ctrl-flag test on any path to the use
+        return self.ctl.decide(sig)       # EXPECT[gate-unguarded-use]
+
+    def bad_knobs(self, cfg):
+        return static_knobs(cfg)          # EXPECT[gate-unguarded-use]
+
+    def bad_line(self, sig, dec):
+        return ctrl_line(0, sig, dec)     # EXPECT[gate-unguarded-use]

@@ -1,0 +1,374 @@
+"""Self-driving control plane (runtime/controller.py + cc/router.py,
+``Config.ctrl``, PR 16 tentpole).
+
+Five claim families:
+
+* **Oscillation control units** — hysteresis dead band holds the class,
+  a single-tick excursion never moves a knob (confirm streak), and a
+  knob that moved holds through its cooldown no matter what the
+  classes do.
+* **Fail-safe governor** — stale signals (stalled epochs or a boundary
+  gap past ``ctrl_stale_s``) revert every knob to the static config on
+  THAT tick; ``ctrl_heal`` consecutive healthy ticks re-engage; the
+  trip counter advances once per trip, not once per stale tick.
+* **Decision replay** — the ``[ctrl]`` line stream round-trips through
+  `harness.parse.parse_ctrl` + `signals_of_row` and a fresh controller
+  replayed over the recorded signals reproduces the decision stream
+  bit-for-bit (`replay_decisions` returns []); a tampered row is
+  reported.
+* **Off pins** — ``ctrl=false`` (the default) builds no controller and
+  no sidecar on a loopback ServerNode, broadcasts byte-identical blobs
+  (the wire pin), and the ROUTED epoch program driven with
+  ``static_knobs`` is value-identical per epoch to the unrouted
+  ``jit_run`` on every db/cc_state/pool/stats leaf (the state pin:
+  routing is pure mechanism, the static knob vector IS the off
+  semantics).
+* **Adaptive floor smoke** — on a deterministic hot YCSB stream the
+  adaptive plane's committed count stays within the acceptance floor
+  of the best single static assignment run through the SAME compiled
+  program (the frontier sweep in results/router carries the full
+  multi-phase version).
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from deneva_tpu.config import CCAlg, Config, WorkloadKind
+from deneva_tpu.runtime.controller import (Controller, CtrlSignals,
+                                           GOV_ARMED, GOV_STATIC, HOT,
+                                           SPARSE, ctrl_line,
+                                           quota_scale, replay_decisions,
+                                           signals_of_row)
+
+
+def ctl_cfg(**kw):
+    """Valid armed config (single part unless overridden): the ctrl
+    gate pins metrics on, a candidate cc_alg, and the escrow ordering
+    exemption off."""
+    base = dict(workload=WorkloadKind.YCSB, cc_alg=CCAlg.OCC,
+                metrics=True, ctrl=True, escrow_order_free=False,
+                repair=True, audit=True,
+                synth_table_size=1 << 12, req_per_query=4,
+                max_accesses=4, epoch_batch=128, conflict_buckets=1024,
+                max_txn_in_flight=512, zipf_theta=0.9,
+                read_perc=0.1, write_perc=0.9,
+                warmup_secs=0.0, done_secs=0.2)
+    base.update(kw)
+    return Config(**base)
+
+
+def sig(epoch=0, epochs=1, dens=(0,), gap_us=1000, **kw):
+    return CtrlSignals(epoch=epoch, epochs=epochs, dens=list(dens),
+                       gap_us=gap_us, **kw)
+
+
+# dens value that normalizes to density d for a 1-part cfg:
+# d = dens * part_cnt / (epochs * epoch_batch)
+def lanes(cfg, d, epochs=1):
+    return int(d * epochs * cfg.epoch_batch / max(cfg.part_cnt, 1))
+
+
+# ---- oscillation control units -----------------------------------------
+
+def test_hysteresis_dead_band_holds_class():
+    """Density inside (ctrl_lo, ctrl_hi) never moves the class: the
+    initial MID assignment (OCC) survives any in-band stream."""
+    cfg = ctl_cfg(ctrl_cooldown=0)
+    ctl = Controller(cfg)
+    mid = lanes(cfg, 0.10)
+    for e in range(8):
+        dec = ctl.decide(sig(epoch=e, dens=[mid]))
+        assert dec.gov == GOV_ARMED
+        assert dec.assign == [1], "in-band tick moved the backend"
+        assert dec.gshift == [0]
+
+
+def test_confirm_streak_blocks_single_tick_flip():
+    """One hot tick (then back in band) is noise by contract: with
+    ctrl_confirm=2 the class — and therefore the assignment — holds."""
+    cfg = ctl_cfg(ctrl_cooldown=0, ctrl_confirm=2)
+    ctl = Controller(cfg)
+    assert ctl.decide(sig(dens=[lanes(cfg, 0.5)])).assign == [1]
+    for e in range(4):
+        dec = ctl.decide(sig(epoch=e, dens=[lanes(cfg, 0.1)]))
+        assert dec.assign == [1]
+    # a SUSTAINED excursion does move it, on the confirm-th tick
+    assert ctl.decide(sig(dens=[lanes(cfg, 0.5)])).assign == [1]
+    dec = ctl.decide(sig(dens=[lanes(cfg, 0.5)]))
+    assert dec.assign == [2] and ctl.cls == [HOT]
+
+
+def test_cooldown_holds_moved_knob():
+    """After a move the knob holds ctrl_cooldown ticks even with the
+    opposite class fully confirmed; only the EXPIRY tick moves it."""
+    cfg = ctl_cfg(ctrl_cooldown=3, ctrl_confirm=1)
+    ctl = Controller(cfg)
+    hot, cold = lanes(cfg, 0.5), lanes(cfg, 0.001)
+    assert ctl.decide(sig(dens=[hot])).assign == [2]    # move; rearm
+    held = [ctl.decide(sig(dens=[cold])).assign for _ in range(2)]
+    assert held == [[2], [2]], "cooldown did not hold the knob"
+    assert ctl.decide(sig(dens=[cold])).assign == [0]   # expiry tick
+    # SPARSE also coarsens the incidence by ctrl_gshift (gshift has its
+    # own cooldown, armed on ITS move at the same ticks here)
+    assert ctl.gshift == [cfg.ctrl_gshift]
+
+
+def test_repair_cap_tracks_fallback_rate():
+    """Fallback-heavy ticks grow the live sub-round cap toward
+    repair_rounds; salvage-free ticks shed it, floored at 1."""
+    cfg = ctl_cfg(ctrl_cooldown=0, repair_rounds=3)
+    ctl = Controller(cfg)
+    mid = lanes(cfg, 0.1)
+    d = ctl.decide(sig(dens=[mid], fallback=8, salvaged=2))
+    assert d.repair_cap == 3                             # at max: hold
+    assert ctl.decide(sig(dens=[mid])).repair_cap == 2   # quiet: shed
+    assert ctl.decide(sig(dens=[mid])).repair_cap == 1
+    assert ctl.decide(sig(dens=[mid])).repair_cap == 1   # floor
+    d = ctl.decide(sig(dens=[mid], fallback=8, salvaged=2))
+    assert d.repair_cap == 2                             # 2*fb > total
+    d = ctl.decide(sig(dens=[mid], fallback=1, salvaged=8))
+    assert d.repair_cap == 2                             # salvage-led: hold
+
+
+def test_audit_cadence_tightens_on_witness():
+    """Any witness tightens the audit cadence to full coverage (1);
+    ctrl_confirm quiet ticks relax it back to the static cadence."""
+    cfg = ctl_cfg(ctrl_cooldown=0, ctrl_confirm=2, audit_cadence=4)
+    ctl = Controller(cfg)
+    mid = lanes(cfg, 0.1)
+    assert ctl.decide(sig(dens=[mid])).audit_cadence == 4
+    assert ctl.decide(sig(dens=[mid], witnesses=3)).audit_cadence == 1
+    assert ctl.decide(sig(dens=[mid])).audit_cadence == 1  # quiet=1
+    assert ctl.decide(sig(dens=[mid])).audit_cadence == 4  # quiet=2
+
+
+def test_quota_steps_and_scale():
+    """SLO breaches shed admission a step per (cooled-down) tick up to
+    ctrl_scale_max; clean ticks heal a step; idx=0 is EXACTLY 1.0."""
+    cfg = ctl_cfg(ctrl_cooldown=0, ctrl_scale_max=2)
+    ctl = Controller(cfg)
+    mid = lanes(cfg, 0.1)
+    assert ctl.decide(sig(dens=[mid], breaches=2)).quota_idx == 1
+    assert ctl.decide(sig(dens=[mid], breaches=1)).quota_idx == 2
+    assert ctl.decide(sig(dens=[mid], breaches=5)).quota_idx == 2  # cap
+    assert ctl.decide(sig(dens=[mid])).quota_idx == 1              # heal
+    assert quota_scale(0) == 1.0
+    assert quota_scale(1) == pytest.approx(0.8)
+    assert quota_scale(3) == pytest.approx(0.8 ** 3)
+
+
+# ---- fail-safe governor ------------------------------------------------
+
+def test_stale_signal_trips_to_static_and_reengages():
+    """A stale tick (gap past ctrl_stale_s, or zero epochs) reverts to
+    the static knob vector IMMEDIATELY, counts ONE trip per trip, and
+    ctrl_heal consecutive healthy ticks re-engage on the heal tick."""
+    cfg = ctl_cfg(ctrl_cooldown=0, ctrl_confirm=1, ctrl_heal=3)
+    ctl = Controller(cfg)
+    hot = lanes(cfg, 0.5)
+    assert ctl.decide(sig(dens=[hot])).assign == [2]     # adapted
+    stale = int(cfg.ctrl_stale_s * 1e6) + 1
+    dec = ctl.decide(sig(dens=[hot], gap_us=stale))
+    assert dec.gov == GOV_STATIC and dec.stale_trips == 1
+    assert dec.assign == [1] and dec.gshift == [0]       # static = cfg
+    assert dec.repair_cap == cfg.repair_rounds
+    assert dec.quota_idx == 0
+    # a second stale tick (stalled epochs this time) is the SAME trip
+    dec = ctl.decide(sig(dens=[hot], epochs=0))
+    assert dec.gov == GOV_STATIC and dec.stale_trips == 1
+    # healthy ticks 1..heal-1 stay static; the heal tick re-arms and
+    # decides adaptively again (the hot class survived the outage)
+    for _ in range(cfg.ctrl_heal - 1):
+        dec = ctl.decide(sig(dens=[hot]))
+        assert dec.gov == GOV_STATIC
+    dec = ctl.decide(sig(dens=[hot]))
+    assert dec.gov == GOV_ARMED and dec.assign == [2]
+    # a later trip increments the counter again
+    dec = ctl.decide(sig(dens=[hot], gap_us=stale))
+    assert dec.stale_trips == 2
+
+
+# ---- decision replay ---------------------------------------------------
+
+def _scripted_rows(cfg):
+    """A signal script covering adapt, trip, heal, quota and repair
+    moves; returns the parsed [ctrl] rows (emit order)."""
+    from deneva_tpu.harness.parse import parse_ctrl
+    ctl = Controller(cfg)
+    hot, cold = lanes(cfg, 0.5), lanes(cfg, 0.001)
+    stale = int(cfg.ctrl_stale_s * 1e6) + 1
+    script = [sig(epoch=e, dens=[hot], fallback=4, salvaged=1)
+              for e in range(3)]
+    script += [sig(epoch=3, dens=[hot], gap_us=stale),
+               sig(epoch=4, dens=[hot], epochs=0)]
+    script += [sig(epoch=5 + i, dens=[cold], breaches=i % 2,
+                   witnesses=(1 if i == 2 else 0)) for i in range(6)]
+    lines = [ctrl_line(0, s, ctl.decide(s)) for s in script]
+    return parse_ctrl(lines)
+
+
+def test_replay_reproduces_decision_stream():
+    cfg = ctl_cfg(ctrl_confirm=2, ctrl_cooldown=2)
+    rows = _scripted_rows(cfg)
+    assert len(rows) == 11
+    govs = {r["gov"] for r in rows}
+    assert govs == {GOV_ARMED, GOV_STATIC}, "script never tripped"
+    assert replay_decisions(cfg, rows) == []
+
+
+def test_replay_reports_tampered_row():
+    cfg = ctl_cfg(ctrl_confirm=2, ctrl_cooldown=2)
+    rows = _scripted_rows(cfg)
+    rows[1]["assign"] = "0"
+    bad = replay_decisions(cfg, rows)
+    assert bad and "assign" in bad[0]
+
+
+def test_signals_round_trip_through_line():
+    s = sig(epoch=7, epochs=3, dens=[5, 0, 9], fallback=2, salvaged=1,
+            witnesses=4, breaches=1, gap_us=123456)
+    cfg = ctl_cfg(part_cnt=1)
+    from deneva_tpu.harness.parse import parse_ctrl
+    row, = parse_ctrl([ctrl_line(2, s, Controller(cfg).decide(s))])
+    assert signals_of_row(row) == s
+
+
+# ---- off pins ----------------------------------------------------------
+
+def test_ctrl_off_wire_pin():
+    """The house contract, executable: with ctrl off (the default) a
+    server builds NO controller, opens NO ctrl sidecar, counts no ctrl
+    stat, and its blob broadcast is byte-identical to the pre-ctrl
+    codec output — off is the pre-ctrl runtime byte for byte."""
+    from deneva_tpu.runtime import wire
+    from tests.test_chaos import _solo_server
+
+    node = _solo_server("ctrl_off_pin")
+    try:
+        assert node.ctl is None
+        assert not hasattr(node, "_ctrl_log"), "off run opened a sidecar"
+        blk = wire.QueryBlock(
+            keys=np.arange(8, dtype=np.int32).reshape(4, 2),
+            types=np.ones((4, 2), np.int8),
+            scalars=np.zeros((4, 0), np.int32),
+            tags=np.arange(4, dtype=np.int64))
+        ts = np.arange(4, dtype=np.int64) + 100
+        blob = wire.encode_epoch_blob(7, blk, ts)
+        sent = []
+        node.tp.sendv_many = \
+            lambda dests, rt, parts: sent.append((list(dests), rt, parts))
+        node.tp.send = lambda d, rt, pl=b"": sent.append(([d], rt, [pl]))
+        node.n_srv = 2          # pretend a peer so the bcast emits
+        node._bcast_views(7, blk, ts)
+        (dests, rt, parts), = sent
+        assert rt == "EPOCH_BLOB"
+        assert b"".join(bytes(p) for p in parts) == blob
+        assert not any(k.startswith("ctrl") for k in node.stats.counters)
+    finally:
+        node.n_srv = 1
+        node.close()
+
+
+def test_ctrl_off_knobs_value_identity():
+    """The state pin: the ROUTED scan driven with `static_knobs` is
+    value-identical to the unrouted `jit_run` on every db row, cc_state
+    leaf, pool leaf and stats counter — so the governor's fail-safe
+    (reverting to the static vector) really is the unrouted config,
+    and ctrl-off runs lose nothing by never routing."""
+    from deneva_tpu.cc.router import static_knobs
+    from deneva_tpu.engine import Engine
+    from deneva_tpu.workloads import get_workload
+    from deneva_tpu.workloads.ycsb import TABLE
+
+    cfg = ctl_cfg(ctrl=False)
+    eng = Engine(cfg, get_workload(cfg))
+    s0 = jax.device_get(eng.jit_run(eng.init_state(0), 8))
+    s1 = jax.device_get(eng.jit_run_ctrl(eng.init_state(0),
+                                         static_knobs(cfg), 8))
+    n = cfg.synth_table_size
+    np.testing.assert_array_equal(
+        np.asarray(s0.db[TABLE].columns["F0"])[:n],
+        np.asarray(s1.db[TABLE].columns["F0"])[:n])
+    for a, b in zip(jax.tree.leaves(s0.cc_state),
+                    jax.tree.leaves(s1.cc_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(s0.pool), jax.tree.leaves(s1.pool)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in s0.stats:
+        np.testing.assert_array_equal(np.asarray(s0.stats[k]),
+                                      np.asarray(s1.stats[k]), k)
+
+
+# ---- adaptive floor smoke ----------------------------------------------
+
+def _run_routed(eng, cfg, knob_fn, chunks=6, chunk=8):
+    """Run the routed scan chunkwise; knob_fn(ctl, state, epochs) maps
+    the post-chunk device stats to the NEXT chunk's knobs (None = keep).
+    One ENGINE (so one compiled program) serves every caller — cells
+    differ only in knob VALUES — so committed counts compare like for
+    like with zero recompiles."""
+    from deneva_tpu.cc.router import static_knobs
+
+    state = eng.init_state(0)
+    knobs = static_knobs(cfg)
+    ctl = Controller(cfg)
+    epochs = 0
+    for _ in range(chunks):
+        state = eng.jit_run_ctrl(state, knobs, chunk)
+        epochs += chunk
+        nxt = knob_fn(ctl, state, epochs)
+        if nxt is not None:
+            knobs = nxt
+    return int(jax.device_get(state.stats["total_txn_commit_cnt"]))
+
+
+def test_adaptive_floor_vs_best_static():
+    """Deterministic floor smoke (the full multi-phase frontier lives
+    in results/router): on a hot zipf-0.9 write-heavy stream the
+    adaptive loop — controller ticked on real device counter deltas,
+    always-healthy gaps — lands within the RAMP-AWARE floor of the
+    best static assignment run through the SAME compiled program for
+    the SAME epochs (the first decision applies after the baseline
+    tick, so 2 of the 6 chunks run the static cfg knobs by design),
+    and clears every non-best static decisively — the adaptation
+    claim the single-phase shape can make."""
+    from deneva_tpu.cc.router import CANDIDATES, knobs_from_decision
+    from deneva_tpu.engine import Engine
+    from deneva_tpu.workloads import get_workload
+
+    cfg = ctl_cfg(ctrl_confirm=1, ctrl_cooldown=0, audit=False,
+                  repair=False)
+    eng = Engine(cfg, get_workload(cfg))
+    prev = [None]
+
+    def adaptive(ctl, state, epochs):
+        dens = jax.device_get(state.stats["conflict_density"])
+        cur = np.asarray(dens).astype(np.int64)
+        last, prev[0] = prev[0], (cur, epochs)
+        if last is None:
+            return None
+        sig_ = CtrlSignals(epoch=epochs, epochs=epochs - last[1],
+                           dens=[int(x) for x in cur - last[0]],
+                           gap_us=1000)
+        d = ctl.decide(sig_)
+        assert d.gov == GOV_ARMED
+        return knobs_from_decision(cfg, d.assign, d.gshift,
+                                   d.repair_cap, d.audit_cadence)
+
+    got = _run_routed(eng, cfg, adaptive)
+    static = {}
+    for i, alg in enumerate(CANDIDATES):
+        kn = knobs_from_decision(cfg, [i], [0], cfg.repair_rounds,
+                                 max(1, cfg.audit_cadence))
+        static[alg.name] = _run_routed(eng, cfg, lambda *_, kn=kn: kn)
+    best = max(static.values())
+    assert best > 0, "static cells inert"
+    # ramp-aware floor: 2/6 chunks on the static OCC knobs before the
+    # first armed decision bound the ideal at ~(2*occ + 4*best)/6
+    assert got >= 0.8 * best, (got, static)
+    # decisive over both non-best statics: the controller found the
+    # hot-regime backend instead of averaging the frontier
+    for alg, val in static.items():
+        if val != best:
+            assert got > 2 * val, (alg, got, static)

@@ -226,6 +226,27 @@ def test_audit_gate_fires_on_unguarded_use():
         "\n".join(f.render() for f in findings)
 
 
+def test_ctrl_gate_fires_on_unguarded_use():
+    """The REAL ``ctrl`` GateSpec (runtime/gates.py) catches an
+    unguarded call into either ctrl home module (runtime/controller.py,
+    cc/router.py) and an unguarded deep use of the controller handle,
+    while accepting the guarded idioms the runtime uses (``cfg.ctrl``
+    at construction, ``self.ctl is not None``, the engine's ``knobs is
+    not None`` routing test, ``cfg.zipf_shift`` around the client's
+    staged ring) — the CI teeth behind the control plane's default-off
+    bit-identity contract."""
+    from deneva_tpu.runtime.gates import GATES
+
+    root = os.path.join(FIX, "gate_bad_ctrl")
+    tree = Tree(root, ["."])
+    findings = tree.filter(gateconsistency.check(
+        tree, gates={"ctrl": GATES["ctrl"]}, exempt=(),
+        escrow_funcs=(), escrow_home=(),
+        config_module="deneva_tpu/config.py", guarded=(), model={}))
+    assert _got(findings) == _expected(root), \
+        "\n".join(f.render() for f in findings)
+
+
 def test_gate_registry_matches_config():
     """Executable half of gate-registry-drift: every registered flag is
     a real Config field defaulting OFF, every wiremodel gate names a

@@ -607,6 +607,61 @@ def test_parse_metrics_forward_backward_compat(tmp_path):
     assert parse_file(str(old_log))["tput"] == 5
 
 
+def test_parse_ctrl_forward_backward_compat(tmp_path):
+    """[ctrl] lines (control-plane tentpole): one row per controller
+    boundary tick carrying BOTH the recorded signals and the decision
+    (the replay contract's whole input); old logs yield [], the new
+    lines perturb no other parser, the colon-joined per-partition
+    vectors come back as strings, and the "ctrl" timeline span lands
+    on the declared tid-7 track."""
+    from deneva_tpu.harness.parse import (parse_admission, parse_ctrl,
+                                          parse_file, parse_membership,
+                                          parse_metrics, parse_repair,
+                                          parse_replication)
+    from deneva_tpu.harness.timeline import parse_timeline
+
+    new_log = tmp_path / "ctrl.out"
+    new_log.write_text(
+        "# cfg node_cnt=2\n"
+        "[ctrl] node=0 seq=3 epoch=150 epochs=50 dens=120:4 fb=2 sv=9 "
+        "wit=0 slo=1 gap_us=81234 gov=armed heal=0 trips=1 "
+        "assign=2:0 gshift=0:2 cap=2 cad=4 qidx=1\n"
+        "[timeline] node=0 epoch=64 loop=1.0ms ctrl=0.1ms\n"
+        "[summary] total_runtime=2,tput=1800,txn_cnt=3600,"
+        "total_txn_commit_cnt=3600,ctrl_decisions=52,ctrl_trips=1\n")
+    rows = parse_ctrl(new_log.read_text().splitlines())
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["node"] == 0 and r["seq"] == 3 and r["epochs"] == 50
+    assert r["gov"] == "armed" and r["trips"] == 1 and r["qidx"] == 1
+    # per-partition vectors stay colon-joined strings (split to consume)
+    assert r["dens"] == "120:4" and r["assign"] == "2:0"
+    assert [int(x) for x in r["gshift"].split(":")] == [0, 2]
+    # the row round-trips through the controller's signal inverse
+    from deneva_tpu.runtime.controller import signals_of_row
+    sig = signals_of_row(r)
+    assert sig.dens == [120, 4] and sig.gap_us == 81234
+    assert sig.breaches == 1 and sig.witnesses == 0
+    row = parse_file(str(new_log))
+    assert row["ctrl_decisions"] == 52 and row["ctrl_trips"] == 1
+    # other parsers ignore the new lines entirely
+    text = new_log.read_text().splitlines()
+    assert parse_membership(text) == []
+    assert parse_replication(text) == []
+    assert parse_admission(text) == []
+    assert parse_repair(text) == []
+    assert parse_metrics(text) == []
+    assert len(parse_timeline(text)) == 1
+    from deneva_tpu.harness.timeline import CTRL_TRACK, SPAN_TRACK
+    assert SPAN_TRACK["ctrl"] is CTRL_TRACK
+    assert CTRL_TRACK.tid == 7
+    # old log: no ctrl lines -> [] and unchanged parsing
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_ctrl(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5
+
+
 def test_track_registry_covers_every_span_family():
     """The declared track registry (timeline.TRACKS) replaces the magic
     Chrome-trace tids: every tagged-line ledger family maps to exactly

@@ -156,12 +156,46 @@ def prom_dump(rows: list[dict]) -> str:
         gauge("conflict_density",
               "per-partition observed-conflict density (latest frame)",
               dens_samples)
+    ctrl_nodes = {n: f for n, f in sorted(latest.items())
+                  if f.get("ctrl_gov", 0.0) > 0}
+    if ctrl_nodes:
+        gauge("ctrl_gov", "controller governor (2=armed 1=static 0=off)",
+              [(f'node="{n}"', float(f.get("ctrl_gov", 0.0)))
+               for n, f in ctrl_nodes.items()])
+        gauge("ctrl_quota_idx", "controller admission quota-scale rung",
+              [(f'node="{n}"', float(f.get("ctrl_qidx", 0.0)))
+               for n, f in ctrl_nodes.items()])
+        gauge("ctrl_stale_trips", "governor trips to static on stale signals",
+              [(f'node="{n}"', float(f.get("ctrl_trips", 0.0)))
+               for n, f in ctrl_nodes.items()])
     counts: dict[str, int] = {}
     for w in watches:
         counts[str(w.get("kind"))] = counts.get(str(w.get("kind")), 0) + 1
     gauge("watch_events_total", "anomaly watchdog events by kind",
           [(f'kind="{k}"', float(v)) for k, v in sorted(counts.items())])
     return "\n".join(out) + "\n"
+
+
+def render_ctrl(rows: list[dict]) -> str:
+    """Controller panel: per-node governor state from the latest frame
+    carrying live ``ctrl_*`` counters (runtime/server._mb_emit,
+    ``ctrl=true``; gov encodes 0=off / 1=static / 2=armed).  Empty
+    string when every frame reads gov=0 — the panel only appears on
+    armed runs, so a ctrl-off stream renders byte-identically."""
+    frames, _ = split_rows(rows)
+    latest = {n: fr[-1] for n, fr in frames.items()
+              if fr and fr[-1].get("ctrl_gov", 0.0) > 0}
+    if not latest:
+        return ""
+    out = ["ctrl (feedback control plane):",
+           f"{'node':>4} {'gov':>7} {'quota_scale':>12} {'trips':>6}"]
+    for node in sorted(latest):
+        f = latest[node]
+        gov = "armed" if f.get("ctrl_gov", 0.0) >= 2 else "static"
+        scale = 0.8 ** int(f.get("ctrl_qidx", 0))
+        out.append(f"{node:>4} {gov:>7} {scale:>12.3f} "
+                   f"{int(f.get('ctrl_trips', 0)):>6}")
+    return "\n".join(out)
 
 
 def load_audit_dir(path: str) -> dict[int, list[dict]]:
@@ -256,7 +290,12 @@ def main(argv: list[str]) -> int:
             sys.stdout.write(prom_audit(aud))
         return 0
     if "--once" in argv:
-        print(render_table(read_metrics(path)))
+        rows = read_metrics(path)
+        print(render_table(rows))
+        ctrl = render_ctrl(rows)
+        if ctrl:
+            print()
+            print(ctrl)
         aud = load_audit_dir(pos[0])
         if aud:
             print()
@@ -269,6 +308,10 @@ def main(argv: list[str]) -> int:
             print(f"metrics bus  {path}  "
                   f"({len(rows)} records, ^C to quit)\n")
             print(render_table(rows))
+            ctrl = render_ctrl(rows)
+            if ctrl:
+                print()
+                print(ctrl)
             aud = load_audit_dir(pos[0])
             if aud:
                 print()

@@ -58,6 +58,22 @@
 #                                         occ-read-skip mutation is
 #                                         REJECTED with a cycle witness
 #                                         naming the mutated epoch)
+#   tools/smoke.sh ctrl                   control-plane gate:
+#                                         ctrl-off bit-identity tests
+#                                         (no controller object, static
+#                                         knobs ≡ legacy path) + the
+#                                         ctrl-shift-degrade chaos
+#                                         scenario (zipf 0→0.9 mid-run
+#                                         shift + flash crowd + an
+#                                         aggregator fault_kill: armed
+#                                         decisions adapt the backend
+#                                         map, the governor falls back
+#                                         to static on signal loss and
+#                                         re-engages after heal, every
+#                                         decision stream replays
+#                                         bit-for-bit, exactly-once +
+#                                         digest-vs-replay + audit
+#                                         certificate all green)
 #   tools/smoke.sh repair                 transaction-repair gate:
 #                                         repair-contention (zipf-0.9
 #                                         write-heavy OCC with repair on +
@@ -131,6 +147,17 @@ case "$SCEN" in
     T="${SMOKE_TIMEOUT_SECS:-${REPAIR_TIMEOUT_SECS:-600}}"
     run "$T" python -m deneva_tpu.harness.chaos repair-contention --quick
     ;;
+  ctrl)
+    # off-pin first (fast, in-process engine); then the shift/flash/
+    # kill scenario — it reuses the kill-one-server recovery machinery
+    # plus a governor trip + heal window, so partition-family budget
+    T="${SMOKE_TIMEOUT_SECS:-${CTRL_TIMEOUT_SECS:-900}}"
+    run "$T" python -m pytest \
+        "tests/test_ctrl.py::test_ctrl_off_wire_pin" \
+        "tests/test_ctrl.py::test_ctrl_off_knobs_value_identity" \
+        -q -p no:cacheprovider
+    run "$T" python -m deneva_tpu.harness.chaos ctrl --quick
+    ;;
   audit)
     # off-pin first (fast, loopback + in-process engine), then the
     # certify-clean / catch-the-mutation chaos pair
@@ -185,7 +212,7 @@ case "$SCEN" in
     fi
     ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|monitor|trace|lint> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|ctrl|monitor|trace|lint> [args...]" >&2
     exit 2
     ;;
 esac
