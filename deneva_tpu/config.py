@@ -573,6 +573,18 @@ class Config:
     #                                    > 0 for the flash process)
     arrival_flash_factor: float = 10.0  # flash: rate multiplier inside
     #                                     the burst window
+    loadgen_procs: int = 1         # open-loop generator FLEET: each client
+    #                                process spawns this many seeded
+    #                                generator workers (runtime/loadgen
+    #                                LoadFleet), each owning a disjoint
+    #                                lane-tag range and a disjoint tenant
+    #                                sub-range, their arrival schedules
+    #                                merged deterministically — offered
+    #                                load scales past one process's
+    #                                query-gen rate (the pod-scale
+    #                                driving side).  1 (default) keeps
+    #                                the single in-process generator and
+    #                                bit-identical wire bytes.
     zipf_shift: str = ""           # mid-run contention shift "THETA:AT_S":
     #                                the client pre-generates a SECOND
     #                                seeded query ring at zipf theta=THETA
@@ -1116,6 +1128,11 @@ class Config:
             _check(self.cc_alg not in (CCAlg.CALVIN, CCAlg.TPU_BATCH),
                    "deterministic backends coordinate via the merged-batch "
                    "sequencer exchange, not 2PC votes")
+            _check(self.device_parts == 1,
+                   "the VOTE protocol's per-epoch host round trip "
+                   "(prepare -> vote -> decide) does not compose with "
+                   "mesh-sharded epoch programs — use the merged "
+                   "sequencer exchange with device_parts > 1")
             _check(not self.ycsb_abort_mode,
                    "forced-abort sentinel is a merged-mode debug oracle")
         _check(self.repl_type in ("AP", "AA"),
@@ -1247,6 +1264,20 @@ class Config:
         else:
             _check(self.arrival_rate == 0.0,
                    "arrival_rate needs an arrival_process")
+        _check(self.loadgen_procs >= 1, "loadgen_procs must be >= 1")
+        if self.loadgen_procs > 1:
+            _check(self.arrival_process != "",
+                   "a loadgen fleet (loadgen_procs > 1) drives the "
+                   "open loop — arm an arrival_process")
+            _check(self.loadgen_procs <= 64,
+                   "loadgen_procs > 64 exceeds the per-client lane-tag "
+                   "budget (tag bits reserve 6 bits of generator lane)")
+            if self.tenant_cnt > 1:
+                _check(self.tenant_cnt >= self.loadgen_procs,
+                       "a loadgen fleet splits [0, tenant_cnt) into "
+                       "disjoint per-generator sub-ranges — tenant_cnt "
+                       "must be >= loadgen_procs so no generator's "
+                       "range is empty")
         if self.zipf_shift:
             self.zipf_shift_spec()      # raises on a malformed spec
             _check(self.workload == WorkloadKind.YCSB,
@@ -1292,6 +1323,11 @@ class Config:
         # live default) ----
         _check(self.metrics_cadence >= 1,
                "metrics_cadence must be >= 1 (1 frames every epoch)")
+        if self.metrics:
+            _check(self.device_parts == 1,
+                   "the metrics bus's conflict-density fold does not "
+                   "compose with multi-chip execution yet (sharded "
+                   "tables have no single bucket space to fold)")
         # ---- isolation audit gating (same discipline: the default
         # takes the pre-audit paths exactly; cadence/edges/buckets are
         # depth knobs with live defaults) ----

@@ -261,12 +261,14 @@ class Engine:
                 from deneva_tpu.cc import audit_mutate_verdict
                 verdict = audit_mutate_verdict(cfg, batch, inc, verdict,
                                                state.epoch)
-        if cfg.metrics and cfg.device_parts == 1:
+        if cfg.metrics:
             # metrics bus (runtime/metricsbus.py): accumulate the
             # per-partition observed-conflict density off the incidence
             # views (the sweep already materialized them; forwarding
-            # backends pay two bucket scatter-adds).  Multi-chip skips:
-            # the sharded tables have no single bucket space to fold.
+            # backends pay two bucket scatter-adds).  Multi-chip is
+            # pinned OUT by config.validate (sharded tables have no
+            # single bucket space to fold) — a validated error, not a
+            # silent skip, so an armed knob can never quietly no-op.
             owner = planned.get("owner",
                                 batch.keys % jnp.int32(max(cfg.part_cnt,
                                                            1)))
@@ -338,9 +340,10 @@ class Engine:
         # txns move abort -> commit (and release their slot like any
         # commit) before the pool update and the counters below ever
         # see them.  Gated exactly like the validate path it extends:
-        # sweep backend, NORMAL mode, single device.
+        # sweep backend, NORMAL mode (multi-chip is a config.validate
+        # error, never a silent skip here).
         if cfg.repair and cfg.mode == Mode.NORMAL and not forwarding \
-                and be.repair_rule is not None and cfg.device_parts == 1:
+                and be.repair_rule is not None:
             from deneva_tpu.engine.repair import run_repair
             # ts_base: the pool's reserved restamp space — the exact
             # stamp authority pool.update uses for abort restamps, so
@@ -359,8 +362,8 @@ class Engine:
         # bit-identical (tested).  The in-process engine keeps the stamp
         # tables + device counters; the sidecar export is the cluster
         # runtime's job (runtime/audit.py).
-        if cfg.audit and cfg.mode == Mode.NORMAL \
-                and cfg.device_parts == 1:
+        # (multi-chip is a config.validate error, never a silent skip)
+        if cfg.audit and cfg.mode == Mode.NORMAL:
             from deneva_tpu.cc import AUDIT_KEY, audit_observe
             order_vis = forwarding
             if forwarding:

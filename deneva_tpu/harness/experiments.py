@@ -419,6 +419,37 @@ def modes(quick: bool) -> list[Config]:
             for m in ("SIMPLE", "NOCC", "QRY_ONLY", "NORMAL")]
 
 
+def mesh_scaling(quick: bool) -> list[Config]:
+    """Pod-scale measured path (parallel/mesh.py): the SAME in-process
+    YCSB point swept over ``device_parts`` 1/2/4/8 — the mesh-sharded
+    executor (tables owner-major sharded, conflict matmul contracting
+    over the sharded bucket dim) as run_simulation's measured path, not
+    a dry run.  Commits/digests are bit-identical across the axis
+    (tests/test_mesh_cluster.py is the oracle); this sweep records what
+    the sharding COSTS or BUYS on the host it ran on.  On a single-core
+    CPU host the 8 mesh devices are virtual (forced host devices
+    time-slicing one core), so the sweep documents dispatch/collective
+    overhead, not chip scaling — see results/mesh_scaling/README.md for
+    the provenance of the checked-in artifact."""
+    import os
+    # the mesh needs >= 8 devices; on a CPU host they must be forced
+    # BEFORE jax initializes.  This import-time env nudge covers the
+    # harness CLI path (jax is imported lazily by run_point); if jax is
+    # already up with fewer devices, make_mesh fails loudly instead.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    base = Config(
+        synth_table_size=1 << 14, req_per_query=4, max_accesses=4,
+        epoch_batch=128, conflict_buckets=512, max_txn_in_flight=1024,
+        zipf_theta=0.6, warmup_secs=0.2 if quick else 0.5,
+        done_secs=0.5 if quick else 2.0)
+    parts = (1, 8) if quick else (1, 2, 4, 8)
+    return [base.replace(device_parts=d, cc_alg=CCAlg(a))
+            for d in parts for a in ("TPU_BATCH", "CALVIN")]
+
+
 experiment_map: dict[str, Callable[[bool], list[Config]]] = {
     "ycsb_scaling": ycsb_scaling,
     "ycsb_skew": ycsb_skew,
@@ -435,6 +466,7 @@ experiment_map: dict[str, Callable[[bool], list[Config]]] = {
     "tpcc_order_index": tpcc_order_index,
     "pps_scaling": pps_scaling,
     "cluster_scaling": cluster_scaling,
+    "mesh_scaling": mesh_scaling,
     "network_sweep": network_sweep,
     "geo_quorum": geo_quorum,
     "overload": overload,

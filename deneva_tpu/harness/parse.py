@@ -27,7 +27,7 @@ SHORTNAMES = {
     "access_perc": "A", "data_perc": "D", "skew_method": "SK",
     "max_txn_in_flight": "TIF", "num_wh": "WH",
     "perc_payment": "PAY", "isolation_level": "ISO",
-    "epoch_batch": "EB", "load_rate": "LR",
+    "epoch_batch": "EB", "load_rate": "LR", "device_parts": "DP",
 }
 
 _DEFAULT = Config()
@@ -298,6 +298,27 @@ def parse_ctrl(lines) -> list[dict[str, Any]]:
     ``parse_membership`` through ``parse_audit`` (tested in
     tests/test_harness.py)."""
     return _parse_tagged(lines, _CTRL)
+
+
+_MESH = re.compile(r"\[mesh\] (.*)")
+
+
+def parse_mesh(lines) -> list[dict[str, Any]]:
+    """Per-node ``[mesh]`` lines (parallel/mesh.mesh_line via the server
+    summary path, emitted only when ``device_parts > 1``) -> [{node,
+    shards, a2a_bytes, prefetch_overlap, groups}].  The pod-scale
+    measured path's health ledger: ``shards`` is the mesh width the
+    epoch program actually ran at, ``a2a_bytes`` the static per-epoch
+    ``all_to_all`` estimate under the owner-exchange plan (0 = the
+    replicated fallback plan), ``prefetch_overlap`` the fraction of
+    verdict-plane d2h prefetches already complete when the retire
+    worker asked (1.0 = fully overlapped with device execution),
+    ``groups`` the retired-group count behind that ratio.  Logs
+    predating the mesh path — and every single-device run — yield []
+    — and every other parser here ignores ``[mesh]`` lines — the same
+    forward/backward-compat contract as ``parse_membership`` through
+    ``parse_ctrl`` (tested in tests/test_harness.py)."""
+    return _parse_tagged(lines, _MESH)
 
 
 def cfg_header(cfg: Config) -> str:

@@ -84,10 +84,17 @@ AUDIT_TRACK = Track(6, "audit", frozenset(("audit",)))
 # the audit sidecar span, on its own declared track so controller
 # overhead is visible as a track instead of folding into the phase clock
 CTRL_TRACK = Track(7, "ctrl", frozenset(("ctrl",)))
+# pod-scale mesh path (parallel/mesh.py via runtime/server.py): the
+# prefetch-wait ledger — per group, the serial remainder of the verdict-
+# plane d2h the overlapped prefetch failed to hide behind device
+# execution (0 = fully overlapped, nothing emitted).  A latency ledger
+# like audit/ctrl, on its own declared track
+MESH_TRACK = Track(8, "mesh", frozenset(("mesh_prefetch",)))
 
 TRACKS: tuple[Track, ...] = (PHASE_TRACK, REPLICATION_TRACK,
                              ADMISSION_TRACK, FENCING_TRACK, TXN_TRACK,
-                             CRITPATH_TRACK, AUDIT_TRACK, CTRL_TRACK)
+                             CRITPATH_TRACK, AUDIT_TRACK, CTRL_TRACK,
+                             MESH_TRACK)
 
 # span name -> owning track for the [timeline] ledger families
 SPAN_TRACK: dict[str, Track] = {name: t for t in TRACKS

@@ -86,7 +86,8 @@ def state_shardings(mesh: Mesh, state: Any):
     Tables marked ``mc_replicated`` (read-only ITEM/USES/SUPPLIES) keep a
     full copy per device, like the reference's per-node copies."""
     repl_tables = set()
-    db = getattr(state, "db", None)
+    db = state.get("db") if isinstance(state, dict) \
+        else getattr(state, "db", None)
     if isinstance(db, dict):
         repl_tables = {name for name, t in db.items()
                        if getattr(t, "mc_replicated", False)}
@@ -103,6 +104,24 @@ def state_shardings(mesh: Mesh, state: Any):
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(spec, state)
+
+
+def a2a_bytes_per_epoch(cfg, b: int) -> int:
+    """Static per-epoch estimate of ``all_to_all`` traffic under the
+    sharded owner-exchange plan: each of D shards ships its
+    ``[D, pair_cap]`` key/rank/write lanes (int32+int32+bool = 9 B per
+    lane) to every peer.  0 when capacity planning is off — the generic
+    ``mc_execute`` path exchanges only psum partials, not lanes."""
+    from ..ops.forward import mc_pair_cap
+    d = cfg.device_parts
+    cap = mc_pair_cap(b, cfg.max_accesses, d, cfg.mc_plan_capacity)
+    return d * d * cap * 9
+
+
+def mesh_line(node: int, fields: dict) -> str:
+    """One `[mesh]` summary satellite line (harness.parse.parse_mesh)."""
+    kv = " ".join(f"{k}={v}" for k, v in fields.items())
+    return f"[mesh] node={node} {kv}"
 
 
 def make_sharded_run(engine, mesh: Mesh):

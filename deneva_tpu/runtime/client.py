@@ -76,8 +76,21 @@ class ClientNode:
         # bits, admission=false means no NACK ever arrives. ----
         self._adm = cfg.admission
         self._arrival = None
+        self._fleet = None
+        self._fleet_credits = None
         self._flash_end_us: float | None = None
-        if cfg.arrival_process:
+        if cfg.loadgen_procs > 1:
+            # pod-scale fleet: N generator processes pace disjoint
+            # lane-tag sub-rings and tenant sub-ranges; the coordinator
+            # (this node) keeps mirror schedules for the merged target.
+            # LoadFleet speaks the ArrivalSchedule interface, so every
+            # arrival-gated path below is shared verbatim.
+            from deneva_tpu.runtime.loadgen import FleetCredits, LoadFleet
+            self._fleet = LoadFleet(cfg, cfg.node_id, TAG_RING,
+                                    cfg.client_batch_size)
+            self._fleet_credits = FleetCredits(cfg.loadgen_procs, TAG_RING)
+            self._arrival = self._fleet
+        elif cfg.arrival_process:
             from deneva_tpu.runtime.loadgen import ArrivalSchedule
             self._arrival = ArrivalSchedule(cfg, cfg.node_id)
         self._ledger = None
@@ -215,6 +228,19 @@ class ClientNode:
                 f"{self.n_srv} servers) and the servers' pipeline window "
                 f"({window}); shrink max_txn_in_flight or the pipeline "
                 "depth")
+        if self._fleet is not None:
+            # fleet mode shrinks the reuse horizon: tags cycle within
+            # one generator's sub-ring, so the whole outstanding window
+            # must fit a single lane's span
+            from deneva_tpu.runtime.loadgen import FLEET_LANE_BITS
+            span = TAG_RING >> FLEET_LANE_BITS
+            if total_cap >= span or window >= span:
+                raise ValueError(
+                    f"fleet lane sub-ring ({span}) must exceed the "
+                    f"total outstanding cap ({total_cap}) and the "
+                    f"pipeline window ({window}): tags reuse within one "
+                    "generator's range — shrink max_txn_in_flight or "
+                    "the pipeline depth")
         self.send_us = np.zeros(TAG_RING, np.int64)   # tag -> send time
         self.next_tag = 0
         self.stats = Stats()
@@ -269,15 +295,20 @@ class ClientNode:
         # tenant so acks feed tenant{t}_latency percentiles and the
         # fairness counters.  tenant_cnt=1 (default) builds none of it.
         self.ring_tenants: list[np.ndarray] | None = None
-        if cfg.tenant_cnt > 1:
-            from deneva_tpu.runtime.loadgen import tenant_column
-            w = np.asarray(cfg.tenant_weights_spec())
-            trng = np.random.default_rng(
-                (cfg.seed + 15485863 * cfg.node_id) & 0x7FFFFFFF)
-            self.ring_tenants = [tenant_column(trng, w, self.chunk)
-                                 for _ in range(n_pregen)]
+        self._tenant_on = cfg.tenant_cnt > 1
+        if self._tenant_on:
             self.tag_tenant = np.zeros(TAG_RING, np.uint8)
             self._tenant_sent = np.zeros(cfg.tenant_cnt, np.int64)
+            if self._fleet is None:
+                # fleet mode draws tenant columns in the generator
+                # processes (disjoint sub-ranges); single-process mode
+                # keeps the seeded per-ring-block columns
+                from deneva_tpu.runtime.loadgen import tenant_column
+                w = np.asarray(cfg.tenant_weights_spec())
+                trng = np.random.default_rng(
+                    (cfg.seed + 15485863 * cfg.node_id) & 0x7FFFFFFF)
+                self.ring_tenants = [tenant_column(trng, w, self.chunk)
+                                     for _ in range(n_pregen)]
 
     # ------------------------------------------------------------------
     def _route(self, src: int, rtype: str, payload: bytes,
@@ -339,7 +370,11 @@ class ClientNode:
                     m = tt == t
                     self.stats.arr(
                         f"{self.type_names[t]}_latency").extend(vals[m])
-            if self.ring_tenants is not None:
+            if self._fleet_credits is not None:
+                # fleet accounting: only non-NACKed tags still hold a
+                # credit (same rule as the inflight release above)
+                self._fleet_credits.release(rel)
+            if self._tenant_on:
                 # per-tenant latency families (overload tier): the
                 # aggressor/fairness invariants compare these — samples
                 # go ONLY into tenant arrays here, the combined series
@@ -375,6 +410,10 @@ class ClientNode:
                 )[: self.n_srv]
             else:
                 self.inflight[src] -= len(tags)
+            if self._fleet_credits is not None:
+                # the NACK releases the lane's credit exactly once
+                # (the backoff re-entry recharges it)
+                self._fleet_credits.nack(tags)
             if self.tel is not None:
                 # shed lifecycle hop (aux = the server's retry-after
                 # hint; the waterfall's "shed" verdict class keys on it)
@@ -526,6 +565,8 @@ class ClientNode:
                 self.ring_pos = (self.ring_pos + 1) % len(self.ring)
                 self._nacked[pslot] = False
                 self.inflight[srv] += n
+                if self._fleet_credits is not None:
+                    self._fleet_credits.charge(part)   # re-entry recharge
                 if self._tag_srv is not None:
                     self._tag_srv[pslot] = srv
                 self.tp.sendv(srv, "CL_QRY_BATCH",
@@ -632,6 +673,8 @@ class ClientNode:
         # LOAD_RATE budget (reference client_thread.cpp:35-41,70-91)
         rate = cfg.load_rate / max(cfg.client_node_cnt, 1)
         t_start = time.monotonic()
+        if self._fleet is not None:
+            self._fleet.go()     # start every generator lane's clock
         if self._arrival is not None:
             fe = self._arrival.flash_end()
             if fe is not None:
@@ -690,21 +733,33 @@ class ClientNode:
                     if budget <= 0:
                         break
                     n = min(n, budget)
+                tcol = None
+                if self._fleet is not None:
+                    # fleet mode: tags + tenant columns stream from the
+                    # generator processes (disjoint lane sub-rings);
+                    # nothing buffered means nothing is due yet
+                    fb = self._fleet.take(n)
+                    if fb is None:
+                        break
+                    tags, tcol = fb
+                    n = len(tags)
                 blk = self.ring[self.ring_pos]
                 blk_types = self.ring_types[self.ring_pos]
                 self.ring_pos = (self.ring_pos + 1) % len(self.ring)
                 now = time.monotonic_ns() // 1000
-                tags = (iota[:n] + self.next_tag) % TAG_RING
-                self.next_tag = int(tags[-1]) + 1
+                if self._fleet is None:
+                    tags = (iota[:n] + self.next_tag) % TAG_RING
+                    self.next_tag = int(tags[-1]) + 1
                 self.send_us[tags] = now
                 self.tag_type[tags] = blk_types[:n]
                 wtags = tags
-                if self.ring_tenants is not None:
+                if self._tenant_on:
                     # tenant ids ride tag bits 24..31; the lane (low
                     # bits) keeps indexing every per-tag ring below
                     from deneva_tpu.runtime.loadgen import pack_tenant
-                    tcol = self.ring_tenants[
-                        (self.ring_pos - 1) % len(self.ring)][:n]
+                    if tcol is None:
+                        tcol = self.ring_tenants[
+                            (self.ring_pos - 1) % len(self.ring)][:n]
                     wtags = pack_tenant(tags, tcol)
                     self.tag_tenant[tags] = tcol
                     self._tenant_sent += np.bincount(
@@ -738,6 +793,8 @@ class ClientNode:
                             blk.keys[:n], blk.types[:n], blk.scalars[:n],
                             wtags)))
                 self.inflight[srv] += n
+                if self._fleet_credits is not None:
+                    self._fleet_credits.charge(tags)
                 sent_total += n
                 if backlog is not None:
                     backlog -= n
@@ -795,7 +852,20 @@ class ClientNode:
             st.set("backlog_max", float(self._backlog_max))
             if self._flash_end_us is not None:
                 st.set("post_flash_ack_cnt", float(self._post_flash_acks))
-        if self.ring_tenants is not None:
+        if self._fleet_credits is not None:
+            # per-lane ledger + the exactly-once invariant counters
+            # (double_* must be 0 — the freshness filters upstream are
+            # the only legal dedup point)
+            fc = self._fleet_credits
+            for g in range(fc.n):
+                st.set(f"fleetg{g}_sent_cnt", float(fc.sent[g]))
+                st.set(f"fleetg{g}_acked_cnt", float(fc.acked[g]))
+                st.set(f"fleetg{g}_nacked_cnt", float(fc.nacked[g]))
+            st.set("fleet_procs", float(fc.n))
+            st.set("fleet_outstanding_cnt", float(fc.outstanding().sum()))
+            st.set("fleet_double_release_cnt",
+                   float(fc.double_charge + fc.double_release))
+        if self._tenant_on:
             for t in range(len(self._tenant_sent)):
                 st.set(f"tenant{t}_sent_cnt",
                        float(self._tenant_sent[t]))
@@ -831,4 +901,6 @@ class ClientNode:
         return st
 
     def close(self) -> None:
+        if self._fleet is not None:
+            self._fleet.close()
         self.tp.close()

@@ -111,12 +111,17 @@ GATES: dict[str, GateSpec] = {s.name: s for s in (
         # the overload tier: server-side admission control + the
         # client's open-loop load generation / backoff ledger /
         # per-tenant tag packing (tenant_cnt > 1 arms the tag bits)
+        # loadgen_procs is the fleet depth knob (default 1 = single
+        # in-process generator, bit-identical): `loadgen_procs > 1`
+        # gates the LoadFleet/FleetCredits paths, _tenant_on is the
+        # client's cached tenant boolean (tenant_cnt > 1)
         flags=("admission", "arrival_process"),
         guards=("admission", "_adm", "arrival_process", "adm",
-                "_nacked", "tenant_cnt"),
+                "_nacked", "tenant_cnt", "loadgen_procs", "_tenant_on"),
         home=("deneva_tpu/runtime/admission.py",
               "deneva_tpu/runtime/loadgen.py"),
-        use_attrs=("adm", "_arrival", "_ledger", "ring_tenants"),
+        use_attrs=("adm", "_arrival", "_ledger", "ring_tenants",
+                   "_fleet", "_fleet_credits"),
     ),
     GateSpec(
         "repair",

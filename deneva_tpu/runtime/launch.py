@@ -143,11 +143,14 @@ def run_cluster(cfg: Config, platform: str | None = "cpu",
             daemon=True))
     cl_platform = client_platform if client_platform is not None else platform
     for c in range(n_cl):
+        # a fleet-armed client must parent the loadgen worker processes,
+        # and daemonic processes cannot have children; the finally block
+        # below terminates it explicitly either way
         procs.append(ctx.Process(
             target=_client_main,
             args=(cfg.replace(node_id=n_srv + c, part_cnt=n_srv), endpoints,
                   cl_platform, q),
-            daemon=True))
+            daemon=cfg.loadgen_procs <= 1))
     for r in range(n_repl):
         procs.append(ctx.Process(
             target=_replica_main,

@@ -24,12 +24,21 @@ NACKed tag re-enters after ``max(retry_after, base * 2^(attempt-1))``
 jittered +/-50% (seeded) and capped — retry-after is a FLOOR (the
 server knows when the bucket refills), the exponential is the pressure
 valve when NACKs repeat.
+
+``LoadFleet`` (pod-scale tier, ``loadgen_procs > 1``) scales the open
+loop past one process: a coordinator spawns N seeded generator
+processes with disjoint lane-tag sub-rings and tenant sub-ranges, and
+``FleetCredits`` keeps the per-generator inflight accounting exactly
+once under the same NACK protocol.  See the fleet section below.
 """
 
 from __future__ import annotations
 
 import heapq
 import math
+import queue as _queue
+import time as _time
+from collections import deque
 
 import numpy as np
 
@@ -214,3 +223,296 @@ class BackoffLedger:
 
     def next_ready_us(self) -> int | None:
         return self._heap[0][0] if self._heap else None
+
+
+# ---------------------------------------------------------------------------
+# Multi-process client fleet (pod-scale tier, ``Config.loadgen_procs > 1``).
+#
+# One client process cannot offer millions of open transactions to an
+# 8-device server: arrival pacing, tenant draws and tag bookkeeping are
+# serial Python.  The fleet splits one client node's open loop across N
+# generator PROCESSES — a coordinator (the ClientNode itself) owns the
+# transport, the inflight throttle and every exactly-once repair path,
+# while each generator paces ``1/N`` of the node's arrival schedule under
+# its own seed and streams ready-to-send (tags, tenants) blocks over a
+# queue.  Ranges are disjoint by construction:
+#
+# * tags — the top FLEET_LANE_BITS of the lane ring are the generator id,
+#   so generator ``g`` owns the contiguous sub-ring
+#   ``[g * span, (g+1) * span)`` with ``span = ring >> FLEET_LANE_BITS``
+#   and every exactly-once bitmap (unacked / nacked / credits) stays
+#   collision-free across generators;
+# * tenants — ``[0, tenant_cnt)`` splits into contiguous per-generator
+#   sub-ranges (validate requires ``tenant_cnt >= loadgen_procs`` when
+#   both are armed), weights renormalized within each.
+#
+# Determinism: a generator's tag sequence, tenant draws and arrival
+# schedule are pure functions of ``(cfg, node_id, gid)`` — `FleetGen` is
+# that pure function, runnable inline as the unit-test reference for what
+# a worker process emits.  Wall-clock interleaving ACROSS generators is
+# the one nondeterministic thing (that is the point of an open loop);
+# the merged cumulative target ``LoadFleet.target`` — the backlog
+# accounting the stats report — is the deterministic sum of the per-lane
+# schedules, mirrored coordinator-side from the same seeds.
+
+FLEET_LANE_BITS = 6          # generator id bits carved from the lane ring
+
+
+def fleet_tag_range(ring: int, gid: int) -> tuple[int, int]:
+    """Generator ``gid``'s disjoint lane-tag sub-ring ``[lo, hi)``."""
+    span = ring >> FLEET_LANE_BITS
+    return gid * span, (gid + 1) * span
+
+
+def fleet_gen_of(ring: int, tags: np.ndarray) -> np.ndarray:
+    """Owning generator id of each wire tag (inverse of the sub-ring
+    layout; tenant/client-id high bits are stripped first)."""
+    return (np.asarray(tags, np.int64) % ring) // (ring >> FLEET_LANE_BITS)
+
+
+def fleet_tenant_range(tenant_cnt: int, n_procs: int,
+                       gid: int) -> tuple[int, int]:
+    """Generator ``gid``'s tenant sub-range ``[lo, hi)``: contiguous,
+    disjoint, jointly covering ``[0, tenant_cnt)``.  Non-empty for every
+    generator because validate pins ``tenant_cnt >= loadgen_procs`` when
+    both tiers are armed; with tenants off everyone gets ``[0, 1)``."""
+    if tenant_cnt <= 1:
+        return 0, 1
+    return ((gid * tenant_cnt) // n_procs,
+            ((gid + 1) * tenant_cnt) // n_procs)
+
+
+def _fleet_gen_cfg(cfg: Config, gid: int) -> Config:
+    """The per-generator schedule config: the node's arrival rate split
+    evenly across the fleet, seed folded per generator lane (so each
+    lane's Poisson gaps and tenant draws are independent but
+    reproducible)."""
+    return cfg.replace(
+        arrival_rate=cfg.arrival_rate / cfg.loadgen_procs,
+        seed=cfg.seed + 15485867 * (gid + 1))
+
+
+class FleetGen:
+    """One generator lane: a seeded arrival schedule at ``rate / N``
+    plus this lane's tag sub-ring and tenant sub-range.  Everything it
+    emits is a pure function of ``(cfg, node_id, gid)`` — the worker
+    process body is a thin pacing loop around `take`, and the unit
+    tests replay this class inline as the oracle for worker output."""
+
+    def __init__(self, cfg: Config, node_id: int, gid: int, ring: int):
+        self.gid = gid
+        self.sched = ArrivalSchedule(_fleet_gen_cfg(cfg, gid), node_id)
+        self.tag_lo, self.tag_hi = fleet_tag_range(ring, gid)
+        self.span = self.tag_hi - self.tag_lo
+        self.t_lo, self.t_hi = fleet_tenant_range(
+            cfg.tenant_cnt, cfg.loadgen_procs, gid)
+        self._tenant_on = cfg.tenant_cnt > 1
+        if self._tenant_on:
+            w = np.asarray(cfg.tenant_weights_spec(), np.float64)
+            sub = w[self.t_lo:self.t_hi]
+            self._w = sub / sub.sum()
+            self._trng = np.random.default_rng(
+                (cfg.seed + 15485863 * node_id + 32452843 * (gid + 1))
+                & 0x7FFFFFFF)
+        self._seq = 0            # tag cursor within the sub-ring
+        self.emitted = 0
+
+    def take(self, t: float, max_n: int):
+        """Up to ``max_n`` arrivals due by elapsed ``t`` as a
+        ``(tags, tenants)`` block; None when fewer than 64 are due
+        (sub-message dribble is never worth framing — the same floor
+        the client's send loop applies)."""
+        due = self.sched.target(t) - self.emitted
+        if due < 64:
+            return None
+        n = min(due, max_n)
+        tags = (self.tag_lo
+                + (self._seq + np.arange(n, dtype=np.int64)) % self.span)
+        self._seq = (self._seq + n) % self.span
+        self.emitted += n
+        tenants = None
+        if self._tenant_on:
+            tenants = (self.t_lo
+                       + tenant_column(self._trng, self._w, n)
+                       ).astype(np.uint8)
+        return tags, tenants
+
+
+def _fleet_worker(cfg: Config, node_id: int, gid: int, ring: int,
+                  chunk: int, q, go, stop) -> None:
+    """Generator process body: wait for the coordinator's go signal
+    (set when the client clears the INIT barrier, so every lane's
+    elapsed clock starts with the run), then pace this lane's schedule
+    and stream blocks with queue backpressure.  Imports stay
+    numpy-only — a worker never touches jax or the transport."""
+    gen = FleetGen(cfg, node_id, gid, ring)
+    if not go.wait(timeout=300.0):
+        return
+    t0 = _time.monotonic()
+    pending = None
+    while not stop.is_set():
+        if pending is None:
+            blk = gen.take(_time.monotonic() - t0, chunk)
+            if blk is None:
+                _time.sleep(0.002)
+                continue
+            pending = (gid, blk[0], blk[1])
+        try:
+            q.put(pending, timeout=0.05)
+            pending = None
+        except _queue.Full:
+            continue          # re-check stop; backpressure paces us
+
+
+class LoadFleet:
+    """Coordinator half of the fleet: spawns one generator process per
+    lane and exposes the ArrivalSchedule interface (``target`` /
+    ``flash_end``) over the merged schedule, so every arrival-gated
+    client path (backlog stats, flash recovery) is shared verbatim.
+
+    ``target`` is computed from coordinator-side MIRROR schedules built
+    from the same per-lane seeds the workers use — deterministic and
+    queue-free.  ``take`` hands the send loop ready blocks in worker
+    arrival order, splitting the head block when the inflight budget is
+    smaller.  ``start=False`` builds the mirrors only (unit tests)."""
+
+    def __init__(self, cfg: Config, node_id: int, ring: int, chunk: int,
+                 start: bool = True):
+        self.n = cfg.loadgen_procs
+        self.ring = ring
+        self._scheds = [ArrivalSchedule(_fleet_gen_cfg(cfg, g), node_id)
+                        for g in range(self.n)]
+        self._buf: deque = deque()
+        self._procs: list = []
+        self._q = None
+        if start:
+            import multiprocessing as mp
+            # spawn, not fork: the client's transport threads are
+            # already running and a forked worker would inherit them
+            # mid-flight; workers re-import only numpy + this module
+            ctx = mp.get_context("spawn")
+            self._q = ctx.Queue(maxsize=4 * self.n)
+            self._go = ctx.Event()
+            self._stop = ctx.Event()
+            for g in range(self.n):
+                p = ctx.Process(
+                    target=_fleet_worker,
+                    args=(cfg, node_id, g, ring, chunk, self._q,
+                          self._go, self._stop),
+                    daemon=True)
+                p.start()
+                self._procs.append(p)
+
+    # -- ArrivalSchedule interface (the client's arrival-gated paths) --
+    def target(self, t: float) -> int:
+        """Merged cumulative arrival target: the deterministic sum of
+        the per-lane schedules (same seeds as the workers)."""
+        return sum(s.target(t) for s in self._scheds)
+
+    def flash_end(self) -> float | None:
+        return self._scheds[0].flash_end()
+
+    # ------------------------------------------------------------------
+    def go(self) -> None:
+        """Start every lane's elapsed clock (call once, post-barrier)."""
+        if self._procs:
+            self._go.set()
+
+    def take(self, max_n: int):
+        """Up to ``max_n`` merged arrivals as ``(tags, tenants)``;
+        None when no worker block is ready (the open loop's 'nothing
+        due yet')."""
+        if self._q is not None:
+            while True:
+                try:
+                    self._buf.append(self._q.get_nowait())
+                except _queue.Empty:
+                    break
+        if not self._buf:
+            return None
+        gid, tags, ten = self._buf[0]
+        if len(tags) <= max_n:
+            self._buf.popleft()
+            return tags, ten
+        self._buf[0] = (gid, tags[max_n:],
+                        None if ten is None else ten[max_n:])
+        return tags[:max_n], None if ten is None else ten[:max_n]
+
+    def close(self) -> None:
+        if not self._procs:
+            return
+        self._stop.set()
+        self._go.set()       # a lane still waiting on go must exit too
+        for _ in range(16 * self.n):   # unblock backpressured put()s
+            try:
+                self._q.get_nowait()
+            except _queue.Empty:
+                break
+        for p in self._procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+        self._q.close()
+        self._procs = []
+
+
+class FleetCredits:
+    """Exactly-once per-generator credit ledger (the fleet's half of
+    the ADMIT_NACK accounting): every outstanding tag holds exactly one
+    credit charged to its owning lane, the FIRST of {ack, NACK}
+    releases it, and duplicates are counted — never applied.  The
+    client calls this AFTER its freshness filters, so the dup counters
+    double as an invariant check: they must stay 0 on a healthy run
+    (`fleet_double_release_cnt` in the summary)."""
+
+    def __init__(self, n_procs: int, ring: int):
+        self.n = n_procs
+        self.ring = ring
+        self._span = ring >> FLEET_LANE_BITS
+        self._held = np.zeros(ring, bool)
+        self.sent = np.zeros(n_procs, np.int64)
+        self.acked = np.zeros(n_procs, np.int64)
+        self.nacked = np.zeros(n_procs, np.int64)
+        self.double_charge = 0
+        self.double_release = 0
+
+    def _gen(self, slot: np.ndarray) -> np.ndarray:
+        # foreign tags (beyond lane n-1's sub-ring) cannot occur on the
+        # client's own send path; clip keeps the bincount safe anyway
+        return np.minimum(slot // self._span, self.n - 1)
+
+    def charge(self, tags: np.ndarray) -> int:
+        """A send (first offer or backoff re-entry) charges one credit
+        per tag to its lane; an already-held tag is a double charge."""
+        slot = np.asarray(tags, np.int64) % self.ring
+        dup = self._held[slot]
+        if dup.any():
+            self.double_charge += int(dup.sum())
+            slot = slot[~dup]
+        self._held[slot] = True
+        self.sent += np.bincount(self._gen(slot), minlength=self.n)
+        return len(slot)
+
+    def _release(self, tags: np.ndarray, into: np.ndarray) -> int:
+        slot = np.asarray(tags, np.int64) % self.ring
+        ok = self._held[slot]
+        if not ok.all():
+            self.double_release += int((~ok).sum())
+            slot = slot[ok]
+        self._held[slot] = False
+        into += np.bincount(self._gen(slot), minlength=self.n)
+        return len(slot)
+
+    def release(self, tags: np.ndarray) -> int:
+        """First ack retires the tag's credit into its lane's acked."""
+        return self._release(tags, self.acked)
+
+    def nack(self, tags: np.ndarray) -> int:
+        """ADMIT_NACK releases the credit too (the backoff re-entry
+        recharges it); a NACK for an unheld tag is a duplicate."""
+        return self._release(tags, self.nacked)
+
+    def outstanding(self) -> np.ndarray:
+        """Per-lane credits currently held; ``sent - acked - nacked``
+        by construction, and never negative."""
+        return self.sent - self.acked - self.nacked

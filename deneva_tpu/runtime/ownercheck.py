@@ -129,6 +129,14 @@ OWNER: dict[str, str] = {
     "_plan_sent": DISPATCH, "_rebalance_cnt": DISPATCH,
     "_rows_in": DISPATCH, "_rows_out": DISPATCH,
     "_cutover_stall_ms": DISPATCH, "_redirects": DISPATCH,
+    # pod-scale mesh path (parallel/mesh.py): the mesh handle, lazily
+    # imported module and feed sharding are stamped in __init__ and only
+    # read afterwards; the prefetch-overlap counters and wait ledger are
+    # bumped in _retire, which runs on the dispatch thread (the retire
+    # WORKER's body is _prefetch_retire, which never touches them)
+    "mesh": DISPATCH, "_mesh_mod": DISPATCH, "_feed_sharding": DISPATCH,
+    "_prefetch_polls": DISPATCH, "_prefetch_hits": DISPATCH,
+    "_prefetch_wait_s": DISPATCH,
     # internally synchronized / thread-safe objects
     "tp": SHARED,            # native transport: MPMC queues
     "logger": SHARED,        # EpochLogger: queue + writer thread

@@ -1,0 +1,19 @@
+"""Fixture config: the real audit GateSpec's flags, default OFF (the
+registry drift check cross-parses this module), plus the device_parts
+knob.  config.py is EXEMPT from gate-device-pin by construction — a
+validate() pin is exactly where a multi-chip compatibility constraint
+belongs, erroring out loud instead of silently changing the measured
+path."""
+
+
+class Config:
+    audit: bool = False
+    audit_mutate: bool = False
+    device_parts: int = 1
+    node_cnt: int = 1
+
+    def validate(self):
+        # the SANCTIONED home for a pin: refuse, don't silently drop
+        if self.audit_mutate and self.device_parts > 1:
+            raise ValueError("audit_mutate is single-device only")
+        return self

@@ -1,0 +1,38 @@
+"""Seeded graftlint violation: a gate guard conjoined with a
+device_parts comparison — the silent single-device pin that makes a
+default-off subsystem vanish on the mesh-sharded measured path with no
+error (gate-device-pin).  The legal shapes beside it must stay silent:
+a bare device_parts branch (the measured-path route), a non-gate
+conjunction (a workload layout choice), and config.py's validate()
+pin (the sanctioned home, exercised via the fixture config module)."""
+
+
+class ServerFx:
+    def __init__(self, cfg):
+        self.cfg = cfg
+
+    def ok_mesh_route(self, cfg):
+        # a bare device_parts branch routes the measured path: legal
+        if cfg.device_parts > 1:
+            return "mesh"
+        return "single"
+
+    def ok_non_gate_conjunction(self, cfg):
+        # the workload MVCC layout idiom: cc_alg is not a gate guard,
+        # so this layout choice is not a subsystem pin
+        if cfg.cc_alg == "MVCC" and cfg.device_parts == 1:
+            return "version-ring"
+        return "flat"
+
+    def bad_silent_pin(self, cfg):
+        # audit silently vanishes the moment device_parts > 1 — the
+        # pin belongs in config.validate, where it refuses out loud
+        if cfg.audit and cfg.device_parts == 1:  # EXPECT[gate-device-pin]
+            return "audited"
+        return "un-audited"
+
+    def bad_negated_pin(self, cfg):
+        # same pin spelled through `not`: still silent, still wrong
+        if not cfg.device_parts > 1 and cfg.audit:  # EXPECT[gate-device-pin]
+            return "audited"
+        return "un-audited"

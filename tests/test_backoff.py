@@ -122,6 +122,9 @@ def _mini_client(n_srv=2, fault_mode=False, chunk=64):
     c.tag_type = np.zeros(TAG_RING, np.uint8)
     c.type_names = ["txn"]
     c.ring_tenants = None
+    c._tenant_on = False
+    c._fleet = None
+    c._fleet_credits = None
     c.chunk = chunk
     c.ring = [wire.QueryBlock(
         keys=np.zeros((chunk, 2), np.int32),

@@ -247,6 +247,25 @@ def test_ctrl_gate_fires_on_unguarded_use():
         "\n".join(f.render() for f in findings)
 
 
+def test_device_pin_gate_fires_on_silent_pin():
+    """gate-device-pin: conjoining the REAL ``audit`` gate's guard with
+    a ``device_parts`` comparison fires — the silent single-device pin
+    that drops a subsystem on the mesh-sharded measured path — while
+    the legal shapes stay silent (a bare device_parts route branch, a
+    non-gate workload-layout conjunction) and config.py itself is
+    exempt (validate() is the sanctioned home for multi-chip pins)."""
+    from deneva_tpu.runtime.gates import GATES
+
+    root = os.path.join(FIX, "gate_bad_devpin")
+    tree = Tree(root, ["."])
+    findings = tree.filter(gateconsistency.check(
+        tree, gates={"audit": GATES["audit"]}, exempt=(),
+        escrow_funcs=(), escrow_home=(),
+        config_module="deneva_tpu/config.py", guarded=(), model={}))
+    assert _got(findings) == _expected(root), \
+        "\n".join(f.render() for f in findings)
+
+
 def test_gate_registry_matches_config():
     """Executable half of gate-registry-drift: every registered flag is
     a real Config field defaulting OFF, every wiremodel gate names a

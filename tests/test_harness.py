@@ -662,6 +662,58 @@ def test_parse_ctrl_forward_backward_compat(tmp_path):
     assert parse_file(str(old_log))["tput"] == 5
 
 
+def test_parse_mesh_forward_backward_compat(tmp_path):
+    """[mesh] lines (pod-scale measured path): one row per mesh-armed
+    server at summary time — shards, the static all_to_all estimate,
+    the d2h prefetch overlap ratio and the group count behind it; old
+    logs and single-device runs yield [], the new lines perturb no
+    other parser, and the "mesh_prefetch" timeline span lands on the
+    declared tid-8 track."""
+    from deneva_tpu.harness.parse import (parse_admission, parse_ctrl,
+                                          parse_file, parse_membership,
+                                          parse_mesh, parse_metrics,
+                                          parse_repair, parse_replication)
+    from deneva_tpu.harness.timeline import parse_timeline
+    from deneva_tpu.parallel.mesh import mesh_line
+
+    new_log = tmp_path / "mesh.out"
+    new_log.write_text(
+        "# cfg node_cnt=1\n"
+        + mesh_line(0, {"shards": 8, "a2a_bytes": 147456,
+                        "prefetch_overlap": "0.8750", "groups": 16})
+        + "\n"
+        "[timeline] node=0 epoch=64 loop=1.0ms mesh_prefetch=0.4ms\n"
+        "[summary] total_runtime=2,tput=1800,txn_cnt=3600,"
+        "total_txn_commit_cnt=3600,mesh_shards=8,"
+        "mesh_prefetch_overlap=0.875\n")
+    rows = parse_mesh(new_log.read_text().splitlines())
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["node"] == 0 and r["shards"] == 8
+    assert r["a2a_bytes"] == 147456 and r["groups"] == 16
+    assert r["prefetch_overlap"] == pytest.approx(0.875)
+    row = parse_file(str(new_log))
+    assert row["mesh_shards"] == 8
+    assert row["mesh_prefetch_overlap"] == pytest.approx(0.875)
+    # other parsers ignore the new lines entirely
+    text = new_log.read_text().splitlines()
+    assert parse_membership(text) == []
+    assert parse_replication(text) == []
+    assert parse_admission(text) == []
+    assert parse_repair(text) == []
+    assert parse_metrics(text) == []
+    assert parse_ctrl(text) == []
+    assert len(parse_timeline(text)) == 1
+    from deneva_tpu.harness.timeline import MESH_TRACK, SPAN_TRACK
+    assert SPAN_TRACK["mesh_prefetch"] is MESH_TRACK
+    assert MESH_TRACK.tid == 8
+    # old log (pre-mesh, or any single-device run): [] and unchanged
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_mesh(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5
+
+
 def test_track_registry_covers_every_span_family():
     """The declared track registry (timeline.TRACKS) replaces the magic
     Chrome-trace tids: every tagged-line ledger family maps to exactly

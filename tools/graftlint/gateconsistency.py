@@ -35,6 +35,15 @@ gate-rtype-mask       a gated rtype is inside FAULT_RTYPE_MASK — gated
                       control-plane traffic must never be silently
                       droppable (the PR 4 "rtypes 15-17 outside the
                       mask" rule, generalized).
+gate-device-pin       a gate guard is conjoined with a `device_parts`
+                      comparison outside config.py (`if cfg.audit and
+                      cfg.device_parts == 1:`) — a SILENT single-device
+                      pin that makes the subsystem vanish on the pod-
+                      scale measured path with no error.  Compatibility
+                      pins are config.validate's job: declare them
+                      there (`_check(self.device_parts == 1, ...)`) so
+                      an unsupported combination REFUSES to run instead
+                      of quietly changing what is measured.
 """
 
 from __future__ import annotations
@@ -469,6 +478,7 @@ def check(tree: Tree, gates=None, exempt=None, escrow_funcs=None,
     findings += _check_guard_shed(tree, guarded)
     findings += _check_escrow(tree, escrow_funcs or (),
                               tuple(escrow_home or ()), exempt)
+    findings += _check_device_pin(tree, st, config_module)
     return findings
 
 
@@ -631,6 +641,59 @@ def _check_guard_shed(tree: Tree, guarded) -> list[Finding]:
                         f"self.{t.attr} — the owner_check wrapper lives "
                         f"on the object, so rebinding sheds it; mutate "
                         f"in place (clear()/update()/extend())"))
+    return findings
+
+
+def _is_device_pin(node: ast.AST) -> bool:
+    """A `device_parts` comparison against a constant (possibly under
+    `not`) — the shape of a silent single-device compatibility pin."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        node = node.operand
+    if not (isinstance(node, ast.Compare) and len(node.ops) == 1):
+        return False
+    for a, b in ((node.left, node.comparators[0]),
+                 (node.comparators[0], node.left)):
+        if _leaf(a) == "device_parts" and isinstance(b, ast.Constant):
+            return True
+    return False
+
+
+def _check_device_pin(tree: Tree, st: _Gates, config_module
+                      ) -> list[Finding]:
+    """gate-device-pin: a gate guard conjoined with a device_parts
+    comparison outside config.py.  `if cfg.audit and cfg.device_parts
+    == 1:` silently drops the subsystem on the mesh-sharded measured
+    path; config.validate owns every multi-chip compatibility pin so
+    the combination errors out loud instead (the PR 17 step.py
+    lesson — non-gate conjunctions like a workload's
+    `cc_alg == MVCC and device_parts == 1` layout choice stay legal)."""
+    cfg_rel = config_module or "deneva_tpu/config.py"
+    findings: list[Finding] = []
+    for m in tree.modules:
+        if not m.rel.startswith("deneva_tpu/") or m.rel == cfg_rel \
+                or m.rel.startswith(st.exempt):
+            continue
+        for node in ast.walk(m.tree):
+            if not (isinstance(node, ast.BoolOp)
+                    and isinstance(node.op, ast.And)):
+                continue
+            pin = None
+            subs: set[str] = set()
+            for v in node.values:
+                if _is_device_pin(v):
+                    pin = pin or v
+                else:
+                    pos, neg = st.classify(v, {})
+                    subs |= pos | neg
+            if pin is not None and subs:
+                names = "/".join(sorted(subs))
+                findings.append(Finding(
+                    "gate-device-pin", m.rel, pin.lineno,
+                    f"gate guard for {names!r} conjoined with a "
+                    f"device_parts comparison — a silent single-device "
+                    f"pin; declare the compatibility constraint in "
+                    f"config.validate so device_parts > 1 errors "
+                    f"instead of quietly dropping the subsystem"))
     return findings
 
 

@@ -81,6 +81,16 @@
 #                                         salvaged txns acked as commits,
 #                                         bit-identical replay through the
 #                                         repair sub-rounds, salvage > 0)
+#   tools/smoke.sh mesh                   pod-scale measured-path gate:
+#                                         the dp=8-vs-dp=1 bit-identity
+#                                         oracle (cluster verdict planes,
+#                                         logs, acks and replay digests
+#                                         identical across the mesh axis,
+#                                         YCSB + TPC-C) + the 8-virtual-
+#                                         device multichip dry run
+#                                         (sharded compile + measured-path
+#                                         run_simulation over every
+#                                         backend family)
 #   tools/smoke.sh lint                   static-analysis gate: graftlint v2
 #                                         (trace/det/wire/own/imports + the
 #                                         gate/life/jit families on the
@@ -190,6 +200,18 @@ case "$SCEN" in
         -q -p no:cacheprovider
     run "$T" python -m deneva_tpu.harness.chaos trace-kill --quick
     ;;
+  mesh)
+    # oracle first: the dp=8 cluster reproduces dp=1 bit-for-bit
+    # (verdict planes, logs, acks, replay digests; YCSB + TPC-C), then
+    # the multichip dry run — sharded compile over every backend family
+    # plus the measured-path run_simulation window.  Both need the 8
+    # forced host devices BEFORE jax initializes.
+    T="${SMOKE_TIMEOUT_SECS:-${MESH_TIMEOUT_SECS:-900}}"
+    run "$T" env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m pytest tests/test_mesh_cluster.py -q -p no:cacheprovider
+    run "$T" env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+    ;;
   lint)
     # static gate; budget 30 s total on the 2-core CI box (graftlint v2
     # measures ~6.5 s full-tree over the 8 families / 78 files, ruff
@@ -212,7 +234,7 @@ case "$SCEN" in
     fi
     ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|ctrl|monitor|trace|lint> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|ctrl|monitor|trace|mesh|lint> [args...]" >&2
     exit 2
     ;;
 esac
