@@ -27,6 +27,7 @@ from deneva_tpu.cc import occ as _occ
 from deneva_tpu.cc import timestamp as _tsmod
 from deneva_tpu.cc import twopl as _twopl
 from deneva_tpu.cc.calvin import validate_calvin, validate_tpu_batch
+from deneva_tpu.cc.dgcc import validate_dgcc
 from deneva_tpu.cc.maat import validate_maat
 from deneva_tpu.cc.nocc import validate_nocc
 from deneva_tpu.cc.occ import validate_occ
@@ -122,6 +123,17 @@ _REGISTRY: dict[CCAlg, CCBackend] = {
     CCAlg.TPU_BATCH: CCBackend(CCAlg.TPU_BATCH, validate_tpu_batch, _NO_STATE,
                                chained=True, forward=True,
                                exempt_order_free=True),
+    # DGCC builds the exact-key dependency graph BEFORE commit
+    # (cc/depgraph.py lane sort + segmented scans — no hashed-bucket
+    # incidence at all, hence needs_incidence=False) and serializes
+    # conflicting txns into chained waves; over-deep closures DEFER to
+    # the retry queue, so aborts stay zero by construction.  forward
+    # stays False on purpose: unlike CALVIN's blind-write forwarding
+    # collapse, DGCC always executes its real wavefront — the [dgcc]
+    # line's waves>1 is the anti-inert signal the smoke gate pins.
+    CCAlg.DGCC: CCBackend(CCAlg.DGCC, validate_dgcc, _NO_STATE,
+                          needs_incidence=False, chained=True,
+                          exempt_order_free=True),
 }
 
 

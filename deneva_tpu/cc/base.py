@@ -303,12 +303,34 @@ def audit_init(cfg):
     epoch + merged rank per hashed bucket; -1 = never written).  Lives
     in ``db[AUDIT_KEY]`` so every db-construction path (engine init,
     server boot, log replay, follower boot) threads it identically and
-    checkpointing carries it (engine/checkpoint schema v8)."""
+    checkpointing carries it (engine/checkpoint schema v8).
+
+    Under MVCC the state additionally carries per-bucket version-
+    boundary RINGS (depth ``mvcc_his_len``, mirroring the backend's own
+    in-ring retention): the last H committed writers' boundary
+    timestamps plus their (epoch, writer) stamps, so a read's observed
+    version can be SELECTED BY ITS TIMESTAMP
+    (`cc.depgraph.version_select`) instead of assumed to be the last
+    writer — the audit plane's MVCC headroom item.  Gated on the
+    algorithm so every non-MVCC artifact (checkpoint schema v8,
+    sidecars, replay digests) keeps its exact pre-existing shape;
+    MVCC+audit was a `config.validate` error before the rings existed,
+    so no prior artifact carries the extended shape."""
     import jax.numpy as jnp
 
+    from deneva_tpu.config import CCAlg
+
     k = cfg.audit_buckets
-    return {"epoch": jnp.full((k,), -1, jnp.int32),
-            "writer": jnp.full((k,), -1, jnp.int32)}
+    aud = {"epoch": jnp.full((k,), -1, jnp.int32),
+           "writer": jnp.full((k,), -1, jnp.int32)}
+    if cfg.cc_alg == CCAlg.MVCC:
+        h = max(1, cfg.mvcc_his_len)
+        aud.update(
+            vts=jnp.full((k, h), -1, jnp.int32),
+            vepoch=jnp.full((k, h), -1, jnp.int32),
+            vwriter=jnp.full((k, h), -1, jnp.int32),
+            vpos=jnp.zeros((k,), jnp.int32))
+    return aud
 
 
 def audit_observe(cfg, batch: AccessBatch, committed, order, lvl,
@@ -393,7 +415,7 @@ def _audit_observe_impl(cfg, batch: AccessBatch, committed, order, lvl,
                         order_vis: bool, stamps, epoch):
     import jax.numpy as jnp
 
-    from deneva_tpu.ops.forward import _seg_scan, _shift1
+    from deneva_tpu.cc import depgraph
 
     b, a = batch.shape
     cm = batch.valid & committed[:, None]
@@ -432,62 +454,56 @@ def _audit_observe_impl(cfg, batch: AccessBatch, committed, order, lvl,
         jnp.broadcast_to(rpos[:, None], (b, a)).reshape(-1),
         jnp.broadcast_to(wpos[:, None], (b, a)).reshape(-1)])
     tid2 = jnp.concatenate([tid.reshape(-1), tid.reshape(-1)])
-    sk, sp, sid = jax.lax.sort((keys2, pos2, tid2), num_keys=2,
-                               is_stable=False)
+    sk, sp, sid = depgraph.lane_sort(keys2, pos2, tid2)
     sw = (sp & 1) == 1
     sbk = bucket_hash(sk, cfg.audit_buckets, family=0)
     live = sk != big
-    head = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
-    tail = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones((1,), bool)])
+    head, tail = depgraph.segment_bounds(sk)
     cand = jnp.where(sw & live, sid, jnp.int32(-1))
-    keep_last = lambda va, v: jnp.where(v >= 0, v, va)  # noqa: E731
     # nearest preceding / following writer within the key segment (sort
     # order IS position order; write positions are unique per txn and
     # never tie a read position, so "preceding" is "strictly lower pos")
-    prev = _shift1(_seg_scan(head, cand, keep_last), jnp.int32(-1))
-    prev = jnp.where(head, jnp.int32(-1), prev)
-    nrev = _shift1(_seg_scan(tail[::-1], cand[::-1], keep_last),
-                   jnp.int32(-1))
-    nxt = jnp.where(tail[::-1], jnp.int32(-1), nrev)[::-1]
-
-    def pack(kind, src, dst):
-        return (jnp.int32(kind) << 28) | (src << 14) | dst
+    prev = depgraph.prev_writer(head, cand)
+    nxt = depgraph.next_writer(tail, cand)
 
     # per sorted lane: a read's preceding writer is its wr source, its
     # following writer the rw target (next version past the observed);
     # a write's preceding writer is its ww predecessor
     f_prev = live & (prev >= 0) & (prev != sid)
-    e_prev = jnp.where(f_prev,
-                       pack(jnp.where(sw, AUDIT_WW, AUDIT_WR), prev, sid),
-                       jnp.int32(-1))
+    e_prev = jnp.where(
+        f_prev,
+        depgraph.pack_edge(jnp.where(sw, AUDIT_WW, AUDIT_WR), prev, sid),
+        jnp.int32(-1))
     f_next = live & ~sw & (nxt >= 0) & (nxt != sid)
-    e_next = jnp.where(f_next, pack(AUDIT_RW, sid, nxt), jnp.int32(-1))
+    e_next = jnp.where(f_next, depgraph.pack_edge(AUDIT_RW, sid, nxt),
+                       jnp.int32(-1))
     flags = jnp.concatenate([f_prev, f_next])
     allp = jnp.concatenate([e_prev, e_next])
     allb = jnp.concatenate([sbk, sbk])
-    cnt = flags.sum(dtype=jnp.int32)
-    # compact to the static export cap by prefix-sum scatter (stable:
-    # flagged lanes keep their sorted-lane positions, themselves
-    # deterministic — every node emits the identical list; a sort here
-    # measured ~60% of the armed cost on CPU XLA).  Overflow past the
-    # cap lands in the trash slot and is COUNTED, never silent.
-    e_max = cfg.audit_edges_max
-    slot = jnp.cumsum(flags.astype(jnp.int32)) - 1
-    tgt = jnp.where(flags, jnp.minimum(slot, e_max), e_max)
-    edges = jnp.full((e_max + 1,), -1, jnp.int32).at[tgt].set(
-        allp, mode="drop")[:e_max]
-    ebkt = jnp.full((e_max + 1,), -1, jnp.int32).at[tgt].set(
-        allb, mode="drop")[:e_max]
-    dropped = jnp.maximum(cnt - jnp.int32(e_max), 0)
+    (edges, ebkt), cnt, dropped = depgraph.compact_lanes(
+        flags, (allp, allb), cfg.audit_edges_max)
 
     # epoch-start read observations (reads with no in-epoch visible
     # writer) gather the PRE-update stamps: their digest is the
-    # cross-epoch fingerprint every node must reproduce
+    # cross-epoch fingerprint every node must reproduce.  With MVCC's
+    # version-boundary rings present, the observed stamp is instead
+    # SELECTED BY THE READER'S TIMESTAMP from the bucket ring — a read
+    # at ts t observes the newest retained version bounded by t, which
+    # may be older than the last writer (`depgraph.version_select`).
     m1, m2, m3, m4 = (jnp.uint32(0x9E3779B9), jnp.uint32(0x85EBCA6B),
                       jnp.uint32(0xC2B2AE35), jnp.uint32(0x27D4EB2F))
     obs = live & ~sw & (prev < 0)
-    oe = jnp.take(stamps["epoch"], sbk)
-    ow = jnp.take(stamps["writer"], sbk)
+    if "vts" in stamps:
+        sts = jnp.take(batch.ts, sid)
+        ring = lambda f: jnp.take(stamps[f], sbk, axis=0)  # noqa: E731
+        sel = depgraph.version_select(ring("vts"), sts)
+        pick = lambda f: jnp.take_along_axis(  # noqa: E731
+            ring(f), jnp.maximum(sel, 0)[:, None], axis=-1)[:, 0]
+        oe = jnp.where(sel >= 0, pick("vepoch"), jnp.int32(-1))
+        ow = jnp.where(sel >= 0, pick("vwriter"), jnp.int32(-1))
+    else:
+        oe = jnp.take(stamps["epoch"], sbk)
+        ow = jnp.take(stamps["writer"], sbk)
     mix = ((sid.astype(jnp.uint32) * m1) ^ (sbk.astype(jnp.uint32) * m2)
            ^ (oe.astype(jnp.uint32) * m3) ^ (ow.astype(jnp.uint32) * m4))
     rdig = jnp.where(obs, mix, jnp.uint32(0)).sum(dtype=jnp.uint32)
@@ -507,8 +523,26 @@ def _audit_observe_impl(cfg, batch: AccessBatch, committed, order, lvl,
     new_w = jnp.where(upd, wid - 1, stamps["writer"])
     vdig = ((new_e.astype(jnp.uint32) * m1)
             ^ (new_w.astype(jnp.uint32) * m2)).sum(dtype=jnp.uint32)
-    return ({"epoch": new_e, "writer": new_w}, edges, ebkt, cnt,
-            dropped, vdig, rdig)
+    nstamps = {"epoch": new_e, "writer": new_w}
+    if "vts" in stamps:
+        # push this epoch's final writer per updated bucket into the
+        # version-boundary ring: boundary ts = the winning writer's own
+        # timestamp (MVCC stamps versions with the writer's ts)
+        hlen = stamps["vts"].shape[1]
+        slot = stamps["vpos"] % hlen
+        rows = jnp.arange(k, dtype=jnp.int32)
+        wts = jnp.take(batch.ts, jnp.maximum(wid - 1, 0))
+
+        def push(ring_arr, val):
+            cur = ring_arr[rows, slot]
+            return ring_arr.at[rows, slot].set(jnp.where(upd, val, cur))
+
+        nstamps.update(
+            vts=push(stamps["vts"], wts),
+            vepoch=push(stamps["vepoch"], jnp.asarray(epoch, jnp.int32)),
+            vwriter=push(stamps["vwriter"], wid - 1),
+            vpos=stamps["vpos"] + upd.astype(jnp.int32))
+    return (nstamps, edges, ebkt, cnt, dropped, vdig, rdig)
 
 
 def audit_mutate_verdict(cfg, batch: AccessBatch, inc: Incidence,

@@ -1,0 +1,167 @@
+"""Dependency-graph primitives: the epoch's exact-key conflict graph as
+one lane sort + segmented scans (PR 15's audit kernel, promoted out of
+the audit plane into a first-class pre-commit primitive).
+
+The audit plane (`cc/base.audit_observe`) already derives the ww/wr/rw
+dependency graph of an epoch ON DEVICE: double every access into a read
+lane and a write lane, sort the lanes by (exact combined key, visibility
+position), and the nearest preceding/following WRITER of each lane —
+two segmented scans — names every dependency edge with zero
+bucket-collision false positives.  That machinery is useful *before*
+commit too (PAPERS: *DGCC: A New Dependency Graph based Concurrency
+Control Protocol*, arXiv:1503.03642 — the protocol IS "build the
+dependency graph first, then execute along it"), so the kernel pieces
+live here, shared verbatim by three consumers:
+
+* the isolation audit plane (`cc/base._audit_observe_impl`) — post-
+  commit observation under the backend's visibility rule; this refactor
+  reproduces its edge stream bit for bit (pinned by the existing audit
+  tests: every helper keeps the exact op sequence the audit kernel
+  compiled before the move);
+* the DGCC wavefront backend (`cc/dgcc.py`) — the same sort/scan over
+  the PLANNED access sets of all active txns assigns execution waves
+  pre-commit, turning would-be aborts into chained commits;
+* MVCC's per-read observed-version export (`version_select`) — the
+  audit-plane headroom item: a read's observed version is selected by
+  its timestamp from the bucket's version-boundary ring, not assumed to
+  be the last committed stamp.
+
+Layout contract (shared by all consumers): lane positions are int32
+with the lane's WRITE-ness encoded as the position's parity (write
+positions odd, read positions even), so the sort carries no extra
+operand and `(pos & 1) == 1` recovers write-ness after the sort —
+CPU XLA's comparator sort charges per operand (see the audit kernel's
+measurement note).  Inactive lanes carry the key sentinel ``LANE_PAD``
+and sort to the tail.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deneva_tpu.ops.forward import _seg_scan, _shift1
+
+# key sentinel for dead lanes (uint32 max: sorts after every real
+# combined key in either signedness interpretation the callers use)
+LANE_PAD = 0xFFFFFFFF
+
+# last-write carry for the prev/next-writer scans: keep the newest
+# non-negative value seen in the segment
+_keep_last = lambda va, v: jnp.where(v >= 0, v, va)  # noqa: E731
+
+
+def lane_sort(keys, pos, tid):
+    """One fused (key, position) lane sort with the owning txn id as
+    payload — the dependency-graph workhorse.  ``is_stable=False``:
+    ties are (key, pos) duplicates whose relative order no consumer
+    observes (write positions are unique per txn; duplicate read lanes
+    of one txn are interchangeable)."""
+    return jax.lax.sort((keys, pos, tid), num_keys=2, is_stable=False)
+
+
+def segment_bounds(sk):
+    """(head, tail) masks of the key segments of a sorted key array."""
+    head = jnp.concatenate([jnp.ones((1,), bool), sk[1:] != sk[:-1]])
+    tail = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones((1,), bool)])
+    return head, tail
+
+
+def prev_writer(head, cand):
+    """Nearest PRECEDING writer within each key segment.
+
+    ``cand`` holds the txn id on writer lanes, -1 elsewhere; the result
+    is exclusive (a lane never sees itself) and -1 when no writer
+    precedes the lane in its segment.  Sort order is position order, so
+    "preceding" means "strictly lower visibility position"."""
+    p = _shift1(_seg_scan(head, cand, _keep_last), jnp.int32(-1))
+    return jnp.where(head, jnp.int32(-1), p)
+
+
+def next_writer(tail, cand):
+    """Nearest FOLLOWING writer within each key segment (the reversed
+    twin of `prev_writer`; -1 when no writer follows)."""
+    n = _shift1(_seg_scan(tail[::-1], cand[::-1], _keep_last),
+                jnp.int32(-1))
+    return jnp.where(tail[::-1], jnp.int32(-1), n)[::-1]
+
+
+def seg_excl_max(head, vals, neutral=-1):
+    """Exclusive segmented running max: each lane's max over the
+    STRICTLY earlier lanes of its segment (``neutral`` at segment
+    heads).  The DGCC level-relaxation carry (`cc/dgcc.py`)."""
+    m = _shift1(_seg_scan(head, vals, jnp.maximum), jnp.int32(neutral))
+    return jnp.where(head, jnp.int32(neutral), m)
+
+
+def pack_edge(kind, src, dst):
+    """Pack a dependency edge as kind<<28 | src<<14 | dst over merged-
+    batch ranks (14-bit fields: epoch_batch <= 16384, config.validate)."""
+    return (jnp.int32(kind) << 28) | (src << 14) | dst
+
+
+def edge_kind(e):
+    return (e >> 28) & jnp.int32(0xF)
+
+
+def edge_src(e):
+    return (e >> 14) & jnp.int32(0x3FFF)
+
+
+def edge_dst(e):
+    return e & jnp.int32(0x3FFF)
+
+
+def compact_lanes(flags, payloads, cap):
+    """Prefix-sum compaction of flagged lanes into a static-shape export
+    buffer: stable (flagged lanes keep their lane order — deterministic,
+    so every node emits the identical list; a sort here measured ~60% of
+    the audit plane's armed cost on CPU XLA).  Overflow past ``cap``
+    lands in the trash slot and is COUNTED, never silent.
+
+    Returns ``(outs, cnt, dropped)``: one int32[cap] array per payload
+    (-1 pad), the total flagged-lane count (pre-cap), and the overflow
+    count."""
+    cnt = flags.sum(dtype=jnp.int32)
+    slot = jnp.cumsum(flags.astype(jnp.int32)) - 1
+    tgt = jnp.where(flags, jnp.minimum(slot, cap), cap)
+    outs = tuple(
+        jnp.full((cap + 1,), -1, jnp.int32).at[tgt].set(
+            p, mode="drop")[:cap]
+        for p in payloads)
+    dropped = jnp.maximum(cnt - jnp.int32(cap), 0)
+    return outs, cnt, dropped
+
+
+def version_select(vts, read_ts):
+    """Per-read observed-version select: index of the NEWEST ring entry
+    whose boundary stamp is <= the reader's timestamp, -1 when no
+    retained version is old enough (the reader observed a version from
+    before the ring's horizon — epoch-start-of-history).
+
+    ``vts``: int32[..., H] version-boundary timestamps (-1 = empty
+    slot); ``read_ts``: int32[...] reader timestamps.  This is MVCC's
+    in-ring version-select rule (`cc/timestamp.py`) restated over the
+    audit plane's bucket rings, which is exactly what the audit model
+    was missing for MVCC: a read at ts t observes the latest version
+    bounded by t, NOT the last committed writer."""
+    ok = (vts >= 0) & (vts <= read_ts[..., None])
+    best = jnp.where(ok, vts, jnp.int32(-1))
+    j = jnp.argmax(best, axis=-1).astype(jnp.int32)
+    found = jnp.take_along_axis(best, j[..., None], axis=-1)[..., 0] >= 0
+    return jnp.where(found, j, jnp.int32(-1))
+
+
+def witness_count(edges, lvl):
+    """Claim-violating dependency edges: packed edges whose BOTH
+    endpoints committed at level/round 0.  A level-0 sweep backend's
+    Verdict invariant says its committed set is conflict-free — zero
+    edges — so any level-0/level-0 edge is certificate pressure (the
+    controller's witness-density signal); repair-salvaged endpoints
+    (round >= 1) and chained waves carry legitimate edges and are
+    excluded by the level test."""
+    valid = edges >= 0
+    src = jnp.clip(edge_src(edges), 0, lvl.shape[0] - 1)
+    dst = jnp.clip(edge_dst(edges), 0, lvl.shape[0] - 1)
+    z = (jnp.take(lvl, src) == 0) & (jnp.take(lvl, dst) == 0)
+    return (valid & z).sum(dtype=jnp.int32)

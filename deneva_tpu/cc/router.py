@@ -46,15 +46,32 @@ from deneva_tpu.cc.base import AccessBatch
 from deneva_tpu.config import CCAlg, Config
 
 # branch indices of the routed `lax.switch` (engine/step.py): the three
-# uniform single-backend branches, then the mixed-assignment branch
+# core uniform single-backend branches, the optional DGCC wavefront
+# branch (``Config.ctrl_dgcc``, PR 18 — the HOT class's near-zero-abort
+# escape hatch), then the mixed-assignment branch last
 CANDIDATES: tuple[CCAlg, ...] = (CCAlg.NO_WAIT, CCAlg.OCC, CCAlg.TPU_BATCH)
 MIXED = len(CANDIDATES)
 
 
+def candidates(cfg: Config) -> tuple[CCAlg, ...]:
+    """The epoch program's candidate tuple for this config.  Without
+    ``ctrl_dgcc`` this is exactly the PR 16 three-class tuple, so the
+    compiled switch (and every recorded [ctrl] replay) stays
+    bit-identical when the fourth class is unarmed."""
+    if cfg.ctrl_dgcc:
+        return CANDIDATES + (CCAlg.DGCC,)
+    return CANDIDATES
+
+
 def candidate_index(alg: CCAlg | str) -> int:
     """Branch index of a candidate backend (raises on a non-candidate —
-    config.validate pins cc_alg to the candidate set under ctrl)."""
-    return CANDIDATES.index(CCAlg(alg))
+    config.validate pins cc_alg to the candidate set under ctrl).
+    DGCC's index is stable at 3 whether or not it is armed: the mixed
+    branch always sits LAST, after whatever candidates(cfg) yields."""
+    alg = CCAlg(alg)
+    if alg == CCAlg.DGCC:
+        return len(CANDIDATES)
+    return CANDIDATES.index(alg)
 
 
 @dataclass
@@ -129,7 +146,8 @@ def txn_backend(knobs: RouterKnobs, owner) -> jax.Array:
                     jnp.clip(home, 0, knobs.assign.shape[0] - 1))
 
 
-def cross_group_defer(inc, batch: AccessBatch, group) -> jax.Array:
+def cross_group_defer(inc, batch: AccessBatch, group,
+                      n_groups: int = MIXED) -> jax.Array:
     """bool[B] txns whose conflict surface crosses backend groups —
     deferred SYMMETRICALLY (both sides) in mixed-assignment epochs, so
     each backend validates a sub-batch whose conflicts are wholly its
@@ -146,7 +164,6 @@ def cross_group_defer(inc, batch: AccessBatch, group) -> jax.Array:
     u1 = inc.u1
     w1 = inc.w1
     act = batch.active.astype(jnp.float32)
-    n_groups = MIXED
     conf = jnp.zeros(batch.active.shape, jnp.float32)
     # total column masses once, per-group masses by masked einsum
     tot_w = jnp.einsum("bk,b->k", w1, act,

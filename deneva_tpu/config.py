@@ -41,6 +41,7 @@ class CCAlg(str, enum.Enum):
     MAAT = "MAAT"              # dynamic timestamp ranges
     CALVIN = "CALVIN"          # deterministic (sequencer + ordered locks)
     TPU_BATCH = "TPU_BATCH"    # headline backend: MXU conflict matrix + greedy serialization
+    DGCC = "DGCC"              # dependency-graph wavefront (exact-key lane graph -> chained waves)
     NOCC = "NOCC"              # oracle mode: no concurrency control (reference MODE=NOCC_MODE)
 
 
@@ -223,6 +224,15 @@ class Config:
     #                                 index (index_btree insert analogue;
     #                                 one merge sort per epoch)
     exec_subrounds: int = 4        # chained-execution levels per epoch (CALVIN/TPU_BATCH)
+    dgcc_levels: int = 32          # DGCC wave budget: level-relaxation
+    #                                round cap AND max wavefront depth per
+    #                                epoch (cc/dgcc.py).  Deeper dependency
+    #                                closures DEFER to the next epoch's
+    #                                retry queue (repair's cyclic-fallback
+    #                                analogue) — never abort.  Far above
+    #                                exec_subrounds because DGCC's exact-
+    #                                key lane graph has no hashed-bucket
+    #                                false conflicts inflating chain depth.
     mvcc_his_len: int = 4          # in-state version history depth (HIS_RECYCLE_LEN analogue)
     escrow_order_free: bool = True  # honor workload order_free (escrow/
     #                                 commutative) declarations in the
@@ -831,6 +841,16 @@ class Config:
     ctrl_scale_max: int = 4        # max admission quota-scale steps the
     #                                cluster governor may shed (effective
     #                                quota = tenant_quota * 0.8^step)
+    ctrl_dgcc: bool = False        # arm the controller's FOURTH router
+    #                                class: HOT partitions route to the
+    #                                DGCC wavefront backend (cc/dgcc.py)
+    #                                instead of TPU_BATCH — conflicting
+    #                                txns serialize into chained waves
+    #                                rather than abort.  Default off:
+    #                                the candidate list, the compiled
+    #                                4-way routed program and every
+    #                                [ctrl] replay stay exactly the
+    #                                3-class plane (bit-identical off).
 
     # ---- checkpoint / resume (no reference analogue: SURVEY §5.4 notes
     # the reference cannot recover; we can) ----
@@ -1345,11 +1365,10 @@ class Config:
             _check(self.device_parts == 1,
                    "audit observations do not compose with multi-chip "
                    "execution yet (the edge derivation is single-device)")
-            _check(self.cc_alg != CCAlg.MVCC,
-                   "audit does not model MVCC's in-ring version-select "
-                   "reads yet (its observed versions are ts-dependent, "
-                   "not epoch-start; every other backend's reads are "
-                   "epoch-start / level / order visible)")
+            # (MVCC is modeled since the depgraph refactor: audit_init
+            # carries per-bucket version-boundary rings and reads select
+            # their observed version by timestamp —
+            # cc/depgraph.version_select)
             _check(self.workload in (WorkloadKind.YCSB, WorkloadKind.TPCC),
                    "audit is wired for YCSB and TPCC (the workload load "
                    "path installs the audit stamp tables)")
@@ -1363,7 +1382,8 @@ class Config:
                    "certifier would be armed but provably inert)")
             if self.node_cnt > 1:
                 _check(self.dist_protocol == "merged"
-                       or self.cc_alg in (CCAlg.CALVIN, CCAlg.TPU_BATCH),
+                       or self.cc_alg in (CCAlg.CALVIN, CCAlg.TPU_BATCH,
+                                          CCAlg.DGCC),
                        "cluster audit needs the replicated deterministic "
                        "verdict (--dist_protocol=merged or a "
                        "deterministic backend): the VOTE protocol's "
@@ -1388,8 +1408,9 @@ class Config:
                                    CCAlg.OCC, CCAlg.TIMESTAMP, CCAlg.MVCC,
                                    CCAlg.MAAT),
                    "repair applies to the six sweep backends only "
-                   "(CALVIN/TPU_BATCH never abort — there is nothing to "
-                   "salvage; NOCC has no conflicts)")
+                   "(CALVIN/TPU_BATCH never abort and DGCC defers its "
+                   "over-deep closures — there is nothing to salvage; "
+                   "NOCC has no conflicts)")
             _check(self.mode == Mode.NORMAL,
                    "repair re-executes committed state; degraded modes "
                    "(SIMPLE/NOCC/QRY_ONLY) have no abort path to salvage")
@@ -1407,6 +1428,20 @@ class Config:
                        "repair sub-rounds are part of the replicated "
                        "deterministic verdict, which the VOTE protocol's "
                        "partitioned local validation cannot express")
+        # ---- DGCC wavefront gating (cc/dgcc.py) ----
+        _check(1 <= self.dgcc_levels <= 256,
+               "dgcc_levels must be in [1, 256] (wave budget per epoch; "
+               "each relaxation round is two segmented scans inside the "
+               "epoch jit)")
+        if self.cc_alg == CCAlg.DGCC:
+            _check(self.workload in (WorkloadKind.YCSB, WorkloadKind.TPCC),
+                   "DGCC's wave re-execution closures are wired for YCSB "
+                   "and TPCC (workloads declare chained execution; PPS "
+                   "keeps the sweep backends)")
+            _check(self.dist_protocol != "vote",
+                   "DGCC's verdict is a pure replicated function of the "
+                   "merged batch — use the merged sequencer exchange, "
+                   "not 2PC votes")
         # ---- control plane gating (same discipline: the default takes
         # the pre-ctrl paths exactly; lo/hi/confirm/cooldown/stale/heal/
         # gshift/scale_max are depth knobs with live defaults) ----
@@ -1427,11 +1462,13 @@ class Config:
             _check(self.mode == Mode.NORMAL,
                    "ctrl adapts executed-state knobs; degraded modes "
                    "(SIMPLE/NOCC/QRY_ONLY) have nothing to adapt")
-            _check(self.cc_alg in (CCAlg.NO_WAIT, CCAlg.OCC,
-                                   CCAlg.TPU_BATCH),
-                   "ctrl routes between NO_WAIT/OCC/TPU_BATCH; the "
-                   "static cc_alg must be one of the three candidates "
-                   "(it is the governor's fail-safe assignment)")
+            cands = (CCAlg.NO_WAIT, CCAlg.OCC, CCAlg.TPU_BATCH) \
+                + ((CCAlg.DGCC,) if self.ctrl_dgcc else ())
+            _check(self.cc_alg in cands,
+                   "ctrl routes between NO_WAIT/OCC/TPU_BATCH (plus "
+                   "DGCC when --ctrl_dgcc=true); the static cc_alg must "
+                   "be one of the candidates (it is the governor's "
+                   "fail-safe assignment)")
             _check(self.device_parts == 1,
                    "the ctrl router's branched epoch program is "
                    "single-device (multi-chip plans are built per-shard "
@@ -1451,6 +1488,10 @@ class Config:
                 _check(self.admission,
                        "cluster ctrl actuates admission quota scaling: "
                        "needs --admission=true")
+        if self.ctrl_dgcc:
+            _check(self.ctrl,
+                   "ctrl_dgcc arms the router's fourth (DGCC) class: "
+                   "needs --ctrl=true")
         if self.fencing and self.fault_peer_stall:
             # the gray-slow node ends up fenced and retired in place —
             # same coordinator constraint as the elastic kill below

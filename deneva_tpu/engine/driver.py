@@ -140,11 +140,15 @@ def run_simulation(cfg: Config, chunk: int = 50,
         counters against the previous tick's snapshot, decide, re-arm.
         The first call only establishes the baseline (the pre-baseline
         chunks run on `static_knobs`, i.e. the unrouted values)."""
+        # witness density = CLAIM-VIOLATING edge count (audit_wit_cnt,
+        # cc/depgraph.witness_count), not the raw edge-lane volume —
+        # chained/DGCC epochs legitimately emit edges, so the raw count
+        # would spuriously pin audit_cadence to 1 under any contention
         dens, fb, sv, wit = jax.device_get(
             (state.stats["conflict_density"],
              state.stats["rep_fallback_cnt"],
              state.stats["rep_salvaged_cnt"],
-             state.stats["audit_edge_cnt"]))
+             state.stats["audit_wit_cnt"]))
         now = time.monotonic()
         cur = (np.asarray(dens).astype(np.int64), int(fb), int(sv),
                int(wit), epochs_total[0], now)
@@ -293,7 +297,7 @@ def run_simulation(cfg: Config, chunk: int = 50,
         # measured window (the sidecar export is the cluster runtime's
         # job — in-process runs surface the device counters).  Emitted
         # only when armed so the default summary line is byte-identical.
-        for k in ("audit_edge_cnt", "audit_drop_cnt"):
+        for k in ("audit_edge_cnt", "audit_drop_cnt", "audit_wit_cnt"):
             st.set(k, float(after[k] - before[k]))
     if cfg.ctrl:
         # control plane ([summary] satellite): decision ticks taken and
@@ -302,6 +306,29 @@ def run_simulation(cfg: Config, chunk: int = 50,
         # summary line is byte-identical.
         st.set("ctrl_decisions", float(ctl.seq))
         st.set("ctrl_trips", float(ctl.stale_trips))
+    from deneva_tpu.config import CCAlg
+    if cfg.cc_alg == CCAlg.DGCC or cfg.ctrl_dgcc:
+        # DGCC wavefront ledger ([summary] satellite + the [dgcc] line,
+        # parsed by harness.parse.parse_dgcc): waves executed over the
+        # window, the deepest single-epoch wavefront of the WHOLE run
+        # (a device-side running max — no windowed delta exists),
+        # over-deep closures deferred (the cyclic fallback), and
+        # pre-commit dependency edges.  Emitted only when DGCC can
+        # validate so every other config's output is byte-identical.
+        for k in ("dgcc_wave_cnt", "dgcc_fallback_cnt", "dgcc_edge_cnt"):
+            st.set(k, float(after[k] - before[k]))
+        st.set("dgcc_wave_max", float(after["dgcc_wave_max"]))
+        if not quiet:
+            from deneva_tpu.stats import tagged_line
+            print(tagged_line("dgcc", {
+                "node": 0,
+                "waves": int(after["dgcc_wave_cnt"]
+                             - before["dgcc_wave_cnt"]),
+                "wave_max": int(after["dgcc_wave_max"]),
+                "fallback": int(after["dgcc_fallback_cnt"]
+                                - before["dgcc_fallback_cnt"]),
+                "edges": int(after["dgcc_edge_cnt"]
+                             - before["dgcc_edge_cnt"])}), flush=True)
     for i, nm in enumerate(getattr(wl, "txn_type_names", ())):
         for fam in ("commit", "abort"):
             key = f"{fam}_by_type"

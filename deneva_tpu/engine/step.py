@@ -34,7 +34,7 @@ import jax.numpy as jnp
 
 from deneva_tpu.cc import (AccessBatch, build_conflict_incidence,
                            conflict_density, gate_order_free, get_backend)
-from deneva_tpu.config import Config, Mode
+from deneva_tpu.config import CCAlg, Config, Mode
 from deneva_tpu.engine.pool import PoolState, TxnPool
 from deneva_tpu.ops import (forward_verdict, forwarding_applies,
                             mc_defer_verdict)
@@ -103,10 +103,21 @@ def init_device_stats(n_txn_types: int = 1, n_parts: int = 1) -> dict:
         "rep_salvaged_cnt": z(), "rep_frontier_cnt": z(),
         "rep_fallback_cnt": z(),
         # isolation audit plane (cc/base.audit_observe, Config.audit):
-        # dependency edge-lanes observed among committed txns and
-        # export-cap overflows.  Always present (pytree structure is
-        # config-independent); stay zero unless audit is armed.
+        # dependency edge-lanes observed among committed txns, export-
+        # cap overflows, and CLAIM-VIOLATING edges (both endpoints at
+        # level 0 of a zero-edge-claim backend — cc/depgraph.
+        # witness_count, the controller's witness-density signal).
+        # Always present (pytree structure is config-independent); stay
+        # zero unless audit is armed.
         "audit_edge_cnt": z(), "audit_drop_cnt": z(),
+        "audit_wit_cnt": z(),
+        # DGCC wavefront backend (cc/dgcc.py, CC_ALG=DGCC): waves
+        # executed (sum over epochs), deepest single-epoch wavefront,
+        # over-deep closures deferred to the retry queue (the cyclic
+        # fallback), and dependency edges in the pre-commit lane graph.
+        # Always present; stay zero unless DGCC validates.
+        "dgcc_wave_cnt": z(), "dgcc_wave_max": z(),
+        "dgcc_fallback_cnt": z(), "dgcc_edge_cnt": z(),
         # per-txn-kind commit/abort breakdown (reference Stats_thd's
         # per-type counter families); names come from
         # Workload.txn_type_names at summary time
@@ -127,8 +138,11 @@ def count_by_type(stats: dict, wl, queries, commit, abort) -> None:
         (onehot & abort[:, None]).sum(axis=0, dtype=jnp.uint32)
 
 
-def _run_levels(cfg, wl, db, queries, exec_commit, verdict, stats):
-    """Chained sub-round execution to the DYNAMIC depth of this epoch.
+def _run_levels(cfg, wl, db, queries, exec_commit, verdict, stats,
+                level_exec=True):
+    """Chained sub-round execution to the DYNAMIC depth of this epoch:
+    the wavefront executor — wave k re-reads only rows written by waves
+    < k (each pass gathers from the db the previous passes scattered).
 
     Level-l txns read state that includes all writes of levels < l (the
     deterministic lock-queue order).  A `lax.while_loop` runs exactly
@@ -136,6 +150,15 @@ def _run_levels(cfg, wl, db, queries, exec_commit, verdict, stats):
     ``exec_subrounds`` budget — at low contention most epochs execute 1-2
     levels, so a generous budget (deep-chain admission) no longer costs
     idle full-batch passes on shallow epochs.
+
+    ``level_exec=True`` (CALVIN/TPU_BATCH): each level's committed set
+    is write-conflict-free by construction (true conflicts are a subset
+    of the hashed over-approximation), so executors skip the
+    ``last_writer`` scatter-max tournament.  ``level_exec=False``
+    (DGCC): a wave may carry several writers of one key — rw anti-
+    dependencies and blind ww chains serialize by the in-wave order
+    tournament instead of extra waves, which is what keeps DGCC's
+    wavefront shallow at write-heavy contention.
     """
     lv_max = jnp.max(jnp.where(exec_commit, verdict.level, 0))
 
@@ -146,13 +169,9 @@ def _run_levels(cfg, wl, db, queries, exec_commit, verdict, stats):
     def body(carry):
         lvl, db, stats = carry
         m = exec_commit & (verdict.level == lvl)
-        # level_exec: each level's committed set is write-conflict-free
-        # by construction (true conflicts are a subset of the hashed
-        # over-approximation), so executors skip the last_writer
-        # scatter-max tournament
         stats = dict(stats)
         db = wl.execute(db, queries, m, verdict.order, stats,
-                        level_exec=True)
+                        level_exec=level_exec)
         return lvl + 1, db, stats
 
     _, db, stats = jax.lax.while_loop(
@@ -253,7 +272,15 @@ class Engine:
         else:
             inc = build_conflict_incidence(cfg, be, batch,
                                            batch.order_free)
-            verdict, cc_state = be.validate(cfg, state.cc_state, batch, inc)
+            if be.alg == CCAlg.DGCC:
+                # DGCC takes the stats dict (repair-engine contract):
+                # its wave/fallback/edge counters come from inside the
+                # wave assignment, where the lane graph is in hand
+                verdict, cc_state = be.validate(cfg, state.cc_state,
+                                                batch, inc, stats=stats)
+            else:
+                verdict, cc_state = be.validate(cfg, state.cc_state,
+                                                batch, inc)
             if cfg.audit_mutate:
                 # seeded edge-derivation fault (the audit plane's
                 # anti-inert knob): flipped losers execute and ack like
@@ -323,10 +350,14 @@ class Engine:
                 from deneva_tpu.workloads.mc import mc_execute
                 db = mc_execute(cfg, wl, db, queries, exec_commit,
                                 verdict.order, verdict.level, stats,
-                                chained=be.chained and cfg.mode == Mode.NORMAL)
+                                chained=be.chained and cfg.mode == Mode.NORMAL,
+                                level_exec=be.alg != CCAlg.DGCC,
+                                n_levels=cfg.dgcc_levels
+                                if be.alg == CCAlg.DGCC else None)
             elif be.chained and cfg.mode == Mode.NORMAL:
                 db, stats = _run_levels(cfg, wl, db, queries, exec_commit,
-                                        verdict, stats)
+                                        verdict, stats,
+                                        level_exec=be.alg != CCAlg.DGCC)
             else:
                 db = wl.execute(db, queries, exec_commit, verdict.order,
                                 stats)
@@ -380,6 +411,15 @@ class Engine:
             db[AUDIT_KEY] = aud2
             stats["audit_edge_cnt"] += cnt.astype(jnp.uint32)
             stats["audit_drop_cnt"] += drop.astype(jnp.uint32)
+            if not forwarding and not be.chained:
+                # witness density (the controller's certificate-pressure
+                # signal): a level-0 sweep backend claims a conflict-
+                # free committed set, so any edge between two level-0
+                # commits is a claim violation — chained waves and
+                # forwarded ranks carry legitimate edges and skip this
+                from deneva_tpu.cc.depgraph import witness_count
+                stats["audit_wit_cnt"] += witness_count(
+                    _e, lvl).astype(jnp.uint32)
 
         # 6. update pool + counters (forced txns release like commits)
         pre_abort_cnt = sel(pool.abort_cnt)   # pre-update: 0 = never aborted
@@ -442,13 +482,18 @@ class Engine:
 
         Sections 1-3 (admit/select/plan) and section 6 (pool update +
         counters) are the static step's, shared OUTSIDE the routed
-        switch.  Section 4-5 becomes a 4-way ``lax.switch``: one branch
-        per uniform candidate backend — each replicating the static
-        step's exact validate/execute/repair/audit dataflow for that
-        backend — plus a mixed-assignment branch that validates each
+        switch.  Section 4-5 becomes a ``lax.switch`` over
+        ``candidates(cfg)``: one branch per uniform candidate backend —
+        each replicating the static step's exact
+        validate/execute/repair/audit dataflow for that backend — plus
+        a mixed-assignment branch (always last) that validates each
         backend's sub-batch against the shared (coarsened) incidence
         and defers the cross-group conflict surface symmetrically
-        (`cc/router.cross_group_defer`).  With ``static_knobs(cfg)``
+        (`cc/router.cross_group_defer`).  Under ``ctrl_dgcc`` a fourth
+        uniform branch runs the DGCC wavefront (index 3, the
+        controller's HOT class), and the mixed branch moves to index 4;
+        unarmed, the compiled 4-way program is bit-identical to the
+        PR 16 plane.  With ``static_knobs(cfg)``
         every epoch takes the uniform branch of ``cfg.cc_alg`` with
         gshift=0 / cap=repair_rounds / cadence=cfg.audit_cadence, and
         the outputs are value-identical to the unrouted step (pinned by
@@ -460,7 +505,7 @@ class Engine:
         shape-stable and knob VALUES never recompile.
         """
         from deneva_tpu.cc import Verdict
-        from deneva_tpu.cc.router import (CANDIDATES, MIXED, coarsen_keys,
+        from deneva_tpu.cc.router import (candidates, coarsen_keys,
                                           cross_group_defer, txn_backend)
         cfg, wl = self.cfg, self.workload
         rng, gen_key = jax.random.split(state.rng)
@@ -497,17 +542,22 @@ class Engine:
                             batch.keys % jnp.int32(max(cfg.part_cnt, 1)))
         cbatch = coarsen_keys(batch, owner, knobs.gshift)
         group = txn_backend(knobs, owner)
-        backends = [get_backend(a) for a in CANDIDATES]
+        # config-dependent candidate list: without ctrl_dgcc this is
+        # exactly the 3-class tuple, so the compiled 4-way switch (and
+        # every [ctrl] replay) is bit-identical to the pre-DGCC plane
+        backends = [get_backend(a) for a in candidates(cfg)]
 
         def density_into(st, inc):
             st["conflict_density"] = st["conflict_density"] + \
                 conflict_density(cfg, cbatch, owner, inc).astype(jnp.uint32)
 
-        def audit_into(db, st, exec_commit, order, lvl, order_vis):
+        def audit_into(db, st, exec_commit, order, lvl, order_vis,
+                       claim_zero=False):
             # static step's 5c with the cadence knob as a traced operand
             if not cfg.audit:
                 return db, st
             from deneva_tpu.cc import AUDIT_KEY, audit_observe
+            from deneva_tpu.cc.depgraph import witness_count
             aud2, _e, _bk, cnt, drop, _vd, _rd = audit_observe(
                 cfg, batch, exec_commit & active, order, lvl, order_vis,
                 db[AUDIT_KEY], state.epoch, cadence=knobs.audit_cadence)
@@ -515,6 +565,12 @@ class Engine:
             db[AUDIT_KEY] = aud2
             st["audit_edge_cnt"] += cnt.astype(jnp.uint32)
             st["audit_drop_cnt"] += drop.astype(jnp.uint32)
+            if claim_zero:
+                # sweep branches claim a conflict-free level-0 commit
+                # set: any level-0/level-0 edge is a claim witness
+                # (repair-salvaged endpoints sit at lvl >= 1, excluded)
+                st["audit_wit_cnt"] += witness_count(
+                    _e, lvl).astype(jnp.uint32)
             return db, st
 
         def budget_merge(verdict, eligible=None):
@@ -556,7 +612,7 @@ class Engine:
                 lvl = srounds if srounds is not None \
                     else jnp.zeros_like(verdict.level)
                 db, st = audit_into(db, st, exec_commit, verdict.order,
-                                    lvl, False)
+                                    lvl, False, claim_zero=True)
                 return (db, st, exec_commit, exec_commit, verdict.abort,
                         verdict.defer)
             return body
@@ -566,7 +622,7 @@ class Engine:
             # for this backend — forwarding executor when the workload
             # is blind-write (density via the scatter-add path, inc
             # never built), chained level waves otherwise
-            tb = backends[-1]
+            tb = backends[2]
             if forwarding_applies(tb, wl):
                 def body(_):
                     st = dict(stats)
@@ -597,6 +653,32 @@ class Engine:
                             verdict.abort, verdict.defer)
             return body
 
+        def dgcc_branch():
+            # uniform DGCC epoch (the controller's HOT class under
+            # ctrl_dgcc): the static step's wavefront path over the
+            # coarsened conflict view — coarsening composes soundly
+            # with the exact-key lane graph (merged keys only ADD
+            # dependencies, deepening waves but never hiding one) while
+            # execution/audit keep exact keys as everywhere.  No
+            # incidence (density via the scatter-add path), no repair
+            # (DGCC never aborts), no defer budget (chained exemption:
+            # its defers are the bounded cyclic fallback).
+            dg = backends[3]
+
+            def body(_):
+                st = dict(stats)
+                verdict, _cc = dg.validate(cfg, state.cc_state, cbatch,
+                                           None, stats=st)
+                density_into(st, None)
+                db, st = _run_levels(cfg, wl, state.db, queries,
+                                     verdict.commit, verdict, st,
+                                     level_exec=False)
+                db, st = audit_into(db, st, verdict.commit,
+                                    verdict.order, verdict.level, False)
+                return (db, st, verdict.commit, verdict.commit,
+                        verdict.abort, verdict.defer)
+            return body
+
         def mixed_branch(_):
             # mixed assignment: one shared coarse incidence; each
             # backend validates its own sub-batch with the cross-group
@@ -611,7 +693,8 @@ class Engine:
             st = dict(stats)
             inc = build_conflict_incidence(cfg, backends[0], cbatch,
                                            cbatch.order_free)
-            crossdef = cross_group_defer(inc, cbatch, group)
+            crossdef = cross_group_defer(inc, cbatch, group,
+                                         n_groups=len(backends))
             commit = jnp.zeros_like(active)
             abort = jnp.zeros_like(active)
             defer = crossdef
@@ -619,7 +702,15 @@ class Engine:
             for g, be_g in enumerate(backends):
                 m = active & (group == g) & ~crossdef
                 sb = dataclasses.replace(cbatch, active=m)
-                v_g, _cc = be_g.validate(cfg, state.cc_state, sb, inc)
+                if be_g.alg == CCAlg.DGCC:
+                    # DGCC ignores the incidence (exact-key lane graph
+                    # over its masked sub-batch) but keeps the [dgcc]
+                    # counters flowing in mixed epochs too
+                    v_g, _cc = be_g.validate(cfg, state.cc_state, sb,
+                                             None, stats=st)
+                else:
+                    v_g, _cc = be_g.validate(cfg, state.cc_state, sb,
+                                             inc)
                 commit = commit | (v_g.commit & m)
                 abort = abort | (v_g.abort & m)
                 defer = defer | (v_g.defer & m)
@@ -627,14 +718,26 @@ class Engine:
                     level = jnp.where(m, v_g.level, level)
             density_into(st, inc)
             # budget covers sweep-group txns and cross-group defers;
-            # TPU_BATCH's internal defers resolve by construction
-            # (static step's chained exemption)
+            # chained groups' internal defers resolve by construction
+            # (TPU_BATCH) or are the bounded cyclic fallback (DGCC) —
+            # the static step's chained exemption, per group
+            nonchained = functools.reduce(
+                jnp.logical_or,
+                [group == g for g, be_g in enumerate(backends)
+                 if not be_g.chained])
             verdict = budget_merge(
                 Verdict(commit=commit, abort=abort, defer=defer,
                         order=batch.rank, level=level),
-                eligible=(group != len(backends) - 1) | crossdef)
+                eligible=nonchained | crossdef)
+            # the union executes through one level chain: sweep winners
+            # at level 0 beside the chained groups' waves (cross-group
+            # conflicts all deferred).  With DGCC armed the executor
+            # takes the order-tournament path — for the conflict-free
+            # non-DGCC waves it degenerates to the fast path's result,
+            # so the static python flag keeps PR 16 programs untouched
             db, st = _run_levels(cfg, wl, state.db, queries,
-                                 verdict.commit, verdict, st)
+                                 verdict.commit, verdict, st,
+                                 level_exec=not cfg.ctrl_dgcc)
             db, st = audit_into(db, st, verdict.commit, verdict.order,
                                 verdict.level, False)
             return (db, st, verdict.commit, verdict.commit,
@@ -642,12 +745,17 @@ class Engine:
 
         # 4+5. routed validate/execute/repair/audit: uniform epochs take
         # their backend's exact static branch; disagreement routes to
-        # the mixed branch
+        # the mixed branch (always last)
+        branches = [sweep_branch(backends[0]), sweep_branch(backends[1]),
+                    tb_branch()]
+        if len(backends) > 3:
+            branches.append(dgcc_branch())
+        branches.append(mixed_branch)
         uniform = (knobs.assign == knobs.assign[0]).all()
-        idx = jnp.where(uniform, knobs.assign[0], jnp.int32(MIXED))
+        idx = jnp.where(uniform, knobs.assign[0],
+                        jnp.int32(len(backends)))
         db, stats, exec_commit, release, aborts, defers = jax.lax.switch(
-            idx, [sweep_branch(backends[0]), sweep_branch(backends[1]),
-                  tb_branch(), mixed_branch], None)
+            idx, branches, None)
 
         # 6. update pool + counters (identical to the static step with
         # forced=None; every candidate restamps aborts with fresh ts)

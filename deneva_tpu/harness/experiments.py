@@ -264,6 +264,43 @@ def repair_ablation(quick: bool) -> list[Config]:
     return out
 
 
+def dgcc_contention(quick: bool) -> list[Config]:
+    """DGCC wavefront backend (cc/dgcc.py) vs the optimistic salvage
+    stack at the contention points where optimism pays in aborts: YCSB
+    zipf 0.6/0.9 write-heavy (90% writes — the repair_ablation cell
+    where OCC+repair still aborts 0.84 of attempts) plus a write-perc
+    axis at zipf 0.9.  Per cell three backends: DGCC (dependency-graph
+    waves, aborts structurally zero — the only non-commit outcome is
+    the over-deep-closure DEFER), OCC with the repair engine at its
+    best setting (rounds=2, the results/repair winner), and retry-only
+    OCC (the floor).  The acceptance curve is committed txns/EPOCH
+    (txn_cnt / epoch_cnt — epoch-batched backends compare per epoch,
+    not per wall-second, on a host CPU) and abort rate; the [dgcc]
+    line's waves/wave_max break the wavefront depth down.  Quick mode
+    is the calibrated repair_ablation CPU operating point (16k rows,
+    8 accesses/txn, eb=512) so the two sweeps share cells;
+    ``results/dgcc`` records the captured artifact with provenance."""
+    base = paper_base(quick).replace(zipf_theta=0.9, read_perc=0.1,
+                                     write_perc=0.9)
+    if quick:
+        base = base.replace(synth_table_size=1 << 14, req_per_query=8,
+                            max_accesses=8, epoch_batch=512,
+                            conflict_buckets=2048,
+                            max_txn_in_flight=2048)
+    thetas = (0.6, 0.9) if quick else (0.0, 0.6, 0.8, 0.9, 0.99)
+    writes = (0.5,) if quick else (0.3, 0.5, 0.7)
+    cells = [base.replace(zipf_theta=t) for t in thetas]
+    cells += [base.replace(read_perc=1.0 - w, write_perc=w)
+              for w in writes]
+    out = []
+    for cell in cells:
+        out.append(cell.replace(cc_alg=CCAlg.DGCC))
+        out.append(cell.replace(cc_alg=CCAlg.OCC, repair=True,
+                                repair_rounds=2))
+        out.append(cell.replace(cc_alg=CCAlg.OCC, repair=False))
+    return out
+
+
 def tpcc_order_index(quick: bool) -> list[Config]:
     """Dynamic ordered ORDER index A/B (VERDICT r5 next #5): the two
     deterministic backends at 2-3 warehouse shapes with
@@ -461,6 +498,7 @@ experiment_map: dict[str, Callable[[bool], list[Config]]] = {
     "operating_points": operating_points,
     "escrow_ablation": escrow_ablation,
     "repair_ablation": repair_ablation,
+    "dgcc_contention": dgcc_contention,
     "tpcc_scaling": tpcc_scaling,
     "tpcc_escrow": tpcc_escrow,
     "tpcc_order_index": tpcc_order_index,

@@ -321,6 +321,27 @@ def parse_mesh(lines) -> list[dict[str, Any]]:
     return _parse_tagged(lines, _MESH)
 
 
+_DGCC = re.compile(r"\[dgcc\] (.*)")
+
+
+def parse_dgcc(lines) -> list[dict[str, Any]]:
+    """Per-node ``[dgcc]`` lines (engine/driver.py and runtime/server.py
+    when the DGCC wavefront backend can validate) -> [{node, waves,
+    wave_max, fallback, edges}].  The dependency-graph backend's health
+    ledger: ``waves`` sums the executed wavefront depths over the
+    measured window (>#epochs proves the backend actually chained —
+    the smoke gate's anti-inert signal), ``wave_max`` is the deepest
+    single-epoch wavefront of the run, ``fallback`` counts over-deep
+    closures deferred to the retry queue (the cyclic fallback), and
+    ``edges`` the pre-commit dependency-graph census (cross-checked
+    against the audit plane's post-commit DSG by the dgcc oracle).
+    Logs predating the DGCC backend — and every non-DGCC run — yield
+    [] — and every other parser here ignores ``[dgcc]`` lines — the
+    same forward/backward-compat contract as ``parse_membership``
+    through ``parse_mesh`` (tested in tests/test_harness.py)."""
+    return _parse_tagged(lines, _DGCC)
+
+
 def cfg_header(cfg: Config) -> str:
     """`# cfg key=value` echo lines the runner prepends to each output file
     so parsing never has to re-derive the config from the filename."""

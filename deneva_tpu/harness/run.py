@@ -64,6 +64,7 @@ RESULT_DIRS = {
     # experiment -> canonical results/ leaf when they differ (the
     # repair_ablation sweep IS the "results/repair" record)
     "repair_ablation": "repair",
+    "dgcc_contention": "dgcc",
 }
 
 

@@ -90,11 +90,19 @@ CTRL_TRACK = Track(7, "ctrl", frozenset(("ctrl",)))
 # execution (0 = fully overlapped, nothing emitted).  A latency ledger
 # like audit/ctrl, on its own declared track
 MESH_TRACK = Track(8, "mesh", frozenset(("mesh_prefetch",)))
+# DGCC wavefront backend (cc/dgcc.py): reserves the track for the
+# wavefront-execution ledger (``dgcc_waves``) so a future host-side
+# measurement cannot collide with an existing tid.  Today the wave
+# chain executes fused inside the jitted device step — its cost shows
+# in the phase clock's validate span and the [dgcc] counter line, not
+# as a separate host ledger — so the track is declared but normally
+# empty, like an idle follower's replication track
+DGCC_TRACK = Track(9, "dgcc", frozenset(("dgcc_waves",)))
 
 TRACKS: tuple[Track, ...] = (PHASE_TRACK, REPLICATION_TRACK,
                              ADMISSION_TRACK, FENCING_TRACK, TXN_TRACK,
                              CRITPATH_TRACK, AUDIT_TRACK, CTRL_TRACK,
-                             MESH_TRACK)
+                             MESH_TRACK, DGCC_TRACK)
 
 # span name -> owning track for the [timeline] ledger families
 SPAN_TRACK: dict[str, Track] = {name: t for t in TRACKS

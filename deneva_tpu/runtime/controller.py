@@ -56,6 +56,19 @@ SPARSE, MID, HOT = 0, 1, 2
 # against the static cells of results/router).
 CLASS_BACKEND = (0, 1, 2)
 
+# ctrl_dgcc variant: HOT partitions route to the DGCC wavefront branch
+# (candidate index 3) instead of TPU_BATCH — dependency-graph waves
+# commit what the deterministic batch would defer past its level budget
+# and what every abort-based scheme would abort (results/dgcc cells).
+CLASS_BACKEND_DGCC = (0, 1, 3)
+
+
+def default_backend_map(cfg: Config) -> tuple:
+    """The class->backend map this config's controller starts from
+    (tools/router_frontier.py may still pass a CALIBRATED map; replay
+    threads whichever map drove the run)."""
+    return CLASS_BACKEND_DGCC if cfg.ctrl_dgcc else CLASS_BACKEND
+
 GOV_ARMED, GOV_STATIC = "armed", "static"
 
 
@@ -123,16 +136,19 @@ class Controller:
     audit_quiet: int = 0        # consecutive witness-free ticks
     assign: list[int] = field(default_factory=list)  # last armed assign
     gshift: list[int] = field(default_factory=list)  # last armed gshift
-    # class -> backend map; CLASS_BACKEND (the paper's frontier) by
-    # default.  tools/router_frontier.py passes the map it CALIBRATES
-    # from the measured static cells instead — on a host whose cost
-    # model differs from the chip (cpu capture: no MXU pricing the
+    # class -> backend map; None resolves to default_backend_map(cfg)
+    # (the paper's frontier; its DGCC variant under ctrl_dgcc).
+    # tools/router_frontier.py passes the map it CALIBRATES from the
+    # measured static cells instead — on a host whose cost model
+    # differs from the chip (cpu capture: no MXU pricing the
     # deterministic batch) the measured frontier is the honest one.
     # Replay must use the same map (replay_decisions threads it).
-    backend_map: tuple = CLASS_BACKEND
+    backend_map: tuple | None = None
 
     def __post_init__(self):
         from deneva_tpu.cc.router import candidate_index
+        if self.backend_map is None:
+            self.backend_map = default_backend_map(self.cfg)
         p = max(self.cfg.part_cnt, 1)
         self.cls = [MID] * p
         self.pend = [MID] * p
@@ -303,7 +319,7 @@ def signals_of_row(row: dict) -> CtrlSignals:
 
 
 def replay_decisions(cfg: Config, rows: list[dict],
-                     backend_map: tuple = CLASS_BACKEND) -> list[str]:
+                     backend_map: tuple | None = None) -> list[str]:
     """Decision-determinism check: re-run a fresh Controller over the
     RECORDED signals of one node's ``[ctrl]`` rows (parse_ctrl order =
     emit order = seq order) and compare every decision field against

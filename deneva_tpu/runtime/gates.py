@@ -238,6 +238,29 @@ GATES: dict[str, GateSpec] = {s.name: s for s in (
         context=("mixed_branch",),
     ),
     GateSpec(
+        "dgcc",
+        # dependency-graph wavefront ROUTING (cc/dgcc.py as the
+        # controller's fourth router class).  CC_ALG=DGCC itself is an
+        # algorithm choice wired through the cc registry like MVCC —
+        # not a gated subsystem; what the default-off bit-identity
+        # contract covers is `ctrl_dgcc`, the bool that adds the DGCC
+        # branch to the routed step and flips the mixed branch onto the
+        # tournament execution path (engine/step.py keeps
+        # `level_exec=not cfg.ctrl_dgcc` static so the unarmed compiled
+        # program is the PR 16 one).  dgcc_levels is a depth knob with
+        # a live default (like repair_rounds), not a flag.  The backend
+        # module is home (its validate_dgcc entry point is reached
+        # through the registry, an algorithm dispatch, not a gate
+        # bypass); dgcc_levels-the-function is the declared use_call so
+        # a direct wave-assignment call outside the home must sit under
+        # the flag.
+        flags=("ctrl_dgcc",),
+        guards=("ctrl_dgcc",),
+        home=("deneva_tpu/cc/dgcc.py",),
+        use_calls=("dgcc_levels",),
+        requires=("ctrl",),
+    ),
+    GateSpec(
         "fencing",
         # partition & gray-failure tolerance: heartbeat failure
         # detection, fenced slot ownership, quorum reassignment

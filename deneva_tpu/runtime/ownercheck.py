@@ -105,7 +105,7 @@ OWNER: dict[str, str] = {
     # the group boundary in run() — all dispatch; workers never touch
     # the controller or its accumulators
     "ctl": DISPATCH, "_ctrl_ep": DISPATCH, "_ctrl_dens": DISPATCH,
-    "_ctrl_sv": DISPATCH, "_ctrl_wit": DISPATCH, "_ctrl_t": DISPATCH,
+    "_ctrl_sv": DISPATCH, "_ctrl_wit0": DISPATCH, "_ctrl_t": DISPATCH,
     "_ctrl_breach0": DISPATCH, "_ctrl_span": DISPATCH,
     "_ctrl_log": DISPATCH, "_ctrl_primed": DISPATCH,
     # fencing layer (runtime/faildet.py): detector, heartbeat ledgers

@@ -138,9 +138,20 @@ def _make_epoch_body(cfg: Config, wl, be):
                 db = wl.execute(db, query, None, verdict.order, stats,
                                 fwd_rank=fwd)
         else:
-            inc = build_conflict_incidence(cfg, be, batch,
-                                           batch.order_free)
-            verdict, cc_state = be.validate(cfg, cc_state, batch, inc)
+            if be.alg == CCAlg.DGCC:
+                # DGCC: exact-key lane graph (cc/depgraph), no hashed
+                # incidence; the stats dict carries the [dgcc] counters
+                # (the repair-engine stats contract).  The verdict is a
+                # pure replicated function of the merged batch, so the
+                # three verdict planes stay bit-identical across nodes
+                # and dp shardings — exactly CALVIN's cluster shape.
+                verdict, cc_state = be.validate(cfg, cc_state, batch,
+                                                None, stats=stats)
+            else:
+                inc = build_conflict_incidence(cfg, be, batch,
+                                               batch.order_free)
+                verdict, cc_state = be.validate(cfg, cc_state, batch,
+                                                inc)
             if cfg.audit_mutate:
                 # seeded edge-derivation fault (the audit plane's
                 # anti-inert knob): flipped losers execute and ack like
@@ -161,11 +172,15 @@ def _make_epoch_body(cfg: Config, wl, be):
                 from deneva_tpu.workloads.mc import mc_execute
                 db = mc_execute(cfg, wl, db, query, exec_commit,
                                 verdict.order, verdict.level, stats,
-                                chained=be.chained)
+                                chained=be.chained,
+                                level_exec=be.alg != CCAlg.DGCC,
+                                n_levels=cfg.dgcc_levels
+                                if be.alg == CCAlg.DGCC else None)
             elif be.chained:
                 from deneva_tpu.engine.step import _run_levels
                 db, stats = _run_levels(cfg, wl, db, query, exec_commit,
-                                        verdict, stats)
+                                        verdict, stats,
+                                        level_exec=be.alg != CCAlg.DGCC)
             else:
                 db = wl.execute(db, query, exec_commit, verdict.order,
                                 stats)
@@ -227,6 +242,16 @@ def _make_epoch_body(cfg: Config, wl, be):
             db[AUDIT_KEY] = aud2
             stats["audit_edge_cnt"] += cnt.astype(jnp.uint32)
             stats["audit_drop_cnt"] += drop.astype(jnp.uint32)
+            if not forwarding and not be.chained:
+                # witness density: CLAIM-VIOLATING edges (both
+                # endpoints at level 0 of a zero-edge-claim backend;
+                # repair-salvaged endpoints sit at lvl >= 1).  Chained/
+                # forwarding backends legitimately emit edges, so the
+                # counter stays zero for them by the same rule the
+                # in-process engine applies (engine/step.py 5c).
+                from deneva_tpu.cc.depgraph import witness_count
+                stats["audit_wit_cnt"] += witness_count(
+                    edges, lvl).astype(jnp.uint32)
             aud_out = (edges, ebkt, cnt, drop, vdig, rdig)
         return (db, cc_state, stats, done, abort & ~done, defer, rep,
                 dens, aud_out)
@@ -859,7 +884,13 @@ class ServerNode:
             self._ctrl_ep = 0
             self._ctrl_dens = np.zeros(max(cfg.part_cnt, 1), np.int64)
             self._ctrl_sv = 0
-            self._ctrl_wit = 0
+            # witness DENSITY baseline: the device audit_wit_cnt counter
+            # holds claim-violating edges only (cc/depgraph.
+            # witness_count) — chained/DGCC epochs legitimately emit
+            # edges, so feeding the raw edge volume would pin
+            # audit_cadence to 1 under any contention.  Delta'd against
+            # this baseline at each boundary tick.
+            self._ctrl_wit0 = 0
             self._ctrl_t = time.monotonic()
             self._ctrl_breach0 = 0
             self._ctrl_span = 0.0
@@ -2483,6 +2514,15 @@ class ServerNode:
             self.tp.sendv(agg, "METRICS", parts)
 
     # -- control plane: boundary tick -------------------------------------
+    def _wit_counter(self) -> int:
+        """Cumulative witness density off the device (audit_wit_cnt —
+        claim-violating edges only; one scalar fetch per boundary tick,
+        riding the same cadence as the breach/salvage folds)."""
+        if not self.cfg.audit:
+            return 0
+        import jax
+        return int(jax.device_get(self.dev_stats["audit_wit_cnt"]))
+
     def _ctrl_tick(self, group_end: int, tl) -> None:
         """One controller decision per group boundary: fold the retire
         loop's accumulated signals into a `CtrlSignals`, decide, actuate
@@ -2505,7 +2545,7 @@ class ServerNode:
             self._ctrl_ep = 0
             self._ctrl_dens[:] = 0
             self._ctrl_sv = 0
-            self._ctrl_wit = 0
+            self._ctrl_wit0 = self._wit_counter()
             if self.adm is not None:
                 self._ctrl_breach0 = self.adm.breach_groups
             return
@@ -2516,15 +2556,17 @@ class ServerNode:
             b = self.adm.breach_groups
             breaches = b - self._ctrl_breach0
             self._ctrl_breach0 = b
+        wit_now = self._wit_counter()
         sig = CtrlSignals(
             epoch=int(group_end), epochs=self._ctrl_ep,
             dens=[int(x) for x in self._ctrl_dens],
             fallback=0, salvaged=self._ctrl_sv,
-            witnesses=self._ctrl_wit, breaches=breaches, gap_us=gap_us)
+            witnesses=wit_now - self._ctrl_wit0, breaches=breaches,
+            gap_us=gap_us)
         self._ctrl_ep = 0
         self._ctrl_dens[:] = 0
         self._ctrl_sv = 0
-        self._ctrl_wit = 0
+        self._ctrl_wit0 = wit_now
         dec = self.ctl.decide(sig)
         if self.adm is not None:
             self.adm.set_scale(quota_scale(dec.quota_idx))
@@ -2731,8 +2773,6 @@ class ServerNode:
                 if rep is not None:
                     self._ctrl_sv += int((rep[i, lo:lo + n]
                                           & my_commit).sum())
-                if auda is not None:
-                    self._ctrl_wit += int(auda[2][i])
             restart = ab | df
             if restart.any():
                 idx = np.where(restart)[0]
@@ -3395,6 +3435,26 @@ class ServerNode:
                 **rep_fields, rounds=cfg.repair_rounds,
                 plane_cnt=self._rep_salvaged - self._rep_meas)),
                 flush=True)
+        if cfg.cc_alg == CCAlg.DGCC:
+            # DGCC wavefront ledger ([summary] satellite + the [dgcc]
+            # line, parsed by harness.parse.parse_dgcc) — same fields
+            # as the in-process driver's; wave_max is the run-wide
+            # device running max.  Emitted only under DGCC so every
+            # other config's output is byte-identical.
+            from deneva_tpu.stats import tagged_line
+            for k in ("dgcc_wave_cnt", "dgcc_fallback_cnt",
+                      "dgcc_edge_cnt"):
+                st.set(k, float(final[k] - measured[k]))
+            st.set("dgcc_wave_max", float(final["dgcc_wave_max"]))
+            print(tagged_line("dgcc", {
+                "node": self.me,
+                "waves": int(final["dgcc_wave_cnt"]
+                             - measured["dgcc_wave_cnt"]),
+                "wave_max": int(final["dgcc_wave_max"]),
+                "fallback": int(final["dgcc_fallback_cnt"]
+                                - measured["dgcc_fallback_cnt"]),
+                "edges": int(final["dgcc_edge_cnt"]
+                             - measured["dgcc_edge_cnt"])}), flush=True)
         if self.adm is not None:
             # admission counters ([summary]) + per-tenant [admission]
             # lines (parsed by harness.parse.parse_admission)
@@ -3433,7 +3493,8 @@ class ServerNode:
             # the [audit] line (parsed by harness.parse.parse_audit);
             # the device edge counters diff over the measured window
             # like every other device stat
-            for k in ("audit_edge_cnt", "audit_drop_cnt"):
+            for k in ("audit_edge_cnt", "audit_drop_cnt",
+                      "audit_wit_cnt"):
                 st.set(k, float(final[k] - measured[k]))
             self.aud.summary_into(st)
             print(self._AUD.audit_line(self.me, self.aud.fields()),

@@ -127,13 +127,22 @@ def table_specs(db: dict) -> dict:
 
 def mc_execute(cfg, wl, db: dict, queries, commit: jax.Array,
                order: jax.Array, level: jax.Array, stats: dict,
-               chained: bool) -> dict:
+               chained: bool, level_exec: bool = True,
+               n_levels: int | None = None) -> dict:
     """One epoch's execution, partition-parallel across the mesh.
 
     ``commit``/``order``/``level`` come from the replicated verdict; for
     chained backends each wavefront level executes as a sub-round against
     the chip-local table state, exactly like the single-chip engine loop
-    (`engine/step.py`)."""
+    (`engine/step.py`).  ``level_exec`` follows `engine/step._run_levels`:
+    True claims each sub-round's committed set is write-conflict-free
+    (CALVIN/TPU_BATCH); False (DGCC) keeps the per-wave ``last_writer``
+    order tournament, so same-wave duplicate writers resolve identically
+    on every shard (the verdict is replicated, the tournament is a pure
+    function of it — dp>1 stays bit-identical to dp=1).  ``n_levels``
+    overrides the static sub-round unroll budget (DGCC waves are bounded
+    by ``dgcc_levels``, not ``exec_subrounds`` — a committed level past
+    the unroll would silently never execute)."""
     mesh = current_mesh()
     assert mesh is not None and mesh.size == cfg.device_parts, \
         f"mc_execute needs a use_mesh({cfg.device_parts}) context"
@@ -146,10 +155,11 @@ def mc_execute(cfg, wl, db: dict, queries, commit: jax.Array,
         st = {"read_checksum": jnp.zeros((), jnp.uint32),
               "write_cnt": jnp.zeros((), jnp.uint32)}
         if chained:
-            for lvl in range(cfg.exec_subrounds):
+            for lvl in range(n_levels if n_levels is not None
+                             else cfg.exec_subrounds):
                 m = commit & (level == lvl)
                 dbv = wl.execute(dbv, queries, m, order, st,
-                                 level_exec=True)
+                                 level_exec=level_exec)
         else:
             dbv = wl.execute(dbv, queries, commit, order, st)
         out = {n: (v.assemble() if isinstance(v, McTableView) else v)

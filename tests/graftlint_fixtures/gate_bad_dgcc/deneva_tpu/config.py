@@ -1,0 +1,9 @@
+"""Fixture config: the dgcc routing flag, default OFF (the registry
+drift check cross-parses this module against the REAL dgcc
+GateSpec)."""
+
+
+class Config:
+    ctrl_dgcc: bool = False
+    dgcc_levels: int = 32
+    node_cnt: int = 1

@@ -1,0 +1,30 @@
+"""Seeded graftlint violations: the REAL ``dgcc`` GateSpec
+(runtime/gates.py) checked against fixture call sites — an unguarded
+call into the wavefront home module (cc/dgcc.py) or an unguarded
+wave-assignment use_call must fail the lint, while the guarded idioms
+the runtime uses (``cfg.ctrl_dgcc`` dominating the call, a local alias
+of the flag) stay silent."""
+
+from deneva_tpu.cc.dgcc import dgcc_levels, validate_dgcc
+
+
+class StepFx:
+    def ok_routed(self, cfg, state, batch):
+        # the runtime idiom: the routing flag dominates the home call
+        if cfg.ctrl_dgcc:
+            return validate_dgcc(cfg, state, batch)
+        return None
+
+    def ok_alias(self, cfg, batch):
+        # a local alias of the flag inherits guard-ness
+        armed = cfg.ctrl_dgcc
+        if armed:
+            return dgcc_levels(cfg, batch)
+        return None
+
+    def bad_validate(self, cfg, state, batch):
+        # no dominating ctrl_dgcc test on any path to the home call
+        return validate_dgcc(cfg, state, batch)  # EXPECT[gate-unguarded-use]
+
+    def bad_waves(self, cfg, batch):
+        return dgcc_levels(cfg, batch)        # EXPECT[gate-unguarded-use]

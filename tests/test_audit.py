@@ -87,8 +87,9 @@ def test_config_gating():
              audit_mutate="occ-read-skip:4")
     with pytest.raises(ValueError):        # malformed spec
         _cfg(audit_mutate="occ-read-skip")
-    with pytest.raises(ValueError):        # MVCC version-select reads
-        _cfg(cc_alg=CCAlg.MVCC)
+    # MVCC version-select reads are MODELED since the depgraph refactor
+    # (per-slot version rings in the stamp state): audit+MVCC validates
+    _cfg(cc_alg=CCAlg.MVCC)
     with pytest.raises(ValueError):        # PPS not wired
         _cfg(workload=WorkloadKind.PPS, pps_parts_per=4, max_accesses=16)
     with pytest.raises(ValueError):        # rank packing bound
@@ -201,6 +202,61 @@ def test_stamp_tables_and_digests():
     _, _, _, _, _, r_fresh = _observe(cfg, r, _mask(8, [0]), aud=aud0)
     _, _, _, _, _, r_after = _observe(cfg, r, _mask(8, [0]), aud=aud1)
     assert r_fresh != r_after
+
+
+def test_mvcc_version_ring_visibility():
+    """MVCC per-read observed-version export (the depgraph refactor's
+    headroom item): a read's observed stamp is SELECTED BY ITS OWN
+    TIMESTAMP from the bucket's version-boundary ring, so a stale
+    reader and a fresh reader digest DIFFERENT observations — under
+    every other backend's last-writer stamp model they are identical,
+    which is exactly the MVCC anomaly the audit plane used to miss."""
+    import dataclasses
+    from deneva_tpu.cc import depgraph
+
+    # the in-ring select rule: newest boundary <= ts, -1 pre-horizon
+    vts = jnp.asarray([[10, 20, 30, -1]], jnp.int32)
+    for ts, want in ((15, 0), (25, 1), (99, 2), (5, -1)):
+        sel = depgraph.version_select(vts, jnp.asarray([ts], jnp.int32))
+        assert int(sel[0]) == want, (ts, want)
+
+    # two writer epochs push boundaries ts=10 and ts=20 into the ring
+    cfg = _cfg(cc_alg=CCAlg.MVCC)
+    aud = audit_init(cfg)
+    assert "vts" in aud            # rings exist only under MVCC
+    w = _batch([[(5, "w")]])
+    for e, wts in ((1, 10), (2, 20)):
+        wb = dataclasses.replace(w, ts=jnp.full(8, wts, jnp.int32))
+        aud, _, _, _, _, _ = _observe(cfg, wb, _mask(8, [0]), aud=aud,
+                                      epoch=e)
+    retained = set(np.asarray(aud["vts"]).ravel().tolist())
+    assert {10, 20} <= retained    # both boundaries retained
+
+    def rdig_at(a, ts):
+        r = dataclasses.replace(_batch([[(5, "r")]]),
+                                ts=jnp.full(8, ts, jnp.int32))
+        return _observe(cfg, r, _mask(8, [0]), aud=a)[5]
+
+    stale, fresh, horizon = rdig_at(aud, 12), rdig_at(aud, 25), \
+        rdig_at(aud, 5)
+    assert stale != fresh          # ts selects the version, not the
+    assert horizon not in (stale, fresh)   # last writer; pre-horizon
+    # reads observe epoch-start-of-history, distinct from both
+    # control: the OCC stamp model cannot see the difference
+    ocfg = _cfg()
+    oaud = audit_init(ocfg)
+    assert "vts" not in oaud
+    for e, wts in ((1, 10), (2, 20)):
+        wb = dataclasses.replace(w, ts=jnp.full(8, wts, jnp.int32))
+        oaud, _, _, _, _, _ = _observe(ocfg, wb, _mask(8, [0]),
+                                       aud=oaud, epoch=e)
+
+    def ordig_at(ts):
+        r = dataclasses.replace(_batch([[(5, "r")]]),
+                                ts=jnp.full(8, ts, jnp.int32))
+        return _observe(ocfg, r, _mask(8, [0]), aud=oaud)[5]
+
+    assert ordig_at(12) == ordig_at(25)
 
 
 # ---- the seeded mutation ----------------------------------------------

@@ -411,7 +411,8 @@ def test_nocc_commits_everything():
     assert np.asarray(v.commit)[:3].all()
 
 @pytest.mark.parametrize("alg", ["NO_WAIT", "WAIT_DIE", "OCC", "TIMESTAMP",
-                                 "MVCC", "MAAT", "CALVIN", "TPU_BATCH"])
+                                 "MVCC", "MAAT", "CALVIN", "TPU_BATCH",
+                                 "DGCC"])
 def test_randomized_serializability(alg):
     rng = np.random.default_rng(42)
     be = get_backend(alg)

@@ -225,6 +225,47 @@ def test_replay_reports_tampered_row():
     assert bad and "assign" in bad[0]
 
 
+def test_dgcc_arms_fourth_router_class():
+    """ctrl_dgcc swaps the HOT class's backend from TPU_BATCH (2) to
+    the DGCC wavefront candidate (3): the default map gains the fourth
+    class only under the flag, the same hot-density stream that moved
+    a plain controller to [2] moves the armed one to [3], and the
+    flag-off stream is untouched — the default-off contract."""
+    from deneva_tpu.runtime.controller import (CLASS_BACKEND,
+                                               CLASS_BACKEND_DGCC,
+                                               default_backend_map)
+    cfg = ctl_cfg(ctrl_cooldown=0, ctrl_confirm=1)
+    dcfg = ctl_cfg(ctrl_cooldown=0, ctrl_confirm=1, ctrl_dgcc=True)
+    assert default_backend_map(cfg) == CLASS_BACKEND == (0, 1, 2)
+    assert default_backend_map(dcfg) == CLASS_BACKEND_DGCC == (0, 1, 3)
+    hot = sig(dens=[lanes(cfg, 0.5)])
+    assert Controller(cfg).decide(hot).assign == [2]
+    ctl = Controller(dcfg)
+    assert ctl.decide(hot).assign == [3] and ctl.cls == [HOT]
+    # the cold end is untouched: SPARSE still routes to class 0
+    assert Controller(dcfg).decide(
+        sig(dens=[lanes(dcfg, 0.001)])).assign == [0]
+
+
+def test_dgcc_replay_compat_both_directions():
+    """Replay stays bit-faithful across the map change: rows recorded
+    by a dgcc-armed controller verify under the armed cfg (forward),
+    pre-dgcc rows verify under the plain cfg exactly as before
+    (backward — test_replay_reproduces_decision_stream), and replaying
+    armed rows under the WRONG map is reported, not silently accepted —
+    unless the caller pins the recorded map via the backend_map
+    parameter (the audit-a-foreign-log path)."""
+    from deneva_tpu.runtime.controller import CLASS_BACKEND_DGCC
+    dcfg = ctl_cfg(ctrl_confirm=2, ctrl_cooldown=2, ctrl_dgcc=True)
+    drows = _scripted_rows(dcfg)
+    assert replay_decisions(dcfg, drows) == []
+    cfg = ctl_cfg(ctrl_confirm=2, ctrl_cooldown=2)
+    bad = replay_decisions(cfg, drows)
+    assert bad and any("assign" in m for m in bad)
+    assert replay_decisions(cfg, drows,
+                            backend_map=CLASS_BACKEND_DGCC) == []
+
+
 def test_signals_round_trip_through_line():
     s = sig(epoch=7, epochs=3, dens=[5, 0, 9], fallback=2, salvaged=1,
             witnesses=4, breaches=1, gap_us=123456)

@@ -247,6 +247,26 @@ def test_ctrl_gate_fires_on_unguarded_use():
         "\n".join(f.render() for f in findings)
 
 
+def test_dgcc_gate_fires_on_unguarded_use():
+    """The REAL ``dgcc`` GateSpec (runtime/gates.py) catches an
+    unguarded call into the wavefront home module (cc/dgcc.py) and an
+    unguarded wave-assignment use_call, while accepting the guarded
+    idioms the runtime uses (``cfg.ctrl_dgcc`` dominating the call, a
+    local alias of the flag) — the CI teeth behind the fourth router
+    class's default-off bit-identity contract (CC_ALG=DGCC itself is
+    registry dispatch, not a gate bypass)."""
+    from deneva_tpu.runtime.gates import GATES
+
+    root = os.path.join(FIX, "gate_bad_dgcc")
+    tree = Tree(root, ["."])
+    findings = tree.filter(gateconsistency.check(
+        tree, gates={"dgcc": GATES["dgcc"]}, exempt=(),
+        escrow_funcs=(), escrow_home=(),
+        config_module="deneva_tpu/config.py", guarded=(), model={}))
+    assert _got(findings) == _expected(root), \
+        "\n".join(f.render() for f in findings)
+
+
 def test_device_pin_gate_fires_on_silent_pin():
     """gate-device-pin: conjoining the REAL ``audit`` gate's guard with
     a ``device_parts`` comparison fires — the silent single-device pin

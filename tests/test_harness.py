@@ -714,6 +714,64 @@ def test_parse_mesh_forward_backward_compat(tmp_path):
     assert parse_file(str(old_log))["tput"] == 5
 
 
+def test_parse_dgcc_forward_backward_compat(tmp_path):
+    """[dgcc] lines (wavefront-backend tentpole): one row per node at
+    summary time carrying the wave ledger — waves summed over the
+    measured window (> #epochs proves the backend chained, the smoke
+    gate's anti-inert signal), the deepest single-epoch wavefront, the
+    over-deep DEFER fallbacks and the pre-commit edge census; old logs
+    yield [], the new lines perturb no other parser, the [summary]
+    dgcc_* fields parse through the standard summary path, and the
+    "dgcc_waves" span name maps onto the declared tid-9 track."""
+    from deneva_tpu.harness.parse import (parse_admission, parse_ctrl,
+                                          parse_dgcc, parse_file,
+                                          parse_membership, parse_mesh,
+                                          parse_metrics, parse_repair,
+                                          parse_replication)
+    from deneva_tpu.harness.timeline import parse_timeline
+    from deneva_tpu.stats import tagged_line
+
+    new_log = tmp_path / "dgcc.out"
+    new_log.write_text(
+        "# cfg node_cnt=2\n"
+        + tagged_line("dgcc", {"node": 1, "waves": 640, "wave_max": 17,
+                               "fallback": 12, "edges": 48311})
+        + "\n"
+        "[timeline] node=1 epoch=64 loop=1.0ms validate=0.3ms\n"
+        "[summary] total_runtime=2,tput=1800,txn_cnt=3600,"
+        "total_txn_commit_cnt=3600,total_txn_abort_cnt=0,"
+        "dgcc_wave_cnt=640,dgcc_wave_max=17,dgcc_fallback_cnt=12,"
+        "dgcc_edge_cnt=48311\n")
+    rows = parse_dgcc(new_log.read_text().splitlines())
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["node"] == 1 and r["waves"] == 640 and r["wave_max"] == 17
+    assert r["fallback"] == 12 and r["edges"] == 48311
+    row = parse_file(str(new_log))
+    assert row["dgcc_wave_cnt"] == 640 and row["dgcc_fallback_cnt"] == 12
+    # the abort contract the backend ships with: fallbacks are DEFERS,
+    # aborts stay zero in the standard summary fields
+    assert row["total_txn_abort_cnt"] == 0
+    # other parsers ignore the new line entirely
+    text = new_log.read_text().splitlines()
+    assert parse_membership(text) == []
+    assert parse_replication(text) == []
+    assert parse_admission(text) == []
+    assert parse_repair(text) == []
+    assert parse_metrics(text) == []
+    assert parse_ctrl(text) == []
+    assert parse_mesh(text) == []
+    assert len(parse_timeline(text)) == 1
+    from deneva_tpu.harness.timeline import DGCC_TRACK, SPAN_TRACK
+    assert SPAN_TRACK["dgcc_waves"] is DGCC_TRACK
+    assert DGCC_TRACK.tid == 9
+    # old log (pre-DGCC, or any other backend): [] and unchanged parsing
+    old_log = tmp_path / "old.out"
+    old_log.write_text("# cfg node_cnt=2\n[summary] total_runtime=1,tput=5\n")
+    assert parse_dgcc(old_log.read_text().splitlines()) == []
+    assert parse_file(str(old_log))["tput"] == 5
+
+
 def test_track_registry_covers_every_span_family():
     """The declared track registry (timeline.TRACKS) replaces the magic
     Chrome-trace tids: every tagged-line ledger family maps to exactly

@@ -91,6 +91,15 @@
 #                                         (sharded compile + measured-path
 #                                         run_simulation over every
 #                                         backend family)
+#   tools/smoke.sh dgcc                   wavefront-backend gate:
+#                                         dgcc-off pin tests (router/
+#                                         map/counter/wire bit-identity
+#                                         with the backend unarmed) +
+#                                         the zipf-0.9 write-heavy
+#                                         anti-inert window (waves
+#                                         chain: wave_max > 1,
+#                                         waves > epochs, commits > 0,
+#                                         aborts == 0)
 #   tools/smoke.sh lint                   static-analysis gate: graftlint v2
 #                                         (trace/det/wire/own/imports + the
 #                                         gate/life/jit families on the
@@ -212,6 +221,46 @@ case "$SCEN" in
     run "$T" env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
     ;;
+  dgcc)
+    # off-pin first (router candidates / backend map / device counters /
+    # wire bytes all pre-DGCC with the backend unarmed), then the
+    # anti-inert half through the REAL measured path: a zipf-0.9
+    # write-heavy window where the wavefront must actually chain
+    # (wave_max > 1, waves > epochs) while committing with ZERO aborts —
+    # the near-zero-abort claim, pinned (a run that silently stopped
+    # validating would fail the commit floor, one that stopped chaining
+    # would fail wave_max)
+    T="${SMOKE_TIMEOUT_SECS:-${DGCC_TIMEOUT_SECS:-600}}"
+    run "$T" python -m pytest \
+        "tests/test_dgcc.py::test_dgcc_off_pin" \
+        "tests/test_dgcc.py::test_engine_hot_zipf_waves_chain_zero_aborts" \
+        -q -p no:cacheprovider
+    run "$T" python - <<'EOF'
+from deneva_tpu.config import CCAlg, Config
+from deneva_tpu.engine.driver import run_simulation
+
+cfg = Config(cc_alg=CCAlg.DGCC, zipf_theta=0.9,
+             read_perc=0.1, write_perc=0.9,
+             synth_table_size=1 << 14, req_per_query=8, max_accesses=8,
+             epoch_batch=512, conflict_buckets=2048,
+             max_txn_in_flight=2048,
+             warmup_secs=0.5, done_secs=2.0).validate()
+st = run_simulation(cfg)
+c = st.counters
+epochs, commits = c["epoch_cnt"], c["total_txn_commit_cnt"]
+aborts, waves = c["total_txn_abort_cnt"], c["dgcc_wave_cnt"]
+wave_max = c["dgcc_wave_max"]
+print(f"[dgcc-smoke] epochs={epochs:.0f} commits={commits:.0f} "
+      f"aborts={aborts:.0f} waves={waves:.0f} wave_max={wave_max:.0f} "
+      f"fallback={c['dgcc_fallback_cnt']:.0f} "
+      f"edges={c['dgcc_edge_cnt']:.0f}")
+assert commits > 0, "inert: nothing committed"
+assert aborts == 0, f"DGCC aborted {aborts:.0f} txns"
+assert wave_max > 1, "inert: wavefront never chained"
+assert waves > epochs, "inert: ~1 wave per epoch at zipf 0.9"
+print("[dgcc-smoke] PASS")
+EOF
+    ;;
   lint)
     # static gate; budget 30 s total on the 2-core CI box (graftlint v2
     # measures ~6.5 s full-tree over the 8 families / 78 files, ruff
@@ -234,7 +283,7 @@ case "$SCEN" in
     fi
     ;;
   *)
-    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|ctrl|monitor|trace|mesh|lint> [args...]" >&2
+    echo "usage: tools/smoke.sh <chaos|escrow|overlap|elastic|geo|overload|partition|repair|ctrl|monitor|trace|mesh|dgcc|lint> [args...]" >&2
     exit 2
     ;;
 esac
